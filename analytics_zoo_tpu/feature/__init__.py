@@ -1,0 +1,5 @@
+from .feature_set import (ArrayFeatureSet, FeatureSet, GeneratorFeatureSet,
+                          MiniBatch, PrefetchIterator, Sample)
+
+__all__ = ["ArrayFeatureSet", "FeatureSet", "GeneratorFeatureSet",
+           "MiniBatch", "PrefetchIterator", "Sample"]

@@ -1,0 +1,443 @@
+"""Keras-style model topology: Sequential / Model / KerasNet.
+
+Parity surface: ``zoo/.../pipeline/api/keras/models/Topology.scala`` —
+``KerasNet`` (compile:135, fit:343, evaluate, predict, setTensorBoard:204,
+setCheckpoint:245, gradient clipping:261-294), ``Model``:602,
+``Sequential``:825 — and the python mirror
+``pyzoo/zoo/pipeline/api/keras/engine/topology.py``.
+
+TPU redesign: ``compile`` builds an :class:`SPMDTrainer` whose jitted step is
+the whole iteration (forward+backward+psum+update in one XLA program); both
+containers are themselves :class:`KerasLayer` so they nest and can be called
+on symbolic Variables (weight sharing included).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .....common.zoo_trigger import EveryEpoch, MaxEpoch, ZooTrigger
+from .....common.nncontext import get_nncontext
+from .....feature.feature_set import ArrayFeatureSet, FeatureSet
+from .....pipeline.engine import GradientClipping, SPMDTrainer
+from .....utils import serialization, tensorboard
+from ..metrics import get_metric
+from ..objectives import get_loss
+from ..optimizers import get_optimizer
+from .base import InputLayer, KerasLayer
+from .graph import GraphFunction, Node, Variable
+
+
+def to_feature_set(x, y=None) -> FeatureSet:
+    if isinstance(x, FeatureSet):
+        return x
+    if hasattr(x, "to_feature_set"):  # ImageSet / TextSet / DataFrames
+        return x.to_feature_set()
+    return ArrayFeatureSet(x, y)
+
+
+def _apply_layer_chain(layers, params, x, state, training, rng):
+    """Shared sequential-application logic for containers."""
+    new_state = {}
+    state = state or {}
+    for layer in layers:
+        p = params.get(layer.name, {}) if params else {}
+        kwargs: Dict[str, Any] = {}
+        if layer.has_state:
+            kwargs["state"] = state.get(layer.name, {})
+        if layer.stochastic:
+            layer_rng = None
+            if rng is not None:
+                rng, layer_rng = jax.random.split(rng)
+            kwargs["rng"] = layer_rng
+        out = layer.call(p, x, training=training, **kwargs)
+        if layer.has_state:
+            out, s = out
+            new_state[layer.name] = s
+        x = out
+    return x, new_state
+
+
+class KerasNet(KerasLayer):
+    """Common training surface for Sequential and Model."""
+
+    has_state = True
+    stochastic = True
+
+    def __init__(self, name=None):
+        super().__init__(name=name)
+        self.optimizer = None
+        self.loss = None
+        self.metrics: List = []
+        self.trainer: Optional[SPMDTrainer] = None
+        self._clipping = GradientClipping()
+        self._checkpoint_dir = None
+        self._checkpoint_trigger: Optional[ZooTrigger] = None
+        self._tb: Optional[tuple] = None
+        self._compute_dtype = None
+
+    # -- abstract ------------------------------------------------------
+    def graph_function(self) -> GraphFunction:
+        raise NotImplementedError
+
+    # -- config --------------------------------------------------------
+    def compile(self, optimizer, loss, metrics=None):
+        """Parity: Topology.scala:135 / topology.py compile."""
+        self.optimizer = get_optimizer(optimizer)
+        self.loss = get_loss(loss)
+        self.metrics = [get_metric(m, self.loss) for m in (metrics or [])]
+        self.trainer = None  # rebuild on next fit
+        return self
+
+    def set_constant_gradient_clipping(self, min_value, max_value):
+        self._clipping = GradientClipping(min_value=min_value,
+                                          max_value=max_value)
+
+    def set_gradient_clipping_by_l2_norm(self, clip_norm):
+        self._clipping = GradientClipping(l2_norm=clip_norm)
+
+    def clear_gradient_clipping(self):
+        self._clipping = GradientClipping()
+
+    def set_tensorboard(self, log_dir, app_name):
+        self._tb = (log_dir, app_name)
+
+    def get_train_summary(self, tag=None):
+        if not self._tb:
+            return []
+        return tensorboard.read_scalars(
+            os.path.join(self._tb[0], self._tb[1], "train"), tag)
+
+    def get_validation_summary(self, tag=None):
+        if not self._tb:
+            return []
+        return tensorboard.read_scalars(
+            os.path.join(self._tb[0], self._tb[1], "validation"), tag)
+
+    def set_checkpoint(self, path, over_write=True,
+                       trigger: Optional[ZooTrigger] = None):
+        self._checkpoint_dir = path
+        self._checkpoint_trigger = trigger or EveryEpoch()
+
+    def set_evaluate_status(self):  # parity no-op (eval uses training=False)
+        return self
+
+    def set_compute_dtype(self, dtype):
+        """TPU-specific: run forward/backward in bfloat16 (params stay f32)."""
+        self._compute_dtype = dtype
+        self.trainer = None
+        return self
+
+    # -- trainer plumbing ---------------------------------------------
+    def _ensure_trainer(self) -> SPMDTrainer:
+        if self.trainer is not None:
+            return self.trainer
+        graph = self.graph_function()
+        old_params = None
+        old_state = None
+        if getattr(self, "_built_params", None) is not None:
+            old_params, old_state = self._built_params
+
+        def apply_fn(params, inputs, state, training, rng):
+            return graph.apply(params, inputs, state=state, training=training,
+                               rng=rng, collect_state=True)
+
+        def init_fn(rng):
+            return graph.init(rng)
+
+        optimizer = self.optimizer or get_optimizer("sgd")
+        loss = self.loss if self.loss is not None else get_loss("mse")
+        self.trainer = SPMDTrainer(
+            apply_fn, init_fn, loss, optimizer, metrics=self.metrics,
+            compute_dtype=self._compute_dtype, clipping=self._clipping,
+            param_sharding_fn=getattr(self, "_param_sharding_fn", None))
+        if old_params is not None:
+            self.trainer.set_params(old_params, old_state)
+        if self._checkpoint_dir:
+            self.trainer.checkpoint_dir = self._checkpoint_dir
+            self.trainer.checkpoint_trigger = self._checkpoint_trigger
+        if self._tb:
+            self.trainer.train_summary = tensorboard.TrainSummary(*self._tb)
+            self.trainer.val_summary = tensorboard.ValidationSummary(
+                *self._tb)
+        return self.trainer
+
+    def set_param_sharding(self, fn):
+        """Install a params->shardings fn (see parallel.sharding)."""
+        self._param_sharding_fn = fn
+        self.trainer = None
+
+    # -- training surface ---------------------------------------------
+    def fit(self, x, y=None, batch_size=32, nb_epoch=10,
+            validation_data=None, distributed=True,
+            checkpoint_trigger=None):
+        trainer = self._ensure_trainer()
+        train_set = to_feature_set(x, y)
+        val_set = None
+        if validation_data is not None:
+            if isinstance(validation_data, tuple):
+                val_set = to_feature_set(*validation_data)
+            else:
+                val_set = to_feature_set(validation_data)
+        end_epoch = trainer.epoch + nb_epoch
+        trainer.train(train_set, batch_size,
+                      end_trigger=MaxEpoch(end_epoch),
+                      checkpoint_trigger=checkpoint_trigger,
+                      validation_set=val_set)
+        self._built_params = (trainer.params, trainer.net_state)
+        return self
+
+    def evaluate(self, x, y=None, batch_size=32):
+        trainer = self._ensure_trainer()
+        results = trainer.evaluate(to_feature_set(x, y), batch_size)
+        self._built_params = (trainer.params, trainer.net_state)
+        return results
+
+    def predict(self, x, batch_size=128, distributed=True):
+        trainer = self._ensure_trainer()
+        if isinstance(x, FeatureSet):
+            data = x
+        elif hasattr(x, "to_feature_set"):
+            data = x.to_feature_set()
+        else:
+            data = ArrayFeatureSet(x)
+        out = trainer.predict(data, batch_size)
+        self._built_params = (trainer.params, trainer.net_state)
+        return out
+
+    def predict_classes(self, x, batch_size=128, zero_based_label=True):
+        probs = self.predict(x, batch_size)
+        classes = np.argmax(probs, axis=-1)
+        return classes if zero_based_label else classes + 1
+
+    # -- weights -------------------------------------------------------
+    def _params_tuple(self):
+        if self.trainer is not None and self.trainer.params is not None:
+            return self.trainer.params, self.trainer.net_state
+        if getattr(self, "_built_params", None) is not None:
+            return self._built_params
+        # build eagerly
+        trainer = self._ensure_trainer()
+        trainer.ensure_initialized()
+        self._built_params = (trainer.params, trainer.net_state)
+        return self._built_params
+
+    def get_weights(self) -> List[np.ndarray]:
+        params, _ = self._params_tuple()
+        return [np.asarray(l) for l in jax.tree_util.tree_leaves(params)]
+
+    def set_weights(self, weights: Sequence[np.ndarray]):
+        params, state = self._params_tuple()
+        treedef = jax.tree_util.tree_structure(params)
+        leaves = jax.tree_util.tree_leaves(params)
+        assert len(leaves) == len(weights), \
+            f"expected {len(leaves)} arrays, got {len(weights)}"
+        new_leaves = [jnp.asarray(w, l.dtype) if hasattr(l, "dtype")
+                      else w for w, l in zip(weights, leaves)]
+        new_params = jax.tree_util.tree_unflatten(treedef, new_leaves)
+        self._built_params = (new_params, state)
+        if self.trainer is not None:
+            self.trainer.set_params(new_params, state)
+
+    def get_params(self):
+        return self._params_tuple()[0]
+
+    # -- persistence ---------------------------------------------------
+    def save_model(self, path, weight_path=None, over_write=False):
+        """Saves architecture (pickled python description) + weights (npz).
+
+        Parity: ``KerasNet.saveModel`` (Topology.scala:109); format is
+        TPU-native (no BigDL protobuf).
+        """
+        if os.path.exists(path) and not over_write:
+            raise IOError(f"{path} exists; pass over_write=True")
+        os.makedirs(path, exist_ok=True)
+        trainer = self.trainer
+        self.trainer = None  # strip unpicklable runtime
+        tb, self._tb = self._tb, None
+        try:
+            with open(os.path.join(path, "architecture.pkl"), "wb") as f:
+                pickle.dump(self, f)
+        finally:
+            self.trainer = trainer
+            self._tb = tb
+        params, state = self._params_tuple()
+        serialization.save_pytree(
+            os.path.join(path, "weights.npz"),
+            {"params": serialization.tree_to_numpy(params),
+             "state": serialization.tree_to_numpy(state)})
+
+    saveModel = save_model
+
+    @staticmethod
+    def load_model(path, weight_path=None):
+        with open(os.path.join(path, "architecture.pkl"), "rb") as f:
+            model = pickle.load(f)
+        blob = serialization.load_pytree(os.path.join(path, "weights.npz"))
+        model._built_params = (blob["params"], blob.get("state") or {})
+        return model
+
+    # -- introspection -------------------------------------------------
+    def summary(self, line_length=100):
+        graph = self.graph_function()
+        params, state = self._params_tuple()
+        lines = [f'Model: "{self.name}"', "_" * line_length,
+                 f"{'Layer (type)':40s}{'Param #':>12s}", "=" * line_length]
+        total = 0
+        for layer in graph.layers:
+            p = params.get(layer.name, {})
+            n = sum(int(np.prod(np.shape(l)))
+                    for l in jax.tree_util.tree_leaves(p))
+            total += n
+            lines.append(f"{layer.name + ' (' + type(layer).__name__ + ')':40s}"
+                         f"{n:>12,d}")
+        lines += ["=" * line_length, f"Total params: {total:,d}"]
+        text = "\n".join(lines)
+        print(text)
+        return text
+
+
+class Model(KerasNet):
+    """Functional graph container (Topology.scala:602)."""
+
+    def __init__(self, input, output, name=None):
+        super().__init__(name=name)
+        self.inputs = [input] if isinstance(input, Variable) else list(input)
+        self.outputs = [output] if isinstance(output, Variable) \
+            else list(output)
+        self._graph = GraphFunction(self.inputs, self.outputs)
+        self.num_outputs = len(self.outputs)
+
+    def graph_function(self):
+        return self._graph
+
+    # used as a nested layer -------------------------------------------
+    def build(self, rng, input_shape):
+        params, state = self._graph.init(rng)
+        self._nested_state_template = state
+        return params
+
+    def init_state(self, input_shape):
+        return getattr(self, "_nested_state_template", {})
+
+    def call(self, params, inputs, training=False, state=None, rng=None):
+        out, new_state = self._graph.apply(
+            params, inputs, state=state, training=training, rng=rng,
+            collect_state=True)
+        return out, new_state
+
+    def compute_output_shape(self, input_shape):
+        shapes = [v.shape for v in self.outputs]
+        return shapes[0] if len(shapes) == 1 else shapes
+
+    def new_graph(self, outputs: Sequence[str]) -> "Model":
+        """Graph surgery: re-root on named layers' outputs
+        (parity: NetUtils GraphNet.newGraph)."""
+        graph = self._graph
+        by_name = {}
+        for node in graph.nodes:
+            for v in [vv for vv in _node_out_vars(node, graph)]:
+                by_name[node.layer.name] = v
+        outs = [by_name[name] for name in outputs]
+        return Model(self.inputs, outs, name=self.name + "_sub")
+
+
+def _node_out_vars(node, graph):
+    # find Variables produced by this node among graph vars
+    seen = []
+    for v in graph.outputs:
+        if v.node is node:
+            seen.append(v)
+    # also walk all node input vars
+    for n in graph.nodes:
+        for v in n.inputs:
+            if v.node is node and v not in seen:
+                seen.append(v)
+    if not seen:
+        out_shape = node.layer.compute_output_shape(
+            node.inputs[0].shape if len(node.inputs) == 1
+            else [v.shape for v in node.inputs])
+        seen.append(Variable(node, out_shape))
+    return seen
+
+
+class Sequential(KerasNet):
+    """Linear stack (Topology.scala:825)."""
+
+    def __init__(self, name=None):
+        super().__init__(name=name)
+        self.layers: List[KerasLayer] = []
+
+    def add(self, layer) -> "Sequential":
+        if not self.layers and not isinstance(layer, (Sequential, Model)):
+            if layer.input_shape is None and not isinstance(layer, InputLayer):
+                raise ValueError(
+                    "first layer needs input_shape (parity with reference "
+                    "Sequential semantics)")
+        self.layers.append(layer)
+        return self
+
+    def _input_shape(self):
+        first = self.layers[0]
+        if isinstance(first, Sequential):
+            return first._input_shape()
+        if isinstance(first, Model):
+            shapes = [v.shape for v in first.inputs]
+            return shapes[0] if len(shapes) == 1 else shapes
+        return first.input_shape
+
+    def graph_function(self):
+        in_shape = self._input_shape()
+        inp = Variable(None, in_shape, name=self.name + "_input")
+        x = inp
+        for layer in self.layers:
+            x = layer(x)
+        return GraphFunction([inp], [x])
+
+    # used as a nested layer -------------------------------------------
+    def build(self, rng, input_shape):
+        params = {}
+        shape = input_shape
+        for layer in self.layers:
+            rng, sub = jax.random.split(rng)
+            p = layer.build(sub, shape)
+            if p:
+                params[layer.name] = p
+            shape = layer.compute_output_shape(shape)
+        return params
+
+    def init_state(self, input_shape):
+        state = {}
+        shape = input_shape
+        for layer in self.layers:
+            s = layer.init_state(shape)
+            if s:
+                state[layer.name] = s
+            shape = layer.compute_output_shape(shape)
+        return state
+
+    def call(self, params, inputs, training=False, state=None, rng=None):
+        return _apply_layer_chain(self.layers, params, inputs, state,
+                                  training, rng)
+
+    def compute_output_shape(self, input_shape):
+        shape = input_shape
+        for layer in self.layers:
+            shape = layer.compute_output_shape(shape)
+        return shape
+
+    def to_model(self) -> Model:
+        """Topology.scala:914."""
+        in_shape = self._input_shape()
+        from .base import Input
+        inp = Input(shape=in_shape[1:], name=self.name + "_input")
+        x = inp
+        for layer in self.layers:
+            x = layer(x)
+        return Model(inp, x, name=self.name)

@@ -1,0 +1,15 @@
+from ..engine.base import Input, InputLayer, KerasLayer
+from .core import (AddConstant, Activation, BinaryThreshold, CAdd, CMul,
+                   Dense, Dropout, Exp, ExpandDim, Flatten, GaussianDropout,
+                   GaussianNoise, GaussianSampler, HardShrink, HardTanh,
+                   Highway, Identity, Log, Masking, Max, MaxoutDense, Mul,
+                   MulConstant, Narrow, Negative, Permute, Power,
+                   RepeatVector, Reshape, ResizeBilinear, Scale, Select,
+                   SoftShrink, SpatialDropout1D, SpatialDropout2D,
+                   SpatialDropout3D, SplitTensor, Sqrt, Square, Squeeze,
+                   Threshold)
+from .embeddings import Embedding, SparseEmbedding, WordEmbedding
+from .merge import (Add, Average, Concatenate, Maximum, Merge, Multiply,
+                    merge)
+from .normalization import (BatchNormalization, LayerNorm, LRN2D,
+                            WithinChannelLRN2D)

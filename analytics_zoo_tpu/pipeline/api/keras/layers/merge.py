@@ -1,0 +1,108 @@
+"""Merge layers (Merge.scala + the functional ``merge`` helper)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..engine.base import KerasLayer
+
+
+class Merge(KerasLayer):
+    """Merge a list of inputs: sum/mul/max/min/ave/concat/dot/cos."""
+
+    def __init__(self, layers=None, mode="sum", concat_axis=-1,
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=None, name=name)
+        self.mode = mode
+        self.concat_axis = concat_axis
+
+    def call(self, params, xs, training=False, **kw):
+        mode = self.mode
+        if mode in ("sum", "add"):
+            out = xs[0]
+            for x in xs[1:]:
+                out = out + x
+            return out
+        if mode == "mul":
+            out = xs[0]
+            for x in xs[1:]:
+                out = out * x
+            return out
+        if mode == "max":
+            out = xs[0]
+            for x in xs[1:]:
+                out = jnp.maximum(out, x)
+            return out
+        if mode == "min":
+            out = xs[0]
+            for x in xs[1:]:
+                out = jnp.minimum(out, x)
+            return out
+        if mode in ("ave", "avg", "average"):
+            return sum(xs) / float(len(xs))
+        if mode == "concat":
+            return jnp.concatenate(xs, axis=self.concat_axis)
+        if mode == "dot":
+            a = xs[0].reshape(xs[0].shape[0], -1)
+            b = xs[1].reshape(xs[1].shape[0], -1)
+            return jnp.sum(a * b, axis=-1, keepdims=True)
+        if mode == "cos":
+            a = xs[0].reshape(xs[0].shape[0], -1)
+            b = xs[1].reshape(xs[1].shape[0], -1)
+            an = a / jnp.maximum(jnp.linalg.norm(a, axis=-1, keepdims=True),
+                                 1e-12)
+            bn = b / jnp.maximum(jnp.linalg.norm(b, axis=-1, keepdims=True),
+                                 1e-12)
+            return jnp.sum(an * bn, axis=-1, keepdims=True)[:, None, :]
+        raise ValueError(f"Unknown merge mode: {self.mode}")
+
+    def compute_output_shape(self, input_shapes):
+        shapes = input_shapes
+        if self.mode == "concat":
+            axis = self.concat_axis
+            ref_shape = list(shapes[0])
+            axis = axis if axis >= 0 else len(ref_shape) + axis
+            total = 0
+            for s in shapes:
+                if s[axis] is None:
+                    total = None
+                    break
+                total += s[axis]
+            ref_shape[axis] = total
+            return tuple(ref_shape)
+        if self.mode == "dot":
+            return (shapes[0][0], 1)
+        if self.mode == "cos":
+            return (shapes[0][0], 1, 1)
+        return tuple(shapes[0])
+
+
+def merge(inputs, mode="sum", concat_axis=-1, name=None):
+    """Functional merge over Variables (pyzoo keras merge)."""
+    return Merge(mode=mode, concat_axis=concat_axis, name=name)(list(inputs))
+
+
+class Add(Merge):
+    def __init__(self, **kw):
+        super().__init__(mode="sum", **kw)
+
+
+class Multiply(Merge):
+    def __init__(self, **kw):
+        super().__init__(mode="mul", **kw)
+
+
+class Average(Merge):
+    def __init__(self, **kw):
+        super().__init__(mode="ave", **kw)
+
+
+class Maximum(Merge):
+    def __init__(self, **kw):
+        super().__init__(mode="max", **kw)
+
+
+class Concatenate(Merge):
+    def __init__(self, axis=-1, **kw):
+        super().__init__(mode="concat", concat_axis=axis, **kw)

@@ -1,0 +1,26 @@
+"""Test config: run the whole suite hermetically on a virtual 8-device CPU
+mesh so multi-chip sharding logic is exercised without TPUs (SURVEY.md §4)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_context():
+    """Reset the global ZooContext between tests."""
+    yield
+    from analytics_zoo_tpu.common import nncontext
+    nncontext.set_nncontext(None)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
