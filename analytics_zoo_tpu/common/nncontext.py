@@ -42,6 +42,9 @@ class ZooConfig:
     # host data pipeline
     prefetch_depth: int = 2
     seed: int = 42
+    # donate params/opt-state buffers into the train step (halves param
+    # memory; adds dispatch latency on some backends)
+    donate_buffers: bool = False
 
     @classmethod
     def from_env(cls, **overrides):

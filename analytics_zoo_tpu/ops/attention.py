@@ -1,0 +1,188 @@
+"""Attention ops: flash attention (Pallas/TPU) + reference jax fallback.
+
+The reference materializes full O(L^2) attention per replica inside
+``TransformerLayer.block``/``Attention`` (keras/layers/TransformerLayer.scala,
+utils/zoo Attention) — sequence length bounded by one worker's RAM
+(SURVEY.md §5.7). Here the hot path is a Pallas flash-attention kernel:
+blockwise online-softmax so the L×L score matrix never hits HBM, MXU-sized
+(128×128) tiles, f32 accumulation. ``ring`` sequence parallelism layers on
+top of this in ``parallel/ring_attention.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DEFAULT_MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
+
+
+# ---------------------------------------------------------------------------
+# Reference implementation (also the CPU / short-sequence path)
+# ---------------------------------------------------------------------------
+
+def attention_reference(q, k, v, bias=None, causal=False, sm_scale=None):
+    """q,k,v: (B, H, L, D). bias broadcastable to (B, H, Lq, Lk)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * sm_scale
+    if bias is not None:
+        logits = logits + bias.astype(logits.dtype)
+    if causal:
+        lq, lk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((lq, lk), bool), k=lk - lq)
+        logits = jnp.where(mask, logits, DEFAULT_MASK_VALUE)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash attention (forward; backward via custom_vjp recompute)
+# ---------------------------------------------------------------------------
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                      sm_scale, causal, block_q, block_k, num_k_blocks):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)           # (block_q, d)
+        k = k_ref[0].astype(jnp.float32)           # (block_k, d)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
+        m_prev = m_scr[...]
+        l_prev = l_scr[...]
+        m_cur = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        correction = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur)
+        l_cur = correction * l_prev + p.sum(axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * correction + jax.lax.dot(
+            p, v, preferred_element_type=jnp.float32)
+        m_scr[...] = m_cur
+        l_scr[...] = l_cur
+
+    if causal:
+        from jax.experimental import pallas as pl  # noqa: F811
+        # skip fully-masked k-blocks above the diagonal
+        pl.when(ki * block_k <= (qi + 1) * block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        o_ref[0] = (acc_scr[...] /
+                    jnp.maximum(l_scr[...], 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal, sm_scale, block_q=128, block_k=128):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, lq, d = q.shape
+    lk = k.shape[1]
+    block_q = min(block_q, lq)
+    block_k = min(block_k, lk)
+    num_q = pl.cdiv(lq, block_q)
+    num_k = pl.cdiv(lk, block_k)
+
+    kernel = functools.partial(
+        _flash_fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, num_k_blocks=num_k)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, num_q, num_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_attention_bhld(q, k, v, causal, sm_scale):
+    return _flash_forward(q, k, v, causal, sm_scale)
+
+
+def _flash_fwd_rule(q, k, v, causal, sm_scale):
+    o = _flash_forward(q, k, v, causal, sm_scale)
+    return o, (q, k, v)
+
+
+def _flash_bwd_rule(causal, sm_scale, res, do):
+    """Backward by recompute through the reference math (XLA fuses well and
+    this keeps the kernel surface small; a dedicated bwd kernel is an
+    optimization for a later round)."""
+    q, k, v = res
+
+    def ref(q, k, v):
+        qf = q[:, None]
+        kf = k[:, None]
+        vf = v[:, None]
+        return attention_reference(qf, kf, vf, causal=causal,
+                                   sm_scale=sm_scale)[:, 0]
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    return vjp(do)
+
+
+_flash_attention_bhld.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q, k, v, bias=None, causal=False, sm_scale=None,
+                    block_q=128, block_k=128):
+    """q,k,v: (B, H, L, D) -> (B, H, L, D).
+
+    Uses the Pallas kernel on TPU for bias-free long sequences; falls back to
+    the fused-XLA reference path otherwise (bias support in the kernel comes
+    with the ring-attention work).
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    on_tpu = jax.default_backend() == "tpu"
+    lq, lk, d = q.shape[2], k.shape[2], q.shape[3]
+    # d=64 (the common head dim) is allowed: Mosaic pads the lane dim.
+    use_kernel = (on_tpu and bias is None and lq >= 128 and lk >= 128 and
+                  lq % block_q == 0 and lk % block_k == 0 and
+                  d % 64 == 0)
+    if not use_kernel:
+        return attention_reference(q, k, v, bias=bias, causal=causal,
+                                   sm_scale=sm_scale)
+    b, h = q.shape[0], q.shape[1]
+    qf = q.reshape(b * h, lq, d)
+    kf = k.reshape(b * h, lk, d)
+    vf = v.reshape(b * h, lk, d)
+    o = _flash_attention_bhld(qf, kf, vf, causal, sm_scale)
+    return o.reshape(b, h, lq, d)

@@ -218,7 +218,9 @@ ACTIVATIONS = {
     "relu6": jax.nn.relu6,
     "tanh": jnp.tanh,
     "sigmoid": jax.nn.sigmoid,
-    "hard_sigmoid": jax.nn.hard_sigmoid,
+    # Keras-1/BigDL hard_sigmoid is clip(0.2x+0.5, 0, 1); jax.nn.hard_sigmoid
+    # is the slope-1/6 variant — use the parity definition.
+    "hard_sigmoid": lambda x: jnp.clip(0.2 * x + 0.5, 0.0, 1.0),
     "softmax": jax.nn.softmax,
     "softplus": jax.nn.softplus,
     "softsign": jax.nn.soft_sign,

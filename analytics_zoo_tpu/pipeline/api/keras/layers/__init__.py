@@ -13,3 +13,25 @@ from .merge import (Add, Average, Concatenate, Maximum, Merge, Multiply,
                     merge)
 from .normalization import (BatchNormalization, LayerNorm, LRN2D,
                             WithinChannelLRN2D)
+from .convolutional import (AtrousConvolution1D, AtrousConvolution2D,
+                            Convolution1D, Convolution2D, Convolution3D,
+                            Cropping1D, Cropping2D, Cropping3D,
+                            Deconvolution2D, LocallyConnected1D,
+                            LocallyConnected2D, SeparableConvolution2D,
+                            ShareConvolution2D, UpSampling1D, UpSampling2D,
+                            UpSampling3D, ZeroPadding1D, ZeroPadding2D,
+                            ZeroPadding3D)
+from .pooling import (AveragePooling1D, AveragePooling2D, AveragePooling3D,
+                      GlobalAveragePooling1D, GlobalAveragePooling2D,
+                      GlobalAveragePooling3D, GlobalMaxPooling1D,
+                      GlobalMaxPooling2D, GlobalMaxPooling3D, MaxPooling1D,
+                      MaxPooling2D, MaxPooling3D)
+from .recurrent import (GRU, LSTM, ConvLSTM2D, ConvLSTM3D, SimpleRNN)
+from .wrappers import Bidirectional, KerasLayerWrapper, TimeDistributed
+from .advanced_activations import (ELU, LeakyReLU, PReLU, RReLU, Softmax,
+                                   SReLU, ThresholdedReLU)
+
+# Convenience aliases matching Keras-2-style names used around the reference
+Conv1D = Convolution1D
+Conv2D = Convolution2D
+Conv3D = Convolution3D

@@ -1,0 +1,278 @@
+"""TransformerLayer and BERT.
+
+Parity surface: ``keras/layers/TransformerLayer.scala`` (279 LoC; GPT-style
+decoder blocks, post-LN, gelu, optional bidirectional) and
+``keras/layers/BERT.scala`` (402 LoC; 4 inputs — token ids, positions,
+segment ids, attention mask; outputs per-block sequence states + pooled
+output; erf-based gelu; extended mask = (1-mask)*-10000).
+
+TPU redesign: one KerasLayer owning all block params (pytree), attention via
+the Pallas flash kernel (ops/attention.py), dropout fused in-jit, params
+annotated with logical axes so ``parallel.sharding`` can lay them out over a
+('data','model') mesh (qkv/mlp-in column-parallel, proj/mlp-out row-parallel
+— Megatron layout, collectives inserted by XLA).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .....ops.attention import flash_attention
+from ..engine.base import KerasLayer, init_tensor
+
+
+def _normal(rng, shape, std):
+    return std * jax.random.normal(rng, shape, jnp.float32)
+
+
+def _dropout(x, p, rng, training):
+    if not training or rng is None or p <= 0.0:
+        return x
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+class TransformerLayer(KerasLayer):
+    """GPT-style transformer stack.
+
+    Inputs: token ids ``(B, L)`` (positions are implicit arange, parity with
+    the reference's position-offset embedding). Outputs
+    ``[sequence_states, pooled]`` (or all block states + pooled when
+    ``output_all_block``).
+    """
+
+    stochastic = True
+    gelu_approximate = True  # TransformerLayer.scala uses the tanh approx
+
+    def __init__(self, n_block, hidden_p_drop=0.1, attn_p_drop=0.1,
+                 n_head=12, initializer_range=0.02, bidirectional=False,
+                 output_all_block=False, intermediate_size=0,
+                 vocab=40990, seq_len=77, hidden_size=768,
+                 embedding_layer=None, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.n_block = int(n_block)
+        self.n_head = int(n_head)
+        self.hidden_p_drop = hidden_p_drop
+        self.attn_p_drop = attn_p_drop
+        self.initializer_range = initializer_range
+        self.bidirectional = bidirectional
+        self.output_all_block = output_all_block
+        self.vocab = int(vocab)
+        self.seq_len = int(seq_len)
+        self.hidden_size = int(hidden_size)
+        self.embedding_layer = embedding_layer
+        if embedding_layer is not None:
+            # custom embedding (reference API): hidden size comes from its
+            # output shape; it consumes the non-mask inputs
+            out_shape = embedding_layer.compute_output_shape(
+                (None, self.seq_len))
+            self.hidden_size = int(out_shape[-1])
+        self.intermediate_size = int(intermediate_size) or \
+            4 * self.hidden_size
+        assert self.hidden_size % self.n_head == 0
+        self.num_outputs = (self.n_block if output_all_block else 1) + 1
+
+    # -- params --------------------------------------------------------
+    def _embedding_params(self, rng):
+        if self.embedding_layer is not None:
+            return {"embedding": self.embedding_layer.build(
+                rng, (None, self.seq_len))}
+        r1, r2 = jax.random.split(rng)
+        params = {
+            "tok_emb": _normal(r1, (self.vocab, self.hidden_size),
+                               self.initializer_range),
+            "pos_emb": _normal(r2, (self.seq_len, self.hidden_size),
+                               self.initializer_range),
+        }
+        self._annotate(tok_emb=("vocab", "embed"),
+                       pos_emb=(None, "embed"))
+        return params
+
+    def _block_params(self, rng, i):
+        h = self.hidden_size
+        m = self.intermediate_size
+        keys = jax.random.split(rng, 4)
+        std = self.initializer_range
+        p = {
+            "qkv_w": _normal(keys[0], (h, 3 * h), std),
+            "qkv_b": jnp.zeros((3 * h,)),
+            "proj_w": _normal(keys[1], (h, h), std),
+            "proj_b": jnp.zeros((h,)),
+            "ln1_g": jnp.ones((h,)), "ln1_b": jnp.zeros((h,)),
+            "mlp_in_w": _normal(keys[2], (h, m), std),
+            "mlp_in_b": jnp.zeros((m,)),
+            "mlp_out_w": _normal(keys[3], (m, h), std),
+            "mlp_out_b": jnp.zeros((h,)),
+            "ln2_g": jnp.ones((h,)), "ln2_b": jnp.zeros((h,)),
+        }
+        self._annotate(**{
+            f"block{i}/qkv_w": ("embed", "heads"),
+            f"block{i}/qkv_b": ("heads",),
+            f"block{i}/proj_w": ("heads", "embed"),
+            f"block{i}/mlp_in_w": ("embed", "mlp"),
+            f"block{i}/mlp_in_b": ("mlp",),
+            f"block{i}/mlp_out_w": ("mlp", "embed"),
+        })
+        return p
+
+    def build(self, rng, input_shape):
+        rngs = jax.random.split(rng, self.n_block + 2)
+        params = self._embedding_params(rngs[0])
+        for i in range(self.n_block):
+            params[f"block{i}"] = self._block_params(rngs[i + 1], i)
+        params["pooler_w"] = _normal(rngs[-1],
+                                     (self.hidden_size, self.hidden_size),
+                                     self.initializer_range)
+        params["pooler_b"] = jnp.zeros((self.hidden_size,))
+        return params
+
+    # -- compute -------------------------------------------------------
+    def _ln(self, x, g, b, eps=1e-5):
+        xf = x.astype(jnp.float32)
+        mu = xf.mean(-1, keepdims=True)
+        var = jnp.square(xf - mu).mean(-1, keepdims=True)
+        return ((xf - mu) * jax.lax.rsqrt(var + eps) * g + b).astype(x.dtype)
+
+    def _gelu(self, x):
+        return jax.nn.gelu(x, approximate=self.gelu_approximate)
+
+    def _attention(self, p, x, mask_bias, rng, training):
+        b, l, h = x.shape
+        nh = self.n_head
+        d = h // nh
+        qkv = jnp.matmul(x, p["qkv_w"].astype(x.dtype)) + \
+            p["qkv_b"].astype(x.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(b, l, nh, d).transpose(0, 2, 1, 3)
+
+        o = flash_attention(heads(q), heads(k), heads(v), bias=mask_bias,
+                            causal=not self.bidirectional)
+        o = o.transpose(0, 2, 1, 3).reshape(b, l, h)
+        if rng is not None:
+            rng, sub = jax.random.split(rng)
+            o = _dropout(o, self.attn_p_drop, sub, training)
+        o = jnp.matmul(o, p["proj_w"].astype(x.dtype)) + \
+            p["proj_b"].astype(x.dtype)
+        return o
+
+    def _block(self, p, x, mask_bias, rng, training):
+        r1 = r2 = r3 = None
+        if rng is not None:
+            r1, r2, r3 = jax.random.split(rng, 3)
+        a = self._attention(p, x, mask_bias, r1, training)
+        a = _dropout(a, self.hidden_p_drop, r2, training)
+        n = self._ln(x + a, p["ln1_g"], p["ln1_b"])
+        m = jnp.matmul(n, p["mlp_in_w"].astype(x.dtype)) + \
+            p["mlp_in_b"].astype(x.dtype)
+        m = self._gelu(m)
+        m = jnp.matmul(m, p["mlp_out_w"].astype(x.dtype)) + \
+            p["mlp_out_b"].astype(x.dtype)
+        m = _dropout(m, self.hidden_p_drop, r3, training)
+        return self._ln(n + m, p["ln2_g"], p["ln2_b"])
+
+    def _embed(self, params, inputs, rng, training):
+        if self.embedding_layer is not None:
+            x = inputs if not isinstance(inputs, (list, tuple)) or \
+                len(inputs) > 1 else inputs[0]
+            e = self.embedding_layer.call(params["embedding"], x,
+                                          training=training)
+            return e, None
+        tokens = (inputs[0] if isinstance(inputs, (list, tuple))
+                  else inputs).astype(jnp.int32)
+        e = jnp.take(params["tok_emb"], tokens, axis=0)
+        e = e + params["pos_emb"][None, :e.shape[1]]
+        return e, None
+
+    def _pooler(self, params, x):
+        first = x[:, 0]
+        return jnp.tanh(jnp.matmul(first, params["pooler_w"]
+                                   .astype(x.dtype)) +
+                        params["pooler_b"].astype(x.dtype))
+
+    def call(self, params, inputs, training=False, rng=None, **kw):
+        e, mask_bias = self._embed(params, inputs, rng, training)
+        if rng is not None:
+            rng, sub = jax.random.split(rng)
+            e = _dropout(e, self.hidden_p_drop, sub, training)
+        states = []
+        x = e
+        for i in range(self.n_block):
+            block_rng = None
+            if rng is not None:
+                rng, block_rng = jax.random.split(rng)
+            x = self._block(params[f"block{i}"], x, mask_bias, block_rng,
+                            training)
+            states.append(x)
+        pooled = self._pooler(params, x)
+        if self.output_all_block:
+            return tuple(states) + (pooled,)
+        return (x, pooled)
+
+    def compute_output_shape(self, input_shape):
+        first = input_shape[0] if isinstance(input_shape, list) \
+            else input_shape
+        seq_shape = (first[0], first[1], self.hidden_size)
+        pooled = (first[0], self.hidden_size)
+        if self.output_all_block:
+            return [seq_shape] * self.n_block + [pooled]
+        return [seq_shape, pooled]
+
+
+class BERT(TransformerLayer):
+    """BERT encoder (BERT.scala). Inputs: ``[token_ids (B,L),
+    position_ids (B,L), segment_ids (B,L), attention_mask (B,1,1,L)]``."""
+
+    gelu_approximate = False  # BERT.scala overrides gelu with the erf form
+
+    def __init__(self, vocab=40990, hidden_size=768, n_block=12, n_head=12,
+                 seq_len=512, intermediate_size=3072, hidden_p_drop=0.1,
+                 attn_p_drop=0.1, initializer_range=0.02,
+                 output_all_block=True, input_shape=None, name=None,
+                 **kwargs):
+        super().__init__(
+            n_block=n_block, hidden_p_drop=hidden_p_drop,
+            attn_p_drop=attn_p_drop, n_head=n_head,
+            initializer_range=initializer_range, bidirectional=True,
+            output_all_block=output_all_block,
+            intermediate_size=intermediate_size, vocab=vocab,
+            seq_len=seq_len, hidden_size=hidden_size,
+            input_shape=input_shape, name=name)
+
+    def _embedding_params(self, rng):
+        params = super()._embedding_params(rng)
+        r = jax.random.fold_in(rng, 7)
+        params["seg_emb"] = _normal(r, (2, self.hidden_size),
+                                    self.initializer_range)
+        params["emb_ln_g"] = jnp.ones((self.hidden_size,))
+        params["emb_ln_b"] = jnp.zeros((self.hidden_size,))
+        return params
+
+    def _embed(self, params, inputs, rng, training):
+        tokens, positions, segments, mask = inputs
+        tokens = tokens.astype(jnp.int32)
+        positions = positions.astype(jnp.int32)
+        segments = segments.astype(jnp.int32)
+        e = jnp.take(params["tok_emb"], tokens, axis=0)
+        e = e + jnp.take(params["pos_emb"], positions, axis=0)
+        e = e + jnp.take(params["seg_emb"], segments, axis=0)
+        e = self._ln(e, params["emb_ln_g"], params["emb_ln_b"], eps=1e-12)
+        # extended mask, parity with BERT.scala buildInput:
+        # (-mask + 1) * -10000
+        mask_bias = (1.0 - mask.astype(jnp.float32)) * -10000.0
+        return e, mask_bias
+
+    def compute_output_shape(self, input_shape):
+        first = input_shape[0]
+        seq_shape = (first[0], first[1], self.hidden_size)
+        pooled = (first[0], self.hidden_size)
+        if self.output_all_block:
+            return [seq_shape] * self.n_block + [pooled]
+        return [seq_shape, pooled]

@@ -179,7 +179,13 @@ class SPMDTrainer:
                     "grad_norm": optax.global_norm(grads)}
             return params, opt_state, new_state, logs
 
-        self._train_step = jax.jit(step_fn, donate_argnums=(0, 1, 2))
+        # Buffer donation halves peak param memory but costs ~30ms/step of
+        # dispatch latency on the axon TPU backend (measured); keep it opt-in
+        # for models whose params actually pressure HBM.
+        if self.ctx.config.donate_buffers:
+            self._train_step = jax.jit(step_fn, donate_argnums=(0, 1, 2))
+        else:
+            self._train_step = jax.jit(step_fn)
         return self._train_step
 
     def build_eval_step(self):

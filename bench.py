@@ -1,0 +1,123 @@
+"""Benchmark: NeuralCF on synthetic MovieLens-1M-shaped data.
+
+North-star config from BASELINE.md: "NCF recommender / MovieLens-1M
+(zoo.models.recommendation via NNEstimator) — steps/sec". The reference
+trains this on CPU clusters via BigDL/MKL (no published absolute numbers,
+BASELINE.json published={}); as a live baseline proxy we time an identical
+NCF train step in torch on this host's CPU — the same engine family the
+reference runs on — and report vs_baseline = tpu/cpu steps-per-sec.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# MovieLens-1M shape (users/items from the dataset; reference example uses
+# explicit ratings 1-5 as 5 classes)
+N_USERS, N_ITEMS, N_CLASSES = 6040, 3706, 5
+USER_EMBED = ITEM_EMBED = MF_EMBED = 20
+HIDDEN = [40, 20, 10]
+BATCH = 8192
+N_SAMPLES = 262144
+TIMED_EPOCHS = 2
+
+
+def make_data(seed=0):
+    rng = np.random.default_rng(seed)
+    x = np.stack([rng.integers(1, N_USERS + 1, N_SAMPLES),
+                  rng.integers(1, N_ITEMS + 1, N_SAMPLES)],
+                 axis=1).astype(np.float32)
+    y = rng.integers(0, N_CLASSES, N_SAMPLES).astype(np.int32)
+    return x, y
+
+
+def bench_tpu(x, y):
+    from analytics_zoo_tpu.models.recommendation import NeuralCF
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+
+    ncf = NeuralCF(N_USERS, N_ITEMS, N_CLASSES, user_embed=USER_EMBED,
+                   item_embed=ITEM_EMBED, hidden_layers=HIDDEN,
+                   include_mf=True, mf_embed=MF_EMBED)
+    ncf.compile(optimizer=Adam(lr=1e-3),
+                loss="sparse_categorical_crossentropy")
+    # warmup epoch: compile + cache
+    ncf.fit(x, y, batch_size=BATCH, nb_epoch=1)
+    steps_per_epoch = N_SAMPLES // BATCH
+    t0 = time.perf_counter()
+    ncf.fit(x, y, batch_size=BATCH, nb_epoch=TIMED_EPOCHS)
+    # force completion of the last async step
+    _ = np.asarray(ncf.model.get_weights()[0])
+    dt = time.perf_counter() - t0
+    steps = steps_per_epoch * TIMED_EPOCHS
+    return steps / dt
+
+
+def bench_torch_cpu(x, y, n_steps=12):
+    import torch
+    import torch.nn as nn
+
+    torch.set_num_threads(os.cpu_count() or 8)
+
+    class TorchNCF(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.ue = nn.Embedding(N_USERS + 1, USER_EMBED)
+            self.ie = nn.Embedding(N_ITEMS + 1, ITEM_EMBED)
+            self.umf = nn.Embedding(N_USERS + 1, MF_EMBED)
+            self.imf = nn.Embedding(N_ITEMS + 1, MF_EMBED)
+            dims = [USER_EMBED + ITEM_EMBED] + HIDDEN
+            self.mlp = nn.Sequential(*[
+                layer for i in range(len(HIDDEN))
+                for layer in (nn.Linear(dims[i], dims[i + 1]), nn.ReLU())])
+            self.head = nn.Linear(HIDDEN[-1] + MF_EMBED, N_CLASSES)
+
+        def forward(self, users, items):
+            mlp = self.mlp(torch.cat([self.ue(users), self.ie(items)], -1))
+            mf = self.umf(users) * self.imf(items)
+            return self.head(torch.cat([mlp, mf], -1))
+
+    model = TorchNCF()
+    opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+    loss_fn = nn.CrossEntropyLoss()
+    users = torch.from_numpy(x[:BATCH * (n_steps + 2), 0].astype(np.int64))
+    items = torch.from_numpy(x[:BATCH * (n_steps + 2), 1].astype(np.int64))
+    labels = torch.from_numpy(y[:BATCH * (n_steps + 2)].astype(np.int64))
+
+    def step(i):
+        s = slice(i * BATCH, (i + 1) * BATCH)
+        opt.zero_grad()
+        loss = loss_fn(model(users[s], items[s]), labels[s])
+        loss.backward()
+        opt.step()
+
+    step(0)
+    step(1)  # warmup
+    t0 = time.perf_counter()
+    for i in range(2, n_steps + 2):
+        step(i)
+    return n_steps / (time.perf_counter() - t0)
+
+
+def main():
+    x, y = make_data()
+    tpu_sps = bench_tpu(x, y)
+    try:
+        cpu_sps = bench_torch_cpu(x, y)
+        vs = tpu_sps / cpu_sps
+    except Exception as e:  # torch missing/broken: report raw number
+        print(f"# torch baseline failed: {e}", file=sys.stderr)
+        cpu_sps, vs = None, None
+    result = {"metric": "ncf_movielens_train_steps_per_sec",
+              "value": round(tpu_sps, 2),
+              "unit": "steps/sec (batch=8192)",
+              "vs_baseline": round(vs, 2) if vs is not None else None}
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -9,6 +9,13 @@ if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
+import jax  # noqa: E402
+
+# The env var alone is not honored when a TPU plugin (axon) is present —
+# the config update is; without it the whole suite silently runs on the TPU.
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu", jax.default_backend()
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

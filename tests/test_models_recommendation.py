@@ -1,0 +1,117 @@
+"""Model-zoo tests: NeuralCF / WideAndDeep / SessionRecommender."""
+
+import numpy as np
+
+from analytics_zoo_tpu.feature.feature_set import Sample
+from analytics_zoo_tpu.models.recommendation import (
+    ColumnFeatureInfo, NeuralCF, SessionRecommender, UserItemFeature,
+    WideAndDeep)
+from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+
+
+def _ncf_data(n=512, users=30, items=20, seed=0):
+    rng = np.random.default_rng(seed)
+    x = np.stack([rng.integers(1, users + 1, n),
+                  rng.integers(1, items + 1, n)], 1).astype(np.float32)
+    y = ((x[:, 0] + x[:, 1]) % 2).astype(np.int32)
+    return x, y
+
+
+def test_neuralcf_train_and_recommend():
+    users, items = 30, 20
+    x, y = _ncf_data(users=users, items=items)
+    ncf = NeuralCF(user_count=users, item_count=items, class_num=2,
+                   user_embed=8, item_embed=8, hidden_layers=[16, 8],
+                   mf_embed=8)
+    ncf.compile(optimizer=Adam(lr=0.01),
+                loss="sparse_categorical_crossentropy",
+                metrics=["accuracy"])
+    ncf.fit(x, y, batch_size=64, nb_epoch=12)
+    res = ncf.evaluate(x, y, batch_size=64)
+    assert res["accuracy"] > 0.8, res
+
+    features = [UserItemFeature(int(u), int(i),
+                                Sample(np.array([u, i], np.float32)))
+                for u, i in x[:64]]
+    pairs = ncf.predict_user_item_pair(features)
+    assert len(pairs) == 64
+    assert all(p.prediction in (1, 2) for p in pairs)
+    recs = ncf.recommend_for_user(features, 3)
+    by_user = {}
+    for r in recs:
+        by_user.setdefault(r.user_id, []).append(r.probability)
+    for probs in by_user.values():
+        assert len(probs) <= 3
+        assert probs == sorted(probs, reverse=True)
+
+
+def test_neuralcf_save_load(tmp_path):
+    x, y = _ncf_data(128)
+    ncf = NeuralCF(30, 20, 2, user_embed=4, item_embed=4,
+                   hidden_layers=[8], mf_embed=4)
+    ncf.compile("adam", "sparse_categorical_crossentropy")
+    ncf.fit(x, y, batch_size=32, nb_epoch=1)
+    p1 = ncf.predict(x[:32])
+    path = str(tmp_path / "ncf")
+    ncf.save_model(path, over_write=True)
+    from analytics_zoo_tpu.models.common import ZooModel
+    loaded = ZooModel.load_model(path)
+    assert isinstance(loaded, NeuralCF)
+    assert loaded.user_count == 30
+    p2 = loaded.predict(x[:32])
+    np.testing.assert_allclose(p1, p2, rtol=1e-5, atol=1e-6)
+
+
+def test_wide_and_deep_variants():
+    rng = np.random.default_rng(1)
+    n = 256
+    ci = ColumnFeatureInfo(
+        wide_base_cols=["a", "b"], wide_base_dims=[5, 7],
+        wide_cross_cols=["ab"], wide_cross_dims=[10],
+        indicator_cols=["c"], indicator_dims=[4],
+        embed_cols=["u", "v"], embed_in_dims=[20, 30],
+        embed_out_dims=[8, 8],
+        continuous_cols=["age"])
+    wide = rng.random((n, 5 + 7 + 10)).astype(np.float32)
+    ind = (rng.random((n, 4)) > 0.5).astype(np.float32)
+    emb = np.stack([rng.integers(1, 20, n), rng.integers(1, 30, n)],
+                   1).astype(np.float32)
+    cont = rng.random((n, 1)).astype(np.float32)
+    y = (wide.sum(-1) + cont[:, 0] > wide.sum(-1).mean() +
+         0.5).astype(np.int32)
+
+    for model_type, inputs in [("wide", wide),
+                               ("deep", [ind, emb, cont]),
+                               ("wide_n_deep", [wide, ind, emb, cont])]:
+        wnd = WideAndDeep(2, ci, model_type=model_type,
+                          hidden_layers=[16, 8])
+        wnd.compile(optimizer=Adam(lr=0.01),
+                    loss="sparse_categorical_crossentropy",
+                    metrics=["accuracy"])
+        wnd.fit(inputs, y, batch_size=64, nb_epoch=3)
+        probs = wnd.predict(inputs)
+        assert probs.shape == (n, 2)
+        np.testing.assert_allclose(probs.sum(-1), 1.0, rtol=1e-4)
+
+
+def test_session_recommender():
+    rng = np.random.default_rng(2)
+    n, items, sess_len, hist_len = 256, 15, 5, 4
+    sess = rng.integers(1, items + 1, (n, sess_len)).astype(np.float32)
+    hist = rng.integers(1, items + 1, (n, hist_len)).astype(np.float32)
+    y = (sess[:, -1] - 1).astype(np.int32)  # predict last clicked item
+
+    sr = SessionRecommender(items, 8, rnn_hidden_layers=[16, 8],
+                            session_length=sess_len, include_history=True,
+                            mlp_hidden_layers=[16], history_length=hist_len)
+    sr.compile(optimizer=Adam(lr=0.01),
+               loss="sparse_categorical_crossentropy")
+    sr.fit([sess, hist], y, batch_size=64, nb_epoch=3)
+    recs = sr.recommend_for_session(
+        [Sample([s, h]) for s, h in zip(sess[:8], hist[:8])], 3,
+        zero_based_label=True)
+    assert len(recs) == 8
+    for row in recs:
+        assert len(row) == 3
+        probs = [p for _, p in row]
+        assert probs == sorted(probs, reverse=True)

@@ -1,0 +1,117 @@
+"""Sharding / ring-attention / flash-attention tests on the 8-device CPU
+mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from analytics_zoo_tpu.ops.attention import (attention_reference,
+                                             flash_attention)
+from analytics_zoo_tpu.parallel import (make_mesh, make_param_sharding_fn,
+                                        ring_attention_sharded)
+
+
+def _qkv(b=2, h=4, l=64, d=16, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: rng.standard_normal((b, h, l, d)).astype(np.float32)
+    return jnp.asarray(mk()), jnp.asarray(mk()), jnp.asarray(mk())
+
+
+def test_ring_attention_matches_reference():
+    mesh = make_mesh(data=1, seq=8)
+    q, k, v = _qkv()
+    ref = attention_reference(q, k, v)
+    out = ring_attention_sharded(q, k, v, mesh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ring_attention_causal_matches_reference():
+    mesh = make_mesh(data=1, seq=8)
+    q, k, v = _qkv(seed=1)
+    ref = attention_reference(q, k, v, causal=True)
+    out = ring_attention_sharded(q, k, v, mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_flash_attention_fallback_matches_reference():
+    # On CPU the wrapper falls back to reference; verify mask/bias path.
+    q, k, v = _qkv(seed=2)
+    bias = jnp.zeros((2, 1, 1, 64)).at[:, :, :, 32:].set(-10000.0)
+    out = flash_attention(q, k, v, bias=bias)
+    ref = attention_reference(q, k, v, bias=bias)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_transformer_tp_sharding_and_forward():
+    """TransformerLayer forward under a (data=2, model=4) mesh with real
+    Megatron-style param shardings; validates the tp layout compiles and
+    matches the replicated result."""
+    from analytics_zoo_tpu.pipeline.api.keras.layers.self_attention import \
+        TransformerLayer
+
+    mesh = make_mesh(data=2, model=4)
+    layer = TransformerLayer(n_block=2, n_head=4, vocab=100, seq_len=16,
+                             hidden_size=32, output_all_block=False)
+    rng = jax.random.PRNGKey(0)
+    params = layer.build(rng, (None, 16))
+
+    # build shardings from annotations via a fake single-layer graph
+    class G:
+        layers = [layer]
+
+    fn = make_param_sharding_fn(G, mesh)
+    shardings = fn({layer.name: params})[layer.name]
+    sharded = jax.device_put(params, shardings)
+    # qkv kernel must actually be sharded over 'model'
+    qkv_sh = shardings["block0"]["qkv_w"]
+    assert qkv_sh.spec == P("embed" and None, "model") or \
+        qkv_sh.spec == P(None, "model"), qkv_sh.spec
+
+    tokens = jnp.asarray(
+        np.random.default_rng(3).integers(0, 100, (8, 16)))
+    tokens = jax.device_put(tokens, NamedSharding(mesh, P("data")))
+
+    seq_out, pooled = jax.jit(
+        lambda p, t: layer.call(p, t, training=False))(sharded, tokens)
+    assert seq_out.shape == (8, 16, 32)
+    assert pooled.shape == (8, 32)
+
+    ref_seq, ref_pooled = layer.call(params, np.asarray(tokens),
+                                     training=False)
+    np.testing.assert_allclose(np.asarray(pooled), np.asarray(ref_pooled),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_bert_forward_shapes():
+    from analytics_zoo_tpu.pipeline.api.keras.layers.self_attention import \
+        BERT
+
+    layer = BERT(vocab=50, hidden_size=16, n_block=2, n_head=2, seq_len=12,
+                 intermediate_size=32, output_all_block=True)
+    rng = jax.random.PRNGKey(0)
+    params = layer.build(rng, [(None, 12)] * 4)
+    b, l = 3, 12
+    tokens = np.random.default_rng(0).integers(0, 50, (b, l))
+    positions = np.tile(np.arange(l), (b, 1))
+    segments = np.zeros((b, l), np.int32)
+    mask = np.ones((b, 1, 1, l), np.float32)
+    outs = layer.call(params, [tokens, positions, segments, mask])
+    assert len(outs) == 3  # 2 blocks + pooled
+    assert outs[0].shape == (b, l, 16)
+    assert outs[-1].shape == (b, 16)
+
+    # masked positions must not affect unmasked outputs
+    mask2 = mask.copy()
+    mask2[:, :, :, 6:] = 0.0
+    out_masked = layer.call(params, [tokens, positions, segments, mask2])
+    tokens2 = tokens.copy()
+    tokens2[:, 6:] = 1  # change masked-out tokens
+    out_masked2 = layer.call(params, [tokens2, positions, segments, mask2])
+    np.testing.assert_allclose(np.asarray(out_masked[0][:, :6]),
+                               np.asarray(out_masked2[0][:, :6]),
+                               rtol=1e-4, atol=1e-4)
