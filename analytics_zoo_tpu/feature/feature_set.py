@@ -181,6 +181,33 @@ class GeneratorFeatureSet(FeatureSet):
                                else len(buf_x), pad=pad_remainder)
 
 
+def pad_minibatch(batch: MiniBatch, target: int) -> MiniBatch:
+    """Pad a MiniBatch to ``target`` samples by repeating the last sample
+    with zero weight. Loss/metrics are weight-aware so the padding does not
+    bias them; note BatchNorm running stats are NOT weight-aware — training
+    batch sizes should be a multiple of the data-parallel size to avoid
+    padded samples entering normalization statistics."""
+    n = len(batch.weights) if batch.weights is not None else \
+        len(batch.inputs[0])
+    if target <= n:
+        return batch
+    reps = target - n
+
+    def pad(a):
+        a = np.asarray(a)
+        return np.concatenate([a, np.repeat(a[-1:], reps, 0)])
+
+    xs = tuple(pad(x) for x in batch.inputs)
+    ys = batch.targets
+    if ys is not None:
+        ys = [pad(y) for y in ys] if isinstance(ys, (list, tuple)) \
+            else pad(ys)
+    w = batch.weights if batch.weights is not None else \
+        np.ones(n, np.float32)
+    w = np.concatenate([np.asarray(w), np.zeros(reps, np.float32)])
+    return MiniBatch(xs, ys, w)
+
+
 def _stack_batch(buf_x, buf_y, batch_size, pad=False):
     n = len(buf_x)
     multi = isinstance(buf_x[0], (list, tuple))
@@ -192,14 +219,10 @@ def _stack_batch(buf_x, buf_y, batch_size, pad=False):
     ys = None
     if buf_y[0] is not None:
         ys = np.stack(buf_y)
-    w = np.ones(n, np.float32)
+    batch = MiniBatch(xs, ys, np.ones(n, np.float32))
     if pad and n < batch_size:
-        reps = batch_size - n
-        xs = tuple(np.concatenate([x, np.repeat(x[-1:], reps, 0)]) for x in xs)
-        if ys is not None:
-            ys = np.concatenate([ys, np.repeat(ys[-1:], reps, 0)])
-        w = np.concatenate([w, np.zeros(reps, np.float32)])
-    return MiniBatch(xs, ys, w)
+        batch = pad_minibatch(batch, batch_size)
+    return batch
 
 
 class TransformedFeatureSet(FeatureSet):

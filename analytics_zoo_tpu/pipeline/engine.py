@@ -30,6 +30,7 @@ from ..common.nncontext import ZooContext, get_nncontext
 from ..common.zoo_trigger import (EveryEpoch, MaxEpoch, TrainRecord,
                                   ZooTrigger)
 from ..feature.feature_set import (ArrayFeatureSet, FeatureSet, MiniBatch,
+                                   pad_minibatch,
                                    PrefetchIterator)
 from ..utils import serialization
 
@@ -226,9 +227,24 @@ class SPMDTrainer:
     # ------------------------------------------------------------------
     def _put_batch(self, batch: MiniBatch):
         sh = self.ctx.batch_sharding()
+        batch = self._pad_to_dp_multiple(batch)
         return jax.tree.map(
             lambda leaf: jax.device_put(leaf, sh) if leaf is not None else
             None, tuple(batch), is_leaf=lambda x: x is None)
+
+    def _pad_to_dp_multiple(self, batch: MiniBatch) -> MiniBatch:
+        """Batch-dim sharding needs len % dp == 0. Steady-state training
+        batches (batch_size % dp == 0) take the early-return; otherwise pad
+        with zero-weight repeats (see feature_set.pad_minibatch caveats)."""
+        dp = int(np.prod([self.ctx.mesh.shape[a]
+                          for a in ("data", "pipe", "seq", "expert")
+                          if a in self.ctx.mesh.shape]))
+        n = len(batch.weights) if batch.weights is not None else \
+            len(batch.inputs[0])
+        target = -(-n // dp) * dp
+        if target == n:
+            return batch
+        return pad_minibatch(batch, target)
 
     # ------------------------------------------------------------------
     # train / evaluate / predict loops

@@ -1,0 +1,167 @@
+"""Model-zoo tests: TextClassifier / AnomalyDetector / KNRM / Seq2seq.
+
+Mirrors the reference test strategy (SURVEY.md §4): train-to-signal on tiny
+synthetic data + save/load round-trips."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.models.anomalydetection import AnomalyDetector
+from analytics_zoo_tpu.models.common import ZooModel
+from analytics_zoo_tpu.models.seq2seq import (Bridge, RNNDecoder, RNNEncoder,
+                                              Seq2seq)
+from analytics_zoo_tpu.models.textclassification import TextClassifier
+from analytics_zoo_tpu.models.textmatching import KNRM
+
+
+def test_text_classifier_cnn_trains():
+    vocab, seq_len, classes = 50, 20, 3
+    table = np.random.default_rng(0).standard_normal((vocab, 16)) * 0.1
+    clf = TextClassifier(classes, table.astype(np.float32),
+                         sequence_length=seq_len, encoder="cnn",
+                         encoder_output_dim=32)
+    rng = np.random.default_rng(1)
+    # class k = sequences dominated by tokens from band k
+    y = rng.integers(0, classes, 256).astype(np.int32)
+    x = np.stack([rng.integers(k * vocab // classes,
+                               (k + 1) * vocab // classes, seq_len)
+                  for k in y]).astype(np.float32)
+    clf.compile("adam", "sparse_categorical_crossentropy", ["accuracy"])
+    clf.fit(x, y, batch_size=64, nb_epoch=8)
+    assert clf.evaluate(x, y, batch_size=64)["accuracy"] > 0.85
+
+
+@pytest.mark.parametrize("encoder", ["lstm", "gru"])
+def test_text_classifier_rnn_builds(encoder):
+    clf = TextClassifier(2, 8, sequence_length=6, encoder=encoder,
+                         encoder_output_dim=12)
+    x = np.random.default_rng(0).standard_normal((4, 6, 8)).astype(np.float32)
+    out = clf.predict(x, batch_size=4)
+    assert out.shape == (4, 2)
+    np.testing.assert_allclose(np.asarray(out).sum(1), 1.0, rtol=1e-5)
+
+
+def test_anomaly_detector_unroll_and_detect():
+    data = np.arange(1, 7, dtype=np.float32)  # doc example in the reference
+    feats, labels, idx = AnomalyDetector.unroll(data, 2, 1)
+    np.testing.assert_array_equal(
+        feats.squeeze(-1), [[1, 2], [2, 3], [3, 4], [4, 5]])
+    np.testing.assert_array_equal(labels, [3, 4, 5, 6])
+    np.testing.assert_array_equal(idx, [0, 1, 2, 3])
+
+    truth = np.zeros(100, np.float32)
+    pred = np.zeros(100, np.float32)
+    pred[7] = 5.0  # one big miss
+    _, _, anomaly = AnomalyDetector.detect_anomalies(truth, pred, 5)
+    assert not np.isnan(anomaly[7])
+    assert np.isnan(anomaly[np.arange(100) != 7]).all()
+
+
+def test_anomaly_detector_trains():
+    t = np.linspace(0, 12 * np.pi, 400, dtype=np.float32)
+    series = np.sin(t)
+    feats, labels, _ = AnomalyDetector.unroll(series, 10)
+    ad = AnomalyDetector(feature_shape=(10, 1), hidden_layers=[8, 8],
+                         dropouts=[0.0, 0.0])
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+    ad.compile(Adam(lr=0.01), "mse")
+    ad.fit(feats, labels[:, None], batch_size=64, nb_epoch=40)
+    pred = np.asarray(ad.predict(feats, batch_size=128)).reshape(-1)
+    mse = float(np.mean((pred - labels) ** 2))
+    assert mse < 0.05, mse
+
+
+def test_knrm_ranking_and_classification():
+    l1, l2, vocab = 5, 10, 40
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, vocab, (8, l1 + l2)).astype(np.float32)
+    knrm = KNRM(l1, l2, vocab, embed_size=12, kernel_num=5)
+    out = knrm.predict(x, batch_size=8)
+    assert out.shape == (8, 1)
+
+    knrm_c = KNRM(l1, l2, vocab, embed_size=12, kernel_num=5,
+                  target_mode="classification")
+    out_c = np.asarray(knrm_c.predict(x, batch_size=8))
+    assert ((out_c >= 0) & (out_c <= 1)).all()
+
+    # pairwise training with rank_hinge: relevant doc = query tokens repeated
+    q = rng.integers(1, vocab, (64, l1))
+    pos = np.concatenate([q, q], axis=1)[:, :l2]
+    neg = rng.integers(1, vocab, (64, l2))
+    # interleave (pos, neg) pairs as rank_hinge expects
+    x_pairs = np.empty((128, l1 + l2), np.float32)
+    x_pairs[0::2] = np.concatenate([q, pos], 1)
+    x_pairs[1::2] = np.concatenate([q, neg], 1)
+    y = np.zeros((128, 1), np.float32)
+    knrm.compile("adam", "rank_hinge")
+    knrm.fit(x_pairs, y, batch_size=32, nb_epoch=5)
+    s_pos = np.asarray(knrm.predict(np.concatenate([q, pos], 1)
+                                    .astype(np.float32)))
+    s_neg = np.asarray(knrm.predict(np.concatenate([q, neg], 1)
+                                    .astype(np.float32)))
+    assert (s_pos > s_neg).mean() > 0.8
+
+
+@pytest.mark.parametrize("rnn_type,bridge_type",
+                         [("lstm", "dense"), ("gru", "densenonlinear"),
+                          ("lstm", None)])
+def test_seq2seq_forward_and_grad(rnn_type, bridge_type):
+    feat, hidden = 4, 6
+    enc = RNNEncoder.initialize(rnn_type, 2, hidden)
+    dec = RNNDecoder.initialize(rnn_type, 2, hidden)
+    bridge = Bridge.initialize(bridge_type, hidden) if bridge_type else None
+    s2s = Seq2seq(enc, dec, [5, feat], [3, feat], bridge=bridge)
+    rng = np.random.default_rng(0)
+    x_enc = rng.standard_normal((2, 5, feat)).astype(np.float32)
+    x_dec = rng.standard_normal((2, 3, feat)).astype(np.float32)
+    out = s2s.predict([x_enc, x_dec], batch_size=2)
+    assert np.asarray(out).shape == (2, 3, hidden)
+
+    y = rng.standard_normal((2, 3, hidden)).astype(np.float32)
+    s2s.compile("adam", "mse")
+    s2s.fit([x_enc, x_dec], y, batch_size=2, nb_epoch=2)
+
+
+def test_seq2seq_trains_copy_task():
+    # learn to reproduce a constant target sequence from the input
+    feat, hidden = 3, 16
+    enc = RNNEncoder.initialize("gru", 1, hidden)
+    dec = RNNDecoder.initialize("gru", 1, hidden)
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    gen = Dense(feat)
+    s2s = Seq2seq(enc, dec, [4, feat], [2, feat], bridge=Bridge("dense", hidden),
+                  generator=gen)
+    rng = np.random.default_rng(0)
+    x_enc = rng.standard_normal((128, 4, feat)).astype(np.float32)
+    x_dec = np.zeros((128, 2, feat), np.float32)
+    y = np.repeat(x_enc.mean(axis=1, keepdims=True), 2, axis=1)
+    s2s.compile("adam", "mse")
+    s2s.fit([x_enc, x_dec], y, batch_size=32, nb_epoch=30)
+    pred = np.asarray(s2s.predict([x_enc, x_dec], batch_size=64))
+    mse = float(np.mean((pred - y) ** 2))
+    assert mse < 0.05, mse
+
+
+def test_seq2seq_infer_loop():
+    feat, hidden = 3, 8
+    enc = RNNEncoder.initialize("lstm", 1, hidden)
+    dec = RNNDecoder.initialize("lstm", 1, hidden)
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    s2s = Seq2seq(enc, dec, [4, feat], [2, feat],
+                  bridge=Bridge("dense", hidden), generator=Dense(feat))
+    x = np.random.default_rng(0).standard_normal((4, feat)).astype(np.float32)
+    start = np.zeros(feat, np.float32)
+    out = s2s.infer(x, start, max_seq_len=5)
+    assert out.shape == (1, 6, feat)  # start + 5 decoded steps
+
+
+def test_zoo_model_save_load_roundtrip(tmp_path):
+    clf = TextClassifier(2, 8, sequence_length=6, encoder="cnn",
+                         encoder_output_dim=12)
+    x = np.random.default_rng(0).standard_normal((4, 6, 8)).astype(np.float32)
+    before = np.asarray(clf.predict(x, batch_size=4))
+    path = str(tmp_path / "tc")
+    clf.save_model(path, over_write=True)
+    loaded = ZooModel.load_model(path)
+    after = np.asarray(loaded.predict(x, batch_size=4))
+    np.testing.assert_allclose(before, after, rtol=1e-6)
