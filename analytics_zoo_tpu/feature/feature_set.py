@@ -181,14 +181,18 @@ class GeneratorFeatureSet(FeatureSet):
                                else len(buf_x), pad=pad_remainder)
 
 
+def minibatch_len(batch: MiniBatch) -> int:
+    return len(batch.weights) if batch.weights is not None else \
+        len(batch.inputs[0])
+
+
 def pad_minibatch(batch: MiniBatch, target: int) -> MiniBatch:
     """Pad a MiniBatch to ``target`` samples by repeating the last sample
     with zero weight. Loss/metrics are weight-aware so the padding does not
     bias them; note BatchNorm running stats are NOT weight-aware — training
     batch sizes should be a multiple of the data-parallel size to avoid
     padded samples entering normalization statistics."""
-    n = len(batch.weights) if batch.weights is not None else \
-        len(batch.inputs[0])
+    n = minibatch_len(batch)
     if target <= n:
         return batch
     reps = target - n

@@ -294,8 +294,8 @@ class Seq2seq(ZooModel):
         self.output_shape_ = list(output_shape)
         self.bridge = bridge
         self.generator = generator
-        self._record_config(input_shape=self.input_shape_,
-                            output_shape=self.output_shape_)
+        self._record_config(input_shape_=self.input_shape_,
+                            output_shape_=self.output_shape_)
         self.model = self.build_model()
 
     def build_model(self):

@@ -30,7 +30,7 @@ from ..common.nncontext import ZooContext, get_nncontext
 from ..common.zoo_trigger import (EveryEpoch, MaxEpoch, TrainRecord,
                                   ZooTrigger)
 from ..feature.feature_set import (ArrayFeatureSet, FeatureSet, MiniBatch,
-                                   pad_minibatch,
+                                   minibatch_len, pad_minibatch,
                                    PrefetchIterator)
 from ..utils import serialization
 
@@ -239,8 +239,7 @@ class SPMDTrainer:
         dp = int(np.prod([self.ctx.mesh.shape[a]
                           for a in ("data", "pipe", "seq", "expert")
                           if a in self.ctx.mesh.shape]))
-        n = len(batch.weights) if batch.weights is not None else \
-            len(batch.inputs[0])
+        n = minibatch_len(batch)
         target = -(-n // dp) * dp
         if target == n:
             return batch

@@ -1,0 +1,172 @@
+"""Feature-layer tests: Preprocessing chains, ImageSet + ops, Image3D,
+TextSet pipeline, Relations (SURVEY.md §2.4)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.feature import (ArrayToTensor, ChainedPreprocessing,
+                                       FeatureLabelPreprocessing, Relation,
+                                       Relations, SampleToMiniBatch, Sample,
+                                       ScalarToTensor, SeqToTensor)
+from analytics_zoo_tpu.feature.image import (ImageBrightness, ImageCenterCrop,
+                                             ImageChannelNormalize,
+                                             ImageChannelOrder, ImageExpand,
+                                             ImageFeature, ImageHFlip,
+                                             ImageMatToTensor, ImageResize,
+                                             ImageSet, ImageSetToSample,
+                                             PerImageNormalize)
+from analytics_zoo_tpu.feature.image3d import (CenterCrop3D, Crop3D,
+                                               Rotate3D)
+from analytics_zoo_tpu.feature.text import (TextFeature, TextSet)
+
+
+def test_preprocessing_chain_composes():
+    chain = SeqToTensor([4]) >> ArrayToTensor([2, 2])
+    out = chain.apply([1, 2, 3, 4])
+    assert out.shape == (2, 2)
+    chain2 = ChainedPreprocessing([ScalarToTensor(), ArrayToTensor()])
+    assert chain2.apply(3.0).shape == ()
+
+
+def test_feature_label_preprocessing_and_batching():
+    flp = FeatureLabelPreprocessing(SeqToTensor([2]), ScalarToTensor())
+    samples = [flp.apply(([i, i + 1], i % 2)) for i in range(5)]
+    assert all(isinstance(s, Sample) for s in samples)
+    batches = list(SampleToMiniBatch(2)(iter(samples)))
+    assert len(batches) == 3
+    assert batches[0].inputs[0].shape == (2, 2)
+    assert batches[-1].inputs[0].shape == (1, 2)
+
+
+def _img(h=32, w=48, c=3, seed=0):
+    return np.random.default_rng(seed).uniform(
+        0, 255, (h, w, c)).astype(np.float32)
+
+
+def test_image_ops():
+    feat = ImageFeature(_img())
+    out = ImageResize(16, 20).apply(feat)
+    assert out.get_image().shape == (16, 20, 3)
+    out = ImageCenterCrop(8, 8).apply(out)
+    assert out.get_image().shape == (8, 8, 3)
+    img = out.get_image().copy()
+    flipped = ImageHFlip().apply(out).get_image()
+    np.testing.assert_allclose(flipped, img[:, ::-1])
+
+    norm = ImageChannelNormalize(10, 20, 30, 2, 2, 2).apply(
+        ImageFeature(np.ones((4, 4, 3), np.float32) * 50)).get_image()
+    # mat is BGR: channel 0 normalized with mean_b=30
+    np.testing.assert_allclose(norm[..., 0], (50 - 30) / 2)
+    np.testing.assert_allclose(norm[..., 2], (50 - 10) / 2)
+
+    per = PerImageNormalize(0, 1).apply(ImageFeature(_img())).get_image()
+    assert 0.0 <= per.min() < 1e-6 and 1 - 1e-6 < per.max() <= 1.0
+
+    exp = ImageExpand(min_expand_ratio=2.0, max_expand_ratio=2.0).apply(
+        ImageFeature(_img(10, 10))).get_image()
+    assert exp.shape == (20, 20, 3)
+
+    rgb = ImageChannelOrder().apply(ImageFeature(_img())).get_image()
+    np.testing.assert_allclose(rgb[..., 0], _img()[..., 2])
+
+
+def test_image_mat_to_tensor_and_sample():
+    feat = ImageFeature(_img(8, 8), label=3.0)
+    feat = ImageMatToTensor(format="NCHW").apply(feat)
+    assert feat["floats"].shape == (3, 8, 8)
+    feat = ImageSetToSample().apply(feat)
+    s = feat.get_sample()
+    assert s.features[0].shape == (3, 8, 8)
+    assert float(s.labels[0]) == 3.0
+
+
+def test_image_set_read_with_label(tmp_path):
+    import cv2
+
+    for cls in ("cat", "dog"):
+        os.makedirs(tmp_path / cls)
+        for i in range(3):
+            cv2.imwrite(str(tmp_path / cls / f"{i}.jpg"),
+                        np.random.default_rng(i).integers(
+                            0, 255, (16, 16, 3)).astype(np.uint8))
+    iset = ImageSet.read(str(tmp_path), with_label=True)
+    assert len(iset) == 6
+    labels = sorted(set(float(l) for l in iset.get_label()))
+    assert labels == [1.0, 2.0]
+
+    iset.transform(ImageResize(8, 8))
+    iset.transform(ImageMatToTensor(format="NHWC"))
+    iset.transform(ImageSetToSample())
+    fs = iset.to_feature_set()
+    assert fs.size() == 6
+    batch = next(fs.batches(6, drop_remainder=False))
+    assert batch.inputs[0].shape == (6, 8, 8, 3)
+
+
+def test_image3d_ops():
+    vol = np.random.default_rng(0).standard_normal((10, 12, 14)) \
+        .astype(np.float32)
+    feat = ImageFeature(vol)
+    out = Crop3D([1, 2, 3], [4, 5, 6]).apply(feat).get_image()
+    np.testing.assert_allclose(out, vol[1:5, 2:7, 3:9])
+    out = CenterCrop3D(4, 4, 4).apply(ImageFeature(vol)).get_image()
+    assert out.shape == (4, 4, 4)
+    rot = Rotate3D([np.pi, 0, 0]).apply(ImageFeature(vol)).get_image()
+    assert rot.shape == vol.shape
+
+
+def test_textset_pipeline(tmp_path):
+    texts = ["Hello World hello", "goodbye world!", "the quick brown fox",
+             "the lazy dog sleeps"]
+    labels = [0, 0, 1, 1]
+    ts = TextSet.array([TextFeature(t, l, uri=f"doc{i}")
+                        for i, (t, l) in enumerate(zip(texts, labels))])
+    ts.tokenize().normalize().word2idx().shape_sequence(5).generate_sample()
+    idx = ts.get_word_index()
+    assert idx["world"] >= 1 and idx["the"] >= 1
+    samples = ts.get_samples()
+    assert all(s.features[0].shape == (5,) for s in samples)
+    fs = ts.to_feature_set()
+    assert fs.size() == 4
+
+    # word index round trip
+    p = str(tmp_path / "vocab.txt")
+    ts.save_word_index(p)
+    ts2 = TextSet.array([TextFeature("hello world")]).load_word_index(p)
+    assert ts2.get_word_index() == idx
+
+    # frequency options
+    ts3 = TextSet.array([TextFeature(t) for t in texts]).tokenize() \
+        .normalize()
+    m = ts3.generate_word_index_map(min_freq=2)
+    assert set(m) == {"world", "hello", "the"}
+
+
+def test_relations_and_ranking_sets(tmp_path):
+    corpus1 = TextSet.array([TextFeature("apple banana", uri="q1"),
+                             TextFeature("cherry date", uri="q2")])
+    corpus2 = TextSet.array([TextFeature("apple pie recipe", uri="d1"),
+                             TextFeature("banana split recipe", uri="d2"),
+                             TextFeature("random other words", uri="d3")])
+    for c, n in ((corpus1, 3), (corpus2, 4)):
+        c.tokenize().normalize().word2idx().shape_sequence(n)
+    relations = [Relation("q1", "d1", 1), Relation("q1", "d3", 0),
+                 Relation("q2", "d2", 1), Relation("q2", "d3", 0)]
+    pairs_ts = TextSet.from_relation_pairs(relations, corpus1, corpus2)
+    assert len(pairs_ts) == 2
+    s = pairs_ts.get_samples()[0]
+    assert s.features[0].shape == (2, 7)
+    np.testing.assert_allclose(np.asarray(s.labels[0]), [[1.0], [0.0]])
+
+    lists_ts = TextSet.from_relation_lists(relations, corpus1, corpus2)
+    assert len(lists_ts) == 2
+    s = lists_ts.get_samples()[0]
+    assert s.features[0].shape == (2, 7)
+
+    # csv read
+    p = tmp_path / "rel.csv"
+    p.write_text("id1,id2,label\nq1,d1,1\nq1,d3,0\n")
+    rels = Relations.read(str(p))
+    assert rels == [Relation("q1", "d1", 1), Relation("q1", "d3", 0)]
