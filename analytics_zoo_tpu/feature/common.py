@@ -33,8 +33,9 @@ class Preprocessing:
         raise NotImplementedError(type(self).__name__)
 
     def __call__(self, x):
-        if isinstance(x, (Iterable,)) and not isinstance(
-                x, (np.ndarray, str, bytes, tuple, Sample, MiniBatch, dict)):
+        # Only true iterators/generators are mapped lazily; plain lists are
+        # single elements (SeqToTensor([1,2,3]) must yield one tensor).
+        if hasattr(x, "__next__"):
             return (self.apply(e) for e in x)
         return self.apply(x)
 
@@ -206,14 +207,9 @@ class SampleToMiniBatch(Preprocessing):
 
     @staticmethod
     def _stack(buf: List[Sample]):
-        n_feat = len(buf[0].features)
-        xs = tuple(np.stack([s.features[i] for s in buf])
-                   for i in range(n_feat))
-        ys = None
-        if buf[0].labels is not None:
-            labs = [np.stack([s.labels[i] for s in buf])
-                    for i in range(len(buf[0].labels))]
-            ys = labs[0] if len(labs) == 1 else labs
+        from .feature_set import stack_samples
+
+        xs, ys = stack_samples(buf)
         return MiniBatch(xs, ys, np.ones(len(buf), np.float32))
 
 

@@ -91,18 +91,9 @@ class FeatureSet:
 
     @staticmethod
     def samples(samples: Sequence[Sample]) -> "ArrayFeatureSet":
-        samples = list(samples)
-        if not samples:
-            raise ValueError("empty sample collection")
-        n_feat = len(samples[0].features)
-        feats = [np.stack([s.features[i] for s in samples])
-                 for i in range(n_feat)]
-        labels = None
-        if samples[0].labels is not None:
-            labs = [np.stack([s.labels[i] for s in samples])
-                    for i in range(len(samples[0].labels))]
-            labels = labs[0] if len(labs) == 1 else labs
-        return ArrayFeatureSet(feats if len(feats) > 1 else feats[0], labels)
+        feats, labels = stack_samples(samples)
+        return ArrayFeatureSet(
+            list(feats) if len(feats) > 1 else feats[0], labels)
 
     @staticmethod
     def generator(fn: Callable[[], Iterator], size: int,
@@ -179,6 +170,23 @@ class GeneratorFeatureSet(FeatureSet):
         if buf_x and not drop_remainder:
             yield _stack_batch(buf_x, buf_y, batch_size if pad_remainder
                                else len(buf_x), pad=pad_remainder)
+
+
+def stack_samples(samples: Sequence[Sample]):
+    """Stack Samples into (features_tuple, labels); the single shared
+    batching helper (used by FeatureSet.samples and SampleToMiniBatch)."""
+    samples = list(samples)
+    if not samples:
+        raise ValueError("empty sample collection")
+    n_feat = len(samples[0].features)
+    feats = tuple(np.stack([s.features[i] for s in samples])
+                  for i in range(n_feat))
+    labels = None
+    if samples[0].labels is not None:
+        labs = [np.stack([s.labels[i] for s in samples])
+                for i in range(len(samples[0].labels))]
+        labels = labs[0] if len(labs) == 1 else labs
+    return feats, labels
 
 
 def minibatch_len(batch: MiniBatch) -> int:

@@ -72,15 +72,17 @@ class ImageSet:
                          if os.path.isdir(os.path.join(root, d)))
         label_map = {c: i + (1 if one_based else 0)
                      for i, c in enumerate(classes)}
+        # shard the path list BEFORE decoding so each host only reads its
+        # slice (matches the unlabeled read() path)
+        entries = [(p, c) for c in classes
+                   for p in sorted(glob.glob(os.path.join(root, c, "*")))
+                   if p.lower().endswith(_IMAGE_EXTS)]
+        entries = entries[shard_index::num_shards]
         feats = []
-        for c in classes:
-            for p in sorted(glob.glob(os.path.join(root, c, "*"))):
-                if not p.lower().endswith(_IMAGE_EXTS):
-                    continue
-                f = cls._load_one(p, resize_h, resize_w)
-                f[ImageFeature.label] = np.float32(label_map[c])
-                feats.append(f)
-        feats = feats[shard_index::num_shards]
+        for p, c in entries:
+            f = cls._load_one(p, resize_h, resize_w)
+            f[ImageFeature.label] = np.float32(label_map[c])
+            feats.append(f)
         out = LocalImageSet(feats) if num_shards == 1 else \
             DistributedImageSet(feats, shard_index, num_shards)
         out.label_map = label_map
@@ -148,7 +150,17 @@ class ImageSet:
             splits.append([self.features[i] for i in idx[start:start + n]])
             start += n
         splits.append([self.features[i] for i in idx[start:]])
-        return [type(self)(s) for s in splits]
+        outs = []
+        for s in splits:
+            if isinstance(self, DistributedImageSet):
+                part = DistributedImageSet(s, self.shard_index,
+                                           self.num_shards)
+            else:
+                part = type(self)(s)
+            if hasattr(self, "label_map"):
+                part.label_map = self.label_map
+            outs.append(part)
+        return outs
 
     def __len__(self):
         return len(self.features)

@@ -74,14 +74,20 @@ class ImagePixelBytesToMat(ImagePreprocessing):
 
 
 class ImageResize(ImagePreprocessing):
+    """``resize_mode`` is a cv2 interpolation flag; -1 picks a random
+    method per image (Resize.scala semantics)."""
+
+    _RANDOM_INTERPS = (0, 1, 2, 3, 4)  # nearest/linear/cubic/area/lanczos
+
     def __init__(self, resize_h: int, resize_w: int, resize_mode: int = 1,
                  use_scale_factor: bool = True):
         self.h, self.w = int(resize_h), int(resize_w)
-        self.interp = resize_mode
+        self.interp = int(resize_mode)
 
     def transform_mat(self, img, feature):
-        return cv2.resize(img, (self.w, self.h),
-                          interpolation=cv2.INTER_LINEAR)
+        interp = self.interp if self.interp >= 0 else \
+            random.choice(self._RANDOM_INTERPS)
+        return cv2.resize(img, (self.w, self.h), interpolation=interp)
 
 
 class ImageAspectScale(ImagePreprocessing):
@@ -95,9 +101,12 @@ class ImageAspectScale(ImagePreprocessing):
         self.max_size = int(max_size)
 
     def transform_mat(self, img, feature):
+        return self._scale_mat(img, feature, self.min_size)
+
+    def _scale_mat(self, img, feature, min_size):
         h, w = img.shape[:2]
         short, long = min(h, w), max(h, w)
-        scale = self.min_size / short
+        scale = min_size / short
         if scale * long > self.max_size:
             scale = self.max_size / long
         nh, nw = int(round(h * scale)), int(round(w * scale))
@@ -116,8 +125,8 @@ class ImageRandomAspectScale(ImageAspectScale):
         self.scales = [int(s) for s in scales]
 
     def transform_mat(self, img, feature):
-        self.min_size = random.choice(self.scales)
-        return super().transform_mat(img, feature)
+        # transformers are shared across prefetch threads — no self writes
+        return self._scale_mat(img, feature, random.choice(self.scales))
 
 
 class ImageBrightness(ImagePreprocessing):
@@ -141,10 +150,10 @@ class ImageHue(ImagePreprocessing):
         self.lo, self.hi = float(delta_low), float(delta_high)
 
     def transform_mat(self, img, feature):
-        hsv = cv2.cvtColor(img.astype(np.uint8), cv2.COLOR_BGR2HSV) \
-            .astype(np.float32)
+        hsv = cv2.cvtColor(np.clip(img, 0, 255).astype(np.uint8),
+                           cv2.COLOR_BGR2HSV).astype(np.float32)
         hsv[..., 0] = (hsv[..., 0] + random.uniform(self.lo, self.hi)) % 180
-        return cv2.cvtColor(hsv.astype(np.uint8),
+        return cv2.cvtColor(np.clip(hsv, 0, 255).astype(np.uint8),
                             cv2.COLOR_HSV2BGR).astype(np.float32)
 
 
@@ -153,11 +162,11 @@ class ImageSaturation(ImagePreprocessing):
         self.lo, self.hi = float(delta_low), float(delta_high)
 
     def transform_mat(self, img, feature):
-        hsv = cv2.cvtColor(img.astype(np.uint8), cv2.COLOR_BGR2HSV) \
-            .astype(np.float32)
+        hsv = cv2.cvtColor(np.clip(img, 0, 255).astype(np.uint8),
+                           cv2.COLOR_BGR2HSV).astype(np.float32)
         hsv[..., 1] = np.clip(
             hsv[..., 1] * random.uniform(self.lo, self.hi), 0, 255)
-        return cv2.cvtColor(hsv.astype(np.uint8),
+        return cv2.cvtColor(np.clip(hsv, 0, 255).astype(np.uint8),
                             cv2.COLOR_HSV2BGR).astype(np.float32)
 
 

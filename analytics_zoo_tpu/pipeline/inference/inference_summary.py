@@ -1,0 +1,56 @@
+"""InferenceSummary: throughput/latency scalars for serving.
+
+Parity: ``zoo/.../pipeline/inference/InferenceSummary.scala:46`` (wired by
+``ClusterServing.scala:96-97``) — TensorBoard scalars via the event-writer
+in ``utils.tensorboard``.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from ...utils import tensorboard
+
+
+class InferenceSummary:
+    def __init__(self, log_dir: str, app_name: str):
+        self.writer = tensorboard.FileWriter(
+            os.path.join(log_dir, app_name, "inference"))
+        self._step = 0
+
+    def add_scalar(self, tag: str, value: float, step: int = None):
+        if step is None:
+            self._step += 1
+            step = self._step
+        self.writer.add_scalar(tag, value, step)
+
+    def record_batch(self, batch_size: int, latency_s: float):
+        self._step += 1
+        self.writer.add_scalar("Throughput",
+                               batch_size / max(latency_s, 1e-9), self._step)
+        self.writer.add_scalar("LatencyMs", latency_s * 1e3, self._step)
+
+    def close(self):
+        self.writer.close()
+
+
+class Timer:
+    """``InferenceSupportive.timing`` parity: context manager measuring a
+    predict call for the summary."""
+
+    def __init__(self, summary: InferenceSummary = None,
+                 batch_size: int = 1):
+        self.summary = summary
+        self.batch_size = batch_size
+        self.elapsed = 0.0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.elapsed = time.perf_counter() - self._t0
+        if self.summary is not None:
+            self.summary.record_batch(self.batch_size, self.elapsed)
+        return False

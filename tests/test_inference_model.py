@@ -1,0 +1,95 @@
+"""InferenceModel tests (SURVEY §2.6)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+from analytics_zoo_tpu.pipeline.api.keras.models import Sequential
+from analytics_zoo_tpu.pipeline.inference import (InferenceModel,
+                                                  InferenceSummary,
+                                                  QuantizedModel)
+
+
+def _trained_model(d=6, out=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((96, d)).astype(np.float32)
+    y = rng.integers(0, out, 96)
+    m = Sequential()
+    m.add(Dense(16, input_shape=(d,), activation="relu"))
+    m.add(Dense(out, activation="softmax"))
+    m.compile("adam", "sparse_categorical_crossentropy")
+    m.fit(x, y, batch_size=32, nb_epoch=2)
+    return m, x
+
+
+def test_inference_model_load_predict(tmp_path):
+    model, x = _trained_model()
+    model.save_model(str(tmp_path / "m"), over_write=True)
+    inf = InferenceModel(supported_concurrent_num=2)
+    inf.load(str(tmp_path / "m"))
+    out = inf.predict(x[:8])
+    ref = model.predict(x[:8])
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+    # second predict with a different batch size triggers a new AOT compile
+    out2 = inf.predict(x[:5])
+    assert out2.shape == (5, 3)
+
+
+def test_inference_model_concurrent():
+    model, x = _trained_model()
+    inf = InferenceModel(supported_concurrent_num=4)
+    inf.load_keras_net(model)
+    results = [None] * 8
+    errs = []
+
+    def worker(i):
+        try:
+            results[i] = inf.predict(x[:4])
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    assert not errs
+    for r in results[1:]:
+        np.testing.assert_allclose(r, results[0], rtol=1e-6)
+
+
+def test_quantized_model_close_to_float():
+    model, x = _trained_model()
+    inf = InferenceModel()
+    inf.load_keras_net(model, quantize=True)
+    assert isinstance(inf.model, QuantizedModel)
+    q = inf.predict(x[:16])
+    f = model.predict(x[:16])
+    # int8 weight-only PTQ: small degradation allowed
+    assert np.mean(np.abs(q - f)) < 0.05
+    # quantized leaves really are int8 under the hood
+    from analytics_zoo_tpu.pipeline.inference.inference_model import \
+        _QuantizedLeaf
+    import jax
+    leaves = [l for l in jax.tree_util.tree_leaves(
+        inf.model._params,
+        is_leaf=lambda p: isinstance(p, _QuantizedLeaf))
+        if isinstance(l, _QuantizedLeaf)]
+    assert leaves and all(np.asarray(l.q).dtype == np.int8 for l in leaves)
+
+
+def test_autoscale_and_summary(tmp_path):
+    model, x = _trained_model()
+    inf = InferenceModel(supported_concurrent_num=0)  # autoscale mode
+    inf.load_keras_net(model)
+    inf.predict(x[:4])
+    summ = InferenceSummary(str(tmp_path), "app")
+    from analytics_zoo_tpu.pipeline.inference.inference_summary import Timer
+    with Timer(summ, batch_size=4):
+        inf.predict(x[:4])
+    summ.close()
+    from analytics_zoo_tpu.utils.tensorboard import read_scalars
+    import os
+    scalars = read_scalars(os.path.join(str(tmp_path), "app", "inference"))
+    tags = {s[2] for s in scalars}
+    assert "Throughput" in tags and "LatencyMs" in tags

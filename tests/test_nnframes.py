@@ -1,0 +1,100 @@
+"""NNFrames tests (SURVEY §2.5: NNEstimator/NNModel/NNClassifier)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from analytics_zoo_tpu.common.zoo_trigger import MaxEpoch
+from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+from analytics_zoo_tpu.pipeline.api.keras.models import Sequential
+from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+from analytics_zoo_tpu.pipeline.nnframes import (NNClassifier,
+                                                 NNClassifierModel,
+                                                 NNEstimator, NNImageReader,
+                                                 NNModel)
+
+
+def _regression_df(n=64, d=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    w = rng.standard_normal((d, 1)).astype(np.float32)
+    y = x @ w
+    return pd.DataFrame({"features": [r.tolist() for r in x],
+                         "label": [float(v) for v in y[:, 0]]})
+
+
+def _classification_df(n=96, d=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.int32)
+    return pd.DataFrame({"features": [r.tolist() for r in x],
+                         "label": y})
+
+
+def _mlp(d=4, out=1, activation=None):
+    m = Sequential()
+    m.add(Dense(8, input_shape=(d,), activation="relu"))
+    m.add(Dense(out, activation=activation))
+    return m
+
+
+def test_nnestimator_fit_transform():
+    df = _regression_df()
+    est = (NNEstimator(_mlp(), "mse", feature_preprocessing=[4],
+                       label_preprocessing=[1])
+           .setBatchSize(16).setMaxEpoch(25)
+           .setOptimMethod(Adam(lr=0.05)))
+    nn_model = est.fit(df)
+    assert isinstance(nn_model, NNModel)
+    out = nn_model.transform(df)
+    assert "prediction" in out.columns
+    preds = np.array([p[0] for p in out["prediction"]])
+    truth = df["label"].to_numpy()
+    assert np.mean((preds - truth) ** 2) < 0.3
+
+
+def test_nnclassifier_accuracy_and_persistence(tmp_path):
+    df = _classification_df()
+    clf = (NNClassifier(_mlp(out=2, activation="softmax"),
+                        "sparse_categorical_crossentropy",
+                        feature_preprocessing=[4])
+           .setBatchSize(16).setMaxEpoch(30)
+           .setOptimMethod(Adam(lr=0.05)))
+    model = clf.fit(df)
+    assert isinstance(model, NNClassifierModel)
+    out = model.transform(df)
+    acc = float((out["prediction"].to_numpy() ==
+                 df["label"].to_numpy()).mean())
+    assert acc > 0.85
+    # ML persistence round trip
+    model.save(str(tmp_path / "m"))
+    loaded = NNModel.load(str(tmp_path / "m"))
+    out2 = loaded.transform(df)
+    np.testing.assert_array_equal(out["prediction"].to_numpy(),
+                                  out2["prediction"].to_numpy())
+
+
+def test_nnestimator_validation_and_clipping():
+    df = _regression_df()
+    est = (NNEstimator(_mlp(), "mse", feature_preprocessing=[4],
+                       label_preprocessing=[1])
+           .setBatchSize(16).setMaxEpoch(3)
+           .setGradientClippingByL2Norm(1.0))
+    from analytics_zoo_tpu.common.zoo_trigger import EveryEpoch
+    est.setValidation(EveryEpoch(), df, ["mae"], 16)
+    model = est.fit(df)
+    assert model is not None
+
+
+def test_nn_image_reader(tmp_path):
+    import cv2
+    img = (np.random.default_rng(0).integers(0, 255, (12, 10, 3))
+           .astype(np.uint8))
+    cv2.imwrite(str(tmp_path / "a.png"), img)
+    df = NNImageReader.readImages(str(tmp_path))
+    assert len(df) == 1
+    row = df["image"][0]
+    assert row["height"] == 12 and row["width"] == 10
+    from analytics_zoo_tpu.pipeline.nnframes import NNImageSchema
+    back = NNImageSchema.to_ndarray(row)
+    np.testing.assert_array_equal(back.astype(np.uint8), img)

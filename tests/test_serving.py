@@ -1,0 +1,94 @@
+"""Cluster Serving tests: client -> stream -> serving loop -> results."""
+
+import time
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.pipeline.api.keras.layers import (Convolution2D,
+                                                         Dense, Flatten)
+from analytics_zoo_tpu.pipeline.api.keras.models import Sequential
+from analytics_zoo_tpu.pipeline.inference import InferenceModel
+from analytics_zoo_tpu.serving import (ClusterServing, ClusterServingHelper,
+                                       FileStreamQueue,
+                                       InProcessStreamQueue, InputQueue,
+                                       OutputQueue)
+
+
+def _tiny_image_model(c=3, h=16, w=16, classes=5):
+    m = Sequential()
+    m.add(Flatten(input_shape=(c, h, w)))
+    m.add(Dense(classes, activation="softmax"))
+    m.compile("sgd", "sparse_categorical_crossentropy")
+    return m
+
+
+def _serving(backend, tmp=None):
+    model = InferenceModel(supported_concurrent_num=1)
+    model.load_keras_net(_tiny_image_model())
+    helper = ClusterServingHelper(config={
+        "model": {"path": None},
+        "data": {"image_shape": "3, 16, 16"},
+        "params": {"batch_size": 4, "top_n": 2}})
+    return ClusterServing(model=model, helper=helper, backend=backend)
+
+
+@pytest.mark.parametrize("transport", ["inproc", "file"])
+def test_serving_end_to_end(transport, tmp_path):
+    backend = InProcessStreamQueue() if transport == "inproc" else \
+        FileStreamQueue(str(tmp_path))
+    serving = _serving(backend).start()
+    try:
+        in_q = InputQueue(backend=backend)
+        rng = np.random.default_rng(0)
+        for i in range(6):
+            img = rng.integers(0, 255, (16, 16, 3)).astype(np.uint8)
+            in_q.enqueue_image(f"img-{i}", img)
+        out_q = OutputQueue(backend=backend)
+        deadline = time.time() + 20
+        got = {}
+        while len(got) < 6 and time.time() < deadline:
+            got.update(out_q.dequeue())
+            time.sleep(0.1)
+        assert len(got) == 6, f"only {len(got)} results"
+        for uri, val in got.items():
+            assert val.shape == (2, 2)  # top_n=2 -> [class, prob] pairs
+            probs = val[:, 1]
+            assert np.all(probs <= 1.0) and np.all(probs >= 0.0)
+    finally:
+        serving.stop()
+
+
+def test_output_queue_query():
+    backend = InProcessStreamQueue()
+    serving = _serving(backend).start()
+    try:
+        in_q = InputQueue(backend=backend)
+        img = np.zeros((16, 16, 3), np.uint8)
+        in_q.enqueue_image("one", img)
+        out_q = OutputQueue(backend=backend)
+        deadline = time.time() + 20
+        while out_q.query("one") is None and time.time() < deadline:
+            time.sleep(0.05)
+        assert out_q.query("one") is not None
+    finally:
+        serving.stop()
+
+
+def test_helper_yaml_parsing(tmp_path):
+    cfg = tmp_path / "config.yaml"
+    cfg.write_text(
+        "model:\n  path: /tmp/m\ndata:\n  src:\n  image_shape: 3, 8, 8\n"
+        "params:\n  batch_size: 2\n  top_n: 1\n")
+    helper = ClusterServingHelper(config_path=str(cfg))
+    assert helper.model_path == "/tmp/m"
+    assert helper.image_shape == (3, 8, 8)
+    assert helper.batch_size == 2
+
+
+def test_watermark_trim():
+    q = InProcessStreamQueue()
+    for i in range(20):
+        q.enqueue({"uri": str(i)})
+    q.trim(5)
+    assert q.stream_len() == 5
