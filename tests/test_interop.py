@@ -1,0 +1,239 @@
+"""Interop layer tests: ONNX importer, TorchNet, TFNet, Net loaders.
+
+Mirrors the reference's golden-test strategy (SURVEY.md §4): foreign-runtime
+models are imported and compared numerically against the native runtime
+(torch / tf.keras) that produced them.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as nn  # noqa: E402
+
+from analytics_zoo_tpu.pipeline.api.net import (Net, TorchCriterion,  # noqa
+                                                TorchNet, TFNet)
+from analytics_zoo_tpu.pipeline.api.onnx import OnnxLoader, builder  # noqa
+
+
+def _mlp_onnx(tmp_path, m):
+    w0 = m[0].weight.detach().numpy()
+    b0 = m[0].bias.detach().numpy()
+    w2 = m[2].weight.detach().numpy()
+    b2 = m[2].bias.detach().numpy()
+    nodes = [
+        builder.make_node("Gemm", ["x", "w0", "b0"], ["h0"], transB=1),
+        builder.make_node("Relu", ["h0"], ["h1"]),
+        builder.make_node("Gemm", ["h1", "w2", "b2"], ["y"], transB=1),
+    ]
+    g = builder.make_graph(
+        nodes, "mlp",
+        [builder.value_info("x", (None, 6))],
+        [builder.value_info("y", (None, 3))],
+        {"w0": w0, "b0": b0, "w2": w2, "b2": b2})
+    path = str(tmp_path / "mlp.onnx")
+    builder.save_model(builder.make_model(g), path)
+    return path
+
+
+class TestOnnxImporter:
+    def test_mlp_matches_torch(self, tmp_path):
+        torch.manual_seed(0)
+        m = nn.Sequential(nn.Linear(6, 16), nn.ReLU(), nn.Linear(16, 3))
+        x = np.random.default_rng(0).standard_normal((4, 6)).astype(
+            np.float32)
+        ref = m(torch.from_numpy(x)).detach().numpy()
+        model = OnnxLoader.from_path(_mlp_onnx(tmp_path, m))
+        out = np.asarray(model.predict(x, batch_size=4))
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_cnn_matches_torch(self, tmp_path):
+        torch.manual_seed(1)
+        conv1 = nn.Conv2d(3, 8, 3, padding=1)
+        bn = nn.BatchNorm2d(8).eval()
+        conv2 = nn.Conv2d(8, 4, 3, stride=2)
+        fc = nn.Linear(4, 5)
+        with torch.no_grad():
+            bn.running_mean.normal_()
+            bn.running_var.uniform_(0.5, 2.0)
+
+        def torch_fwd(t):
+            h = torch.relu(bn(conv1(t)))
+            h = torch.max_pool2d(h, 2)
+            h = torch.relu(conv2(h))
+            h = h.mean(dim=(2, 3))
+            return fc(h)
+
+        x = np.random.default_rng(1).standard_normal(
+            (2, 3, 12, 12)).astype(np.float32)
+        ref = torch_fwd(torch.from_numpy(x)).detach().numpy()
+
+        nodes = [
+            builder.make_node("Conv", ["x", "w1", "c1"], ["a"],
+                              pads=[1, 1, 1, 1], kernel_shape=[3, 3]),
+            builder.make_node("BatchNormalization",
+                              ["a", "g", "beta", "mu", "var"], ["b"],
+                              epsilon=bn.eps),
+            builder.make_node("Relu", ["b"], ["c"]),
+            builder.make_node("MaxPool", ["c"], ["d"],
+                              kernel_shape=[2, 2], strides=[2, 2]),
+            builder.make_node("Conv", ["d", "w2", "c2"], ["e"],
+                              strides=[2, 2], kernel_shape=[3, 3]),
+            builder.make_node("Relu", ["e"], ["f"]),
+            builder.make_node("GlobalAveragePool", ["f"], ["gap"]),
+            builder.make_node("Flatten", ["gap"], ["flat"]),
+            builder.make_node("Gemm", ["flat", "wf", "bf"], ["y"],
+                              transB=1),
+        ]
+        inits = {
+            "w1": conv1.weight.detach().numpy(),
+            "c1": conv1.bias.detach().numpy(),
+            "g": bn.weight.detach().numpy(),
+            "beta": bn.bias.detach().numpy(),
+            "mu": bn.running_mean.numpy(),
+            "var": bn.running_var.numpy(),
+            "w2": conv2.weight.detach().numpy(),
+            "c2": conv2.bias.detach().numpy(),
+            "wf": fc.weight.detach().numpy(),
+            "bf": fc.bias.detach().numpy(),
+        }
+        g = builder.make_graph(
+            nodes, "cnn",
+            [builder.value_info("x", (None, 3, 12, 12))],
+            [builder.value_info("y", (None, 5))], inits)
+        path = str(tmp_path / "cnn.onnx")
+        builder.save_model(builder.make_model(g), path)
+        model = OnnxLoader.from_path(path)
+        out = np.asarray(model.predict(x, batch_size=2))
+        np.testing.assert_allclose(out, ref, atol=1e-4)
+
+    def test_shape_subgraph_constant_folds(self, tmp_path):
+        # Shape -> Gather -> Unsqueeze -> Concat -> Reshape: the dynamic
+        # flatten idiom exporters emit; must fold at trace time.
+        nodes = [
+            builder.make_node("Shape", ["x"], ["s"]),
+            builder.make_node("Gather", ["s", "zero"], ["b"], axis=0),
+            builder.make_node("Unsqueeze", ["b", "ax"], ["b1"]),
+            builder.make_node("Concat", ["b1", "minus1"], ["target"],
+                              axis=0),
+            builder.make_node("Reshape", ["x", "target"], ["y"]),
+        ]
+        inits = {"zero": np.asarray(0, np.int64),
+                 "ax": np.asarray([0], np.int64),
+                 "minus1": np.asarray([-1], np.int64)}
+        g = builder.make_graph(
+            nodes, "fold",
+            [builder.value_info("x", (4, 2, 3))],
+            [builder.value_info("y", (4, 6))], inits)
+        path = str(tmp_path / "fold.onnx")
+        builder.save_model(builder.make_model(g), path)
+        model = OnnxLoader.from_path(path)
+        x = np.arange(24, dtype=np.float32).reshape(4, 2, 3)
+        out = np.asarray(model.predict(x, batch_size=4))
+        np.testing.assert_allclose(out, x.reshape(4, 6))
+
+    def test_imported_model_is_trainable(self, tmp_path):
+        torch.manual_seed(2)
+        m = nn.Sequential(nn.Linear(6, 16), nn.ReLU(), nn.Linear(16, 3))
+        model = OnnxLoader.from_path(_mlp_onnx(tmp_path, m))
+        model.compile(optimizer="adam", loss="mse")
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((32, 6)).astype(np.float32)
+        y = rng.standard_normal((32, 3)).astype(np.float32)
+        before = model.evaluate(x, y, batch_size=16)["loss"]
+        model.fit(x, y, batch_size=16, nb_epoch=8)
+        after = model.evaluate(x, y, batch_size=16)["loss"]
+        assert after < before
+
+
+class TestTorchNet:
+    def _module(self):
+        torch.manual_seed(0)
+        return nn.Sequential(
+            nn.Conv2d(3, 4, 3, padding=1), nn.BatchNorm2d(4), nn.ReLU(),
+            nn.MaxPool2d(2), nn.Flatten(), nn.Linear(4 * 4 * 4, 5)).eval()
+
+    def test_fx_lowering_matches_torch(self):
+        m = self._module()
+        x = np.random.default_rng(0).standard_normal(
+            (2, 3, 8, 8)).astype(np.float32)
+        ref = m(torch.from_numpy(x)).detach().numpy()
+        net = TorchNet.from_pytorch(m)
+        assert net.mode == "jax"
+        np.testing.assert_allclose(net.predict(x), ref, atol=1e-5)
+
+    def test_callback_matches_torch_and_has_grads(self):
+        import jax
+        import jax.numpy as jnp
+
+        m = self._module()
+        x = np.random.default_rng(1).standard_normal(
+            (2, 3, 8, 8)).astype(np.float32)
+        ref = m(torch.from_numpy(x)).detach().numpy()
+        net = TorchNet(m, lower=False)
+        assert net.mode == "callback"
+        np.testing.assert_allclose(net.predict(x), ref, atol=1e-5)
+
+        params = net.build(None, None)
+        grads = jax.grad(
+            lambda p: jnp.sum(net.call(p, [jnp.asarray(x)]) ** 2))(params)
+        total = sum(float(jnp.abs(v).sum())
+                    for v in jax.tree_util.tree_leaves(grads))
+        assert total > 0
+
+    def test_torch_criterion(self):
+        import jax
+        import jax.numpy as jnp
+
+        crit = TorchCriterion.from_pytorch(nn.MSELoss())
+        rng = np.random.default_rng(3)
+        y = rng.standard_normal((4, 3)).astype(np.float32)
+        p = rng.standard_normal((4, 3)).astype(np.float32)
+        loss = float(crit(jnp.asarray(y), jnp.asarray(p)))
+        np.testing.assert_allclose(loss, np.mean((y - p) ** 2), rtol=1e-5)
+        g = jax.grad(lambda q: crit(jnp.asarray(y), q))(jnp.asarray(p))
+        np.testing.assert_allclose(np.asarray(g), 2 * (p - y) / p.size,
+                                   rtol=1e-4)
+
+
+@pytest.mark.filterwarnings("ignore")
+class TestTFNet:
+    def _keras_h5(self, tmp_path):
+        tf = pytest.importorskip("tensorflow")
+        tf.keras.utils.set_random_seed(0)
+        m = tf.keras.Sequential([
+            tf.keras.layers.Input((8,)),
+            tf.keras.layers.Dense(16, activation="relu"),
+            tf.keras.layers.Dense(3, activation="softmax")])
+        path = str(tmp_path / "m.h5")
+        m.save(path)
+        return m, path
+
+    def test_keras_h5_lowers_to_jax(self, tmp_path):
+        m, path = self._keras_h5(tmp_path)
+        x = np.random.default_rng(0).standard_normal((4, 8)).astype(
+            np.float32)
+        ref = m(x).numpy()
+        net = TFNet.from_keras(path)
+        assert net.mode == "jax"
+        np.testing.assert_allclose(net.predict(x), ref, atol=1e-5)
+        # float consts imported as trainable params
+        assert net.build(None, None)
+
+    def test_net_facade(self, tmp_path):
+        m, path = self._keras_h5(tmp_path)
+        net = Net.load_tf(path)
+        assert isinstance(net, TFNet)
+        tnet = Net.load_torch(nn.Linear(4, 2).eval())
+        assert isinstance(tnet, TorchNet)
+
+    def test_inference_model_load_torch(self):
+        from analytics_zoo_tpu.pipeline.inference import InferenceModel
+
+        m = nn.Sequential(nn.Linear(6, 4), nn.Tanh()).eval()
+        x = np.random.default_rng(5).standard_normal((3, 6)).astype(
+            np.float32)
+        ref = m(torch.from_numpy(x)).detach().numpy()
+        im = InferenceModel(supported_concurrent_num=2)
+        im.load_torch(m)
+        np.testing.assert_allclose(im.predict(x), ref, atol=1e-5)
