@@ -228,7 +228,12 @@ def _tf_padding(attrs):
     pad = attrs.get("padding", "VALID")
     if pad == "EXPLICIT":
         ep = attrs.get("explicit_paddings", [])
-        return [(int(ep[i]), int(ep[i + 1])) for i in range(2, 8, 2)][:2]
+        # layout follows data_format: spatial pads at H,W positions
+        if attrs.get("data_format", "NHWC") == "NCHW":
+            idx = (4, 6)
+        else:
+            idx = (2, 4)
+        return [(int(ep[i]), int(ep[i + 1])) for i in idx]
     return pad
 
 

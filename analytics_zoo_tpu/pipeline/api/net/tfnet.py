@@ -185,7 +185,14 @@ class TFNet(KerasLayer):
 
 
 class _CallbackTF:
-    """Host-CPU TF session behind pure_callback (+ input grads via tape)."""
+    """Host-CPU TF execution behind pure_callback.
+
+    Input gradients come from ``tf.GradientTape`` through a ``custom_vjp``
+    backward callback, so a callback-mode TFNet placed inside a model keeps
+    the chain rule intact (the reference's TFNet trains the same way: the
+    foreign graph computes its own grads, TFNet.scala backward meta).
+    Graph consts are frozen — matching TFNet's "fixed weights" semantics.
+    """
 
     def __init__(self, graph_def, input_names, output_names):
         tf = _tf()
@@ -197,6 +204,31 @@ class _CallbackTF:
         self.graph_def = graph_def
         self._fn = None
         self.num_outputs = len(output_names)
+        self._shape_cache = {}
+
+        @jax.custom_vjp
+        def apply(xs):
+            shapes = self._result_shapes(xs)
+            out = jax.pure_callback(
+                lambda *a: self.host_run(*a), tuple(shapes), *xs,
+                vmap_method="sequential")
+            return tuple(out)
+
+        def fwd(xs):
+            return apply(xs), xs
+
+        def bwd(xs, gs):
+            shapes = [jax.ShapeDtypeStruct(
+                np.shape(x), np.asarray(x).dtype
+                if not hasattr(x, "dtype") else x.dtype) for x in xs]
+            gx = jax.pure_callback(
+                lambda a, g: tuple(self.host_grad(list(a), list(g))),
+                tuple(shapes), tuple(xs), tuple(gs),
+                vmap_method="sequential")
+            return (tuple(gx),)
+
+        apply.defvjp(fwd, bwd)
+        self._apply = apply
 
     def _ensure(self):
         if self._fn is not None:
@@ -211,6 +243,19 @@ class _CallbackTF:
             return fetches
         self._fn = tf.function(import_and_run)
 
+    def _result_shapes(self, xs):
+        key = tuple((tuple(np.shape(x)), str(getattr(x, "dtype", "f4")))
+                    for x in xs)
+        if key not in self._shape_cache:
+            probe = [np.zeros(np.shape(x),
+                              np.asarray(x).dtype
+                              if not hasattr(x, "dtype") else x.dtype)
+                     for x in xs]
+            self._shape_cache[key] = [
+                jax.ShapeDtypeStruct(o.shape, o.dtype)
+                for o in self.host_run(*probe)]
+        return self._shape_cache[key]
+
     def host_run(self, *xs):
         self._ensure()
         tf = self.tf
@@ -218,13 +263,23 @@ class _CallbackTF:
             outs = self._fn(*[tf.constant(np.asarray(x)) for x in xs])
         return tuple(np.asarray(o) for o in outs)
 
+    def host_grad(self, xs, gs):
+        self._ensure()
+        tf = self.tf
+        with tf.device("/CPU:0"):
+            ts = [tf.constant(np.asarray(x)) for x in xs]
+            with tf.GradientTape() as tape:
+                for t in ts:
+                    tape.watch(t)
+                outs = self._fn(*ts)
+                target = tf.add_n([
+                    tf.reduce_sum(o * tf.constant(np.asarray(g)))
+                    for o, g in zip(outs, gs)])
+            grads = tape.gradient(target, ts)
+        return tuple(
+            np.zeros(np.shape(x), np.float32) if g is None
+            else np.asarray(g).astype(np.asarray(x).dtype)
+            for x, g in zip(xs, grads))
+
     def __call__(self, xs):
-        probe = [np.zeros(x.shape, np.asarray(x).dtype
-                          if not hasattr(x, "dtype") else x.dtype)
-                 for x in xs]
-        shapes = [jax.ShapeDtypeStruct(o.shape, o.dtype)
-                  for o in self.host_run(*probe)]
-        out = jax.pure_callback(
-            lambda *a: self.host_run(*a), tuple(shapes), *xs,
-            vmap_method="sequential")
-        return list(out)
+        return list(self._apply(tuple(xs)))

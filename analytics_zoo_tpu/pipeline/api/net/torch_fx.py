@@ -45,6 +45,17 @@ def _torch_sum(x, dim=None, keepdim=False, **kw):
     return jnp.sum(x, axis=dim, keepdims=keepdim)
 
 
+def _torch_expand(x, *sizes):
+    if len(sizes) == 1 and isinstance(sizes[0], (tuple, list)):
+        sizes = tuple(sizes[0])
+    # torch aligns trailing dims; -1 keeps the existing size
+    offset = len(sizes) - x.ndim
+    shape = tuple(
+        x.shape[i - offset] if d == -1 else d
+        for i, d in enumerate(sizes))
+    return jnp.broadcast_to(x, shape)
+
+
 # ---------------------------------------------------------------------------
 # module converters: (module, params_prefix) -> fn(params, x)
 # ---------------------------------------------------------------------------
@@ -272,8 +283,7 @@ class TorchFxConverter:
             jnp.split(x, range(size, x.shape[dim], size), axis=dim)),
         "softmax": lambda x, dim=-1: jax.nn.softmax(x, axis=dim),
         "masked_fill": lambda x, mask, v: jnp.where(mask, v, x),
-        "expand": lambda x, *s: jnp.broadcast_to(
-            x, tuple(x.shape[i] if d == -1 else d for i, d in enumerate(s))),
+        "expand": _torch_expand,
         "pow": jnp.power,
         "clamp": lambda x, min=None, max=None: jnp.clip(x, min, max),
     }

@@ -179,13 +179,14 @@ def _gemm(attrs, inputs):
 # -- conv / pool (ONNX is NCHW; lowered directly, XLA relayouts for TPU) ---
 
 
-def _conv_pads(attrs, spatial, kernel, strides, dilations):
+def _conv_pads(attrs, spatial, kernel, strides, dilations, in_sizes):
     auto = attrs.get("auto_pad", "NOTSET")
     if auto in ("SAME_UPPER", "SAME_LOWER"):
         pads = []
         for i in range(spatial):
             eff = (kernel[i] - 1) * dilations[i] + 1
-            total = max(eff - strides[i], 0)
+            out = -(-in_sizes[i] // strides[i])  # ceil div
+            total = max((out - 1) * strides[i] + eff - in_sizes[i], 0)
             lo = total // 2
             hi = total - lo
             pads.append((hi, lo) if auto == "SAME_LOWER" else (lo, hi))
@@ -208,7 +209,7 @@ def _conv(attrs, inputs):
     strides = [int(s) for s in attrs.get("strides", [1] * spatial)]
     dil = [int(d) for d in attrs.get("dilations", [1] * spatial)]
     groups = int(attrs.get("group", 1))
-    pads = _conv_pads(attrs, spatial, kernel, strides, dil)
+    pads = _conv_pads(attrs, spatial, kernel, strides, dil, x.shape[2:])
     dn = _conv_dn(x, w, spatial)
     out = lax.conv_general_dilated(
         x, w, window_strides=strides, padding=pads, rhs_dilation=dil,
@@ -225,7 +226,7 @@ def _conv_transpose(attrs, inputs):
     strides = [int(s) for s in attrs.get("strides", [1] * spatial)]
     kernel = attrs.get("kernel_shape", list(w.shape[2:]))
     pads = _conv_pads(attrs, spatial, kernel, strides,
-                      [1] * spatial)
+                      [1] * spatial, x.shape[2:])
     # ONNX deconv kernel layout is (C_in, C_out, ...spatial) = IO + spatial
     sp = "XYZ"[:spatial]
     dims = ("NC" + sp, "IO" + sp, "NC" + sp)
@@ -243,7 +244,8 @@ def _pool(attrs, x, reducer, init, is_avg=False):
     spatial = x.ndim - 2
     kernel = [int(k) for k in attrs["kernel_shape"]]
     strides = [int(s) for s in attrs.get("strides", [1] * spatial)]
-    pads = _conv_pads(attrs, spatial, kernel, strides, [1] * spatial)
+    pads = _conv_pads(attrs, spatial, kernel, strides, [1] * spatial,
+                      x.shape[2:])
     window = (1, 1) + tuple(kernel)
     strd = (1, 1) + tuple(strides)
     pad = ((0, 0), (0, 0)) + tuple(pads)
@@ -325,7 +327,7 @@ def _lrn(attrs, inputs):
     alpha = attrs.get("alpha", 1e-4)
     beta = attrs.get("beta", 0.75)
     bias = attrs.get("bias", 1.0)
-    half = size // 2
+    half = (size - 1) // 2  # ONNX: floor((size-1)/2) before, rest after
     sq = x * x
     pads = ((0, 0), (half, size - 1 - half)) + ((0, 0),) * (x.ndim - 2)
     window = (1, size) + (1,) * (x.ndim - 2)
@@ -539,5 +541,12 @@ def _argmin(attrs, inputs):
 @op("TopK")
 def _topk(attrs, inputs):
     k = int(attrs.get("k", _ints(inputs[1])[0] if len(inputs) > 1 else 1))
-    vals, idx = lax.top_k(inputs[0], k)
-    return [vals, idx.astype(jnp.int64)]
+    axis = int(attrs.get("axis", -1))
+    largest = int(attrs.get("largest", 1))
+    x = inputs[0]
+    moved = jnp.moveaxis(x, axis, -1)
+    vals, idx = lax.top_k(-moved if not largest else moved, k)
+    if not largest:
+        vals = -vals
+    return [jnp.moveaxis(vals, -1, axis),
+            jnp.moveaxis(idx, -1, axis).astype(jnp.int64)]

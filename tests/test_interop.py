@@ -146,6 +146,43 @@ class TestOnnxImporter:
         assert after < before
 
 
+class TestOnnxOpSemantics:
+    def test_same_upper_conv_pads(self, tmp_path):
+        # kernel 3, stride 2, width 5: ONNX SAME_UPPER gives out=ceil(5/2)=3
+        torch.manual_seed(3)
+        conv = nn.Conv2d(1, 2, 3, stride=2)
+        w = conv.weight.detach().numpy()
+        b = conv.bias.detach().numpy()
+        nodes = [builder.make_node("Conv", ["x", "w", "b"], ["y"],
+                                   auto_pad="SAME_UPPER",
+                                   kernel_shape=[3, 3], strides=[2, 2])]
+        g = builder.make_graph(
+            nodes, "sconv", [builder.value_info("x", (None, 1, 5, 5))],
+            [builder.value_info("y", (None, 2, 3, 3))], {"w": w, "b": b})
+        path = str(tmp_path / "s.onnx")
+        builder.save_model(builder.make_model(g), path)
+        model = OnnxLoader.from_path(path)
+        x = np.random.default_rng(4).standard_normal(
+            (1, 1, 5, 5)).astype(np.float32)
+        out = np.asarray(model.predict(x, batch_size=1))
+        assert out.shape == (1, 2, 3, 3)
+        # torch equivalent: pad (1,2)x(1,2) asymmetric = F.pad then conv
+        import torch.nn.functional as F
+        t = F.pad(torch.from_numpy(x), (1, 2, 1, 2))
+        ref = conv(t).detach().numpy()[:, :, :3, :3]
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_topk_axis_and_smallest(self):
+        from analytics_zoo_tpu.pipeline.api.onnx.ops import REGISTRY
+
+        x = np.asarray([[5.0, 1.0], [3.0, 4.0], [2.0, 9.0]])
+        vals, idx = REGISTRY["TopK"]({"axis": 0, "k": 2}, [x])
+        np.testing.assert_allclose(np.asarray(vals),
+                                   [[5.0, 9.0], [3.0, 4.0]])
+        vals, _ = REGISTRY["TopK"]({"axis": 0, "k": 1, "largest": 0}, [x])
+        np.testing.assert_allclose(np.asarray(vals), [[2.0, 1.0]])
+
+
 class TestTorchNet:
     def _module(self):
         torch.manual_seed(0)
@@ -219,6 +256,20 @@ class TestTFNet:
         np.testing.assert_allclose(net.predict(x), ref, atol=1e-5)
         # float consts imported as trainable params
         assert net.build(None, None)
+
+    def test_callback_mode_input_grads(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+
+        m, path = self._keras_h5(tmp_path)
+        net = TFNet.from_keras(path, lower=False)
+        assert net.mode == "callback"
+        x = np.random.default_rng(7).standard_normal((2, 8)).astype(
+            np.float32)
+        np.testing.assert_allclose(net.predict(x), m(x).numpy(), atol=1e-5)
+        g = jax.grad(
+            lambda q: jnp.sum(net.call({}, [q]) ** 2))(jnp.asarray(x))
+        assert float(jnp.abs(g).sum()) > 0
 
     def test_net_facade(self, tmp_path):
         m, path = self._keras_h5(tmp_path)
