@@ -208,7 +208,21 @@ class SigmoidCrossEntropyWithLogits(LossFunction):
 
 
 # String registry — mirrors KerasUtils.toBigDLCriterion:180.
+class Identity(LossFunction):
+    """The prediction IS the loss — used by TFPark's TFOptimizer, where an
+    imported graph computes its own scalar objective (tf_optimizer.py:422
+    from_loss parity)."""
+
+    def per_sample(self, y_pred, y_true):
+        if y_pred.ndim == 0:  # graph already reduced over the batch
+            batch = y_true.shape[0] if y_true is not None and \
+                getattr(y_true, "ndim", 0) > 0 else 1
+            return jnp.broadcast_to(y_pred, (batch,))
+        return _flat_mean(y_pred)
+
+
 _LOSSES = {
+    "identity": Identity,
     "binary_crossentropy": BinaryCrossEntropy,
     "categorical_crossentropy": CategoricalCrossEntropy,
     "mse": MeanSquaredError,

@@ -79,8 +79,10 @@ def _nhwc_pool_args(attrs):
 
 
 @tf_op("Identity", "StopGradient", "PreventGradient", "CheckNumerics",
-       "EnsureShape", "Snapshot")
+       "EnsureShape", "Snapshot", "ReadVariableOp")
 def _identity(attrs, ins):
+    # ReadVariableOp: the resource placeholder's env entry IS the value
+    # (capture-based lowering feeds variable arrays straight in).
     return [ins[0]]
 
 
@@ -180,6 +182,27 @@ def _log_softmax(attrs, ins):
 @tf_op("Select", "SelectV2")
 def _select(attrs, ins):
     return [jnp.where(ins[0], ins[1], ins[2])]
+
+
+@tf_op("SparseSoftmaxCrossEntropyWithLogits")
+def _sparse_softmax_xent(attrs, ins):
+    logits, labels = ins
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(
+        logp, jnp.asarray(labels, jnp.int32)[..., None], axis=-1)[..., 0]
+    backprop = jax.nn.softmax(logits, axis=-1) - jax.nn.one_hot(
+        jnp.asarray(labels, jnp.int32), logits.shape[-1],
+        dtype=logits.dtype)
+    return [-picked, backprop]
+
+
+@tf_op("SoftmaxCrossEntropyWithLogits")
+def _softmax_xent(attrs, ins):
+    logits, labels = ins
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    loss = -jnp.sum(labels * logp, axis=-1)
+    backprop = jax.nn.softmax(logits, axis=-1) - labels
+    return [loss, backprop]
 
 
 @tf_op("Cast")
@@ -483,11 +506,19 @@ class TFGraphFunction:
     """
 
     def __init__(self, graph_def, input_names: List[str],
-                 output_names: List[str]):
+                 output_names: List[str],
+                 captures: Dict[str, np.ndarray] = None,
+                 trainable_captures: List[str] = None):
+        """``captures``: placeholder-name → value for tensors captured from
+        outside the graph (tf.function variable reads). When given, *they*
+        are the trainable params (exact tf.Variable correspondence) and
+        Const nodes stay baked; otherwise float Consts are trainable (the
+        frozen-graph path)."""
         self.input_names = [n.split(":")[0] for n in input_names]
         self.output_names = list(output_names)
         self.nodes = list(graph_def.node)
         byname = {n.name: n for n in self.nodes}
+        self.captures = dict(captures or {})
         self.consts: Dict[str, np.ndarray] = {}
         unsupported = set()
         for n in self.nodes:
@@ -499,17 +530,26 @@ class TFGraphFunction:
         if unsupported:
             raise UnsupportedTFGraph(
                 f"unsupported TF ops: {sorted(unsupported)}")
-        # trainable = float consts; ints/bools stay baked (shape machinery)
-        self.param_names = [k for k, v in self.consts.items()
-                            if np.issubdtype(v.dtype, np.floating)]
+        if captures:
+            self.param_names = list(
+                trainable_captures if trainable_captures is not None
+                else captures)
+        else:
+            # trainable = float consts; ints/bools stay baked (shapes)
+            self.param_names = [k for k, v in self.consts.items()
+                                if np.issubdtype(v.dtype, np.floating)]
         self._byname = byname
 
     def init_params(self):
-        return {k: jnp.asarray(self.consts[k]) for k in self.param_names}
+        src = self.captures if self.captures else self.consts
+        return {k: jnp.asarray(src[k]) for k in self.param_names}
 
     def __call__(self, params, *inputs):
         env: Dict[str, Any] = {k: v for k, v in self.consts.items()
                                if k not in params}
+        for k, v in self.captures.items():
+            if k not in params:
+                env[k] = v
         env.update(params)
         for name, x in zip(self.input_names, inputs):
             env[name] = x

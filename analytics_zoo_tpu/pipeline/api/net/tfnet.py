@@ -218,14 +218,21 @@ class _CallbackTF:
             return apply(xs), xs
 
         def bwd(xs, gs):
-            shapes = [jax.ShapeDtypeStruct(
-                np.shape(x), np.asarray(x).dtype
-                if not hasattr(x, "dtype") else x.dtype) for x in xs]
-            gx = jax.pure_callback(
-                lambda a, g: tuple(self.host_grad(list(a), list(g))),
+            from .torchnet import _is_int, _zero_cotangent
+
+            shapes = [jax.ShapeDtypeStruct(np.shape(x), np.float32)
+                      for x in xs]
+            out = jax.pure_callback(
+                lambda a, g: tuple(
+                    np.asarray(v, np.float32)
+                    for v in self.host_grad(list(a), list(g))),
                 tuple(shapes), tuple(xs), tuple(gs),
                 vmap_method="sequential")
-            return (tuple(gx),)
+            gx = tuple(
+                _zero_cotangent(x) if _is_int(x)
+                else g.astype(getattr(x, "dtype", np.float32))
+                for x, g in zip(xs, out))
+            return (gx,)
 
         apply.defvjp(fwd, bwd)
         self._apply = apply
@@ -278,7 +285,7 @@ class _CallbackTF:
             grads = tape.gradient(target, ts)
         return tuple(
             np.zeros(np.shape(x), np.float32) if g is None
-            else np.asarray(g).astype(np.asarray(x).dtype)
+            else np.asarray(g, np.float32)
             for x, g in zip(xs, grads))
 
     def __call__(self, xs):

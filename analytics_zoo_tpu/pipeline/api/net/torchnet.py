@@ -161,19 +161,24 @@ class TorchNet(KerasLayer):
 
         def bwd(res, gs):
             flat_params, xs = res
-            flats = list(flat_params) + list(xs)
-            shapes = [jax.ShapeDtypeStruct(np.shape(x), _dt(x))
+            # callbacks can't emit float0; fetch float32 grads for all
+            # inputs, then swap integer-primal slots to float0 zeros
+            shapes = [jax.ShapeDtypeStruct(np.shape(x), np.float32)
                       for x in xs] + \
                      [jax.ShapeDtypeStruct(np.shape(p), np.float32)
                       for p in flat_params]
             out = jax.pure_callback(
                 lambda p, x, g: tuple(
+                    np.asarray(a, np.float32) for a in
                     runner.backward(list(p), list(x), list(g))),
                 tuple(shapes), tuple(flat_params), tuple(xs), tuple(gs),
                 vmap_method="sequential")
             n_x = len(xs)
-            gx, gp = out[:n_x], out[n_x:]
-            return tuple(gp), tuple(gx)
+            gx = tuple(
+                _zero_cotangent(x) if _is_int(x) else g.astype(_dt(x))
+                for x, g in zip(xs, out[:n_x]))
+            gp = out[n_x:]
+            return tuple(gp), gx
 
         apply.defvjp(fwd, bwd)
         # flat param order MUST match named_parameters(): forward's _load and
@@ -225,6 +230,20 @@ def _dt(x):
     return np.asarray(x).dtype if not hasattr(x, "dtype") else x.dtype
 
 
+def _is_int(x):
+    dt = _dt(x)
+    return np.issubdtype(dt, np.integer) or dt == np.bool_
+
+
+def _zero_cotangent(primal):
+    """Zero cotangent with the dtype custom_vjp demands: float0 for
+    integer/bool primals, zeros otherwise."""
+    dt = _dt(primal)
+    if np.issubdtype(dt, np.integer) or dt == np.bool_:
+        return np.zeros(np.shape(primal), jax.dtypes.float0)
+    return jnp.zeros(np.shape(primal), dt)
+
+
 def _torch_result_shapes(runner, xs):
     probe = [np.zeros(np.shape(x), _dt(x)) for x in xs]
     outs = runner.forward(
@@ -262,7 +281,7 @@ class TorchCriterion:
                 self._host_grad,
                 jax.ShapeDtypeStruct(np.shape(y_pred), np.float32),
                 y_true, y_pred, vmap_method="sequential")
-            return jnp.zeros_like(y_true), g * gp
+            return _zero_cotangent(y_true), g * gp
 
         apply.defvjp(fwd, bwd)
         self._apply = apply

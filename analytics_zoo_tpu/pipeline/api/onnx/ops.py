@@ -225,8 +225,18 @@ def _conv_transpose(attrs, inputs):
     spatial = x.ndim - 2
     strides = [int(s) for s in attrs.get("strides", [1] * spatial)]
     kernel = attrs.get("kernel_shape", list(w.shape[2:]))
-    pads = _conv_pads(attrs, spatial, kernel, strides,
-                      [1] * spatial, x.shape[2:])
+    auto = attrs.get("auto_pad", "NOTSET")
+    if auto in ("SAME_UPPER", "SAME_LOWER"):
+        # deconv SAME: output = input * stride, total pad = eff - stride
+        pads = []
+        for i in range(spatial):
+            total = max(kernel[i] - strides[i], 0)
+            lo = total // 2
+            hi = total - lo
+            pads.append((hi, lo) if auto == "SAME_LOWER" else (lo, hi))
+    else:
+        p = attrs.get("pads", [0] * (2 * spatial))
+        pads = [(int(p[i]), int(p[i + spatial])) for i in range(spatial)]
     # ONNX deconv kernel layout is (C_in, C_out, ...spatial) = IO + spatial
     sp = "XYZ"[:spatial]
     dims = ("NC" + sp, "IO" + sp, "NC" + sp)

@@ -16,35 +16,7 @@ import threading
 import time
 from typing import Optional
 
-# ---------------------------------------------------------------------------
-# crc32c (software, table-based) + TFRecord masking
-# ---------------------------------------------------------------------------
-
-_CRC_TABLE = []
-
-
-def _make_table():
-    poly = 0x82F63B78
-    for n in range(256):
-        crc = n
-        for _ in range(8):
-            crc = (crc >> 1) ^ (poly if crc & 1 else 0)
-        _CRC_TABLE.append(crc)
-
-
-_make_table()
-
-
-def crc32c(data: bytes) -> int:
-    crc = 0xFFFFFFFF
-    for b in data:
-        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
-    return crc ^ 0xFFFFFFFF
-
-
-def _masked_crc(data: bytes) -> int:
-    crc = crc32c(data)
-    return ((((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF)
+from .crc32c import crc32c, masked_crc as _masked_crc  # noqa: F401
 
 
 # ---------------------------------------------------------------------------

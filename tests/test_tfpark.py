@@ -1,0 +1,191 @@
+"""TFPark tests: KerasModel, TFOptimizer, TFEstimator, TFDataset, TFRecord.
+
+Golden strategy per SURVEY.md §4: lowered TF models must match tf.keras
+numerics, training must reduce loss, and trained weights must land back in
+the live TF objects.
+"""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from analytics_zoo_tpu.feature.feature_set import ArrayFeatureSet  # noqa
+from analytics_zoo_tpu.feature.tfrecord import (read_tfrecord,  # noqa
+                                                write_tfrecord)
+from analytics_zoo_tpu.tfpark import (KerasModel, ModeKeys, TFDataset,  # noqa
+                                      TFEstimator, TFEstimatorSpec,
+                                      TFOptimizer)
+
+
+def _keras_mlp(seed=0, classes=2, dim=6):
+    tf.keras.utils.set_random_seed(seed)
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input((dim,)),
+        tf.keras.layers.Dense(16, activation="relu"),
+        tf.keras.layers.Dense(classes, activation="softmax")])
+    m.compile(optimizer=tf.keras.optimizers.Adam(1e-2),
+              loss="sparse_categorical_crossentropy")
+    return m
+
+
+def _toy_data(n=128, dim=6, classes=2, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, dim)).astype(np.float32)
+    w = rng.standard_normal((dim, classes))
+    y = np.argmax(x @ w, axis=1).astype(np.int32)
+    return x, y
+
+
+class TestKerasModel:
+    def test_predict_matches_tf(self):
+        m = _keras_mlp()
+        km = KerasModel(m)
+        x, _ = _toy_data(32)
+        ref = m(x).numpy()
+        out = np.asarray(km.predict(x, batch_per_thread=32))
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_fit_improves_and_writes_back(self):
+        m = _keras_mlp(seed=1)
+        km = KerasModel(m)
+        x, y = _toy_data(256, seed=1)
+        before = km.evaluate(x, y, batch_per_thread=64)["loss"]
+        km.fit(x, y, batch_size=64, epochs=15)
+        after = km.evaluate(x, y, batch_per_thread=64)["loss"]
+        assert after < before
+        # write-back: the LIVE tf.keras model must now match the trained
+        # jax params
+        tf_after = float(m.compute_loss(
+            y=tf.constant(y), y_pred=m(x)).numpy()) if hasattr(
+            m, "compute_loss") else None
+        jax_preds = np.asarray(km.predict(x, batch_per_thread=64))
+        tf_preds = m(x).numpy()
+        np.testing.assert_allclose(jax_preds, tf_preds, atol=1e-4)
+
+    def test_tfdataset_path(self):
+        m = _keras_mlp(seed=2)
+        km = KerasModel(m)
+        x, y = _toy_data(128, seed=2)
+        ds = TFDataset.from_ndarrays((x, y), batch_size=32)
+        km.fit(ds, epochs=3)
+        acc = np.mean(
+            np.argmax(np.asarray(km.predict(x)), axis=1) == y)
+        assert acc > 0.5
+
+
+class TestTFOptimizer:
+    def test_from_loss_trains_variables(self):
+        # least squares in raw TF: loss = mean((x@w - y)^2)
+        w = tf.Variable(tf.zeros((4, 1)), name="w")
+
+        def loss_fn(x, y):
+            return tf.reduce_mean(tf.square(tf.matmul(x, w) - y))
+
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((256, 4)).astype(np.float32)
+        true_w = np.asarray([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+        y = x @ true_w
+        from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+
+        ds = TFDataset.from_ndarrays((x, y), batch_size=64)
+        opt = TFOptimizer.from_loss(loss_fn, ds, variables=[w],
+                                    optim_method=Adam(lr=0.1))
+        from analytics_zoo_tpu.common.zoo_trigger import MaxEpoch
+        opt.optimize(end_trigger=MaxEpoch(60))
+        got = w.numpy()
+        assert np.abs(got - true_w).max() < 0.5
+
+    def test_from_keras(self):
+        m = _keras_mlp(seed=3)
+        x, y = _toy_data(128, seed=3)
+        ds = TFDataset.from_ndarrays((x, y), batch_size=32)
+        TFOptimizer.from_keras(m, ds).optimize()
+
+
+class TestTFEstimator:
+    def test_train_eval_predict(self):
+        def model_fn(features, labels, mode, params):
+            logits = tf.keras.layers.Dense(2, name="head")(features)
+            preds = tf.nn.softmax(logits)
+            loss = tf.reduce_mean(
+                tf.nn.sparse_softmax_cross_entropy_with_logits(
+                    labels=tf.cast(labels, tf.int32), logits=logits))
+            return TFEstimatorSpec(mode, predictions=preds, loss=loss)
+
+        x, y = _toy_data(128, dim=6, seed=4)
+        ds = TFDataset.from_ndarrays((x, y), batch_size=32)
+        est = TFEstimator(model_fn, optimizer="adam")
+        before = est.train(ds, end_trigger=None) and \
+            est.evaluate(ds)["loss"]
+        est.train(ds, batch_size=32,
+                  end_trigger=__import__(
+                      "analytics_zoo_tpu.common.zoo_trigger",
+                      fromlist=["MaxEpoch"]).MaxEpoch(20))
+        after = est.evaluate(ds)["loss"]
+        assert after < before
+        preds = est.predict(ds)
+        assert preds.shape == (128, 2)
+        acc = np.mean(np.argmax(preds, axis=1) == y)
+        assert acc > 0.6
+
+
+class TestGANEstimator:
+    def test_learns_1d_gaussian(self):
+        from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+        from analytics_zoo_tpu.pipeline.api.keras.models import Sequential
+        from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+        from analytics_zoo_tpu.tfpark import GANEstimator
+
+        gen = Sequential()
+        gen.add(Dense(16, activation="relu", input_shape=(4,)))
+        gen.add(Dense(1))
+        disc = Sequential()
+        disc.add(Dense(16, activation="relu", input_shape=(1,)))
+        disc.add(Dense(1))
+
+        rng = np.random.default_rng(0)
+        real = (rng.standard_normal((512, 1)) * 0.5 + 3.0).astype(
+            np.float32)
+        est = GANEstimator(gen, disc,
+                           generator_optimizer=Adam(lr=5e-3),
+                           discriminator_optimizer=Adam(lr=5e-3),
+                           noise_dim=4)
+        est.train(real, steps=150, batch_size=64)
+        samples = est.generate(256)
+        # generator should move its output mean toward the target (3.0)
+        assert abs(float(samples.mean()) - 3.0) < 1.0
+
+
+class TestTFRecord:
+    def test_roundtrip_with_crc(self, tmp_path):
+        path = str(tmp_path / "data.tfrecord")
+        records = [bytes([i]) * (i + 1) for i in range(10)]
+        assert write_tfrecord(path, records) == 10
+        back = list(read_tfrecord(path, verify_crc=True))
+        assert back == records
+
+    def test_tf_compat(self, tmp_path):
+        # our reader parses files written by TF, and vice versa
+        path = str(tmp_path / "tf.tfrecord")
+        with tf.io.TFRecordWriter(path) as w:
+            for i in range(5):
+                w.write(f"rec{i}".encode())
+        ours = list(read_tfrecord(path, verify_crc=True))
+        assert ours == [f"rec{i}".encode() for i in range(5)]
+
+        path2 = str(tmp_path / "ours.tfrecord")
+        write_tfrecord(path2, [b"abc", b"defg"])
+        theirs = [r.numpy() for r in tf.data.TFRecordDataset(path2)]
+        assert theirs == [b"abc", b"defg"]
+
+    def test_from_tfrecord_file(self, tmp_path):
+        path = str(tmp_path / "x.tfrecord")
+        write_tfrecord(path, [np.float32(i).tobytes() for i in range(8)])
+
+        def parse(rec):
+            return (np.frombuffer(rec, np.float32),
+                    np.zeros((1,), np.float32))
+
+        ds = TFDataset.from_tfrecord_file(path, parse, batch_size=4)
+        assert len(ds) == 8
