@@ -162,7 +162,23 @@ class Reshape(KerasLayer):
         return x.reshape((x.shape[0],) + self.target_shape)
 
     def compute_output_shape(self, input_shape):
-        return (input_shape[0],) + self.target_shape
+        target = self.target_shape
+        if -1 in target:
+            if target.count(-1) > 1:
+                raise ValueError(f"Reshape{target}: at most one -1 allowed")
+            known = 1
+            for d in input_shape[1:]:
+                known *= int(d)
+            fixed = 1
+            for d in target:
+                if d != -1:
+                    fixed *= d
+            if known % fixed != 0:
+                raise ValueError(
+                    f"cannot Reshape {tuple(input_shape[1:])} "
+                    f"({known} elements) into {target}")
+            target = tuple(known // fixed if d == -1 else d for d in target)
+        return (input_shape[0],) + target
 
 
 class Permute(KerasLayer):

@@ -1,0 +1,25 @@
+"""Keras-2 style API (reference: ``zoo/.../pipeline/api/keras2/``).
+
+The reference ships a Keras-2-flavored subset (21 layer files) alongside the
+Keras-1 API — same engine, Keras-2 argument names (``units``, ``filters``,
+``kernel_size``, ``padding``, ``rate``...). Here each keras2 layer is a thin
+constructor adapter over the keras layer library; models/training are shared.
+"""
+
+from .layers import (Activation, Add, Average, AveragePooling1D,
+                     AveragePooling2D, BatchNormalization, Concatenate,
+                     Conv1D, Conv2D, Dense, Dropout, Embedding, Flatten,
+                     GlobalAveragePooling1D, GlobalAveragePooling2D,
+                     GlobalMaxPooling1D, GlobalMaxPooling2D, Input,
+                     MaxPooling1D, MaxPooling2D, Maximum, Multiply,
+                     SeparableConv2D)
+from .models import Model, Sequential
+
+__all__ = [
+    "Input", "Dense", "Conv1D", "Conv2D", "SeparableConv2D", "Activation",
+    "Dropout", "Flatten", "Embedding", "BatchNormalization", "MaxPooling1D",
+    "MaxPooling2D", "AveragePooling1D", "AveragePooling2D",
+    "GlobalMaxPooling1D", "GlobalMaxPooling2D", "GlobalAveragePooling1D",
+    "GlobalAveragePooling2D", "Add", "Multiply", "Average", "Maximum",
+    "Concatenate", "Model", "Sequential",
+]

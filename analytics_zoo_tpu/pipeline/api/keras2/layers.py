@@ -1,0 +1,167 @@
+"""Keras-2 layer adapters.
+
+Parity: ``zoo/.../pipeline/api/keras2/layers/*.scala`` (Dense.scala,
+Conv.scala, pooling, merge) and ``pyzoo/zoo/pipeline/api/keras2/layers``.
+Each adapter translates Keras-2 argument names onto the keras-1 layer
+library — one engine, two argument dialects, matching the reference's
+keras2 design.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+from ..keras import layers as k1
+from ..keras.engine.base import Input  # re-export (same object)
+
+_PADDING = {"valid": "valid", "same": "same"}
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def Dense(units: int, activation=None, use_bias: bool = True,
+          kernel_initializer="glorot_uniform", input_shape=None,
+          name: Optional[str] = None, **kw):
+    return k1.Dense(units, init=kernel_initializer, activation=activation,
+                    bias=use_bias, input_shape=input_shape, name=name)
+
+
+def Conv1D(filters: int, kernel_size: int, strides: int = 1,
+           padding: str = "valid", activation=None, use_bias: bool = True,
+           kernel_initializer="glorot_uniform", input_shape=None,
+           name=None, **kw):
+    return k1.Convolution1D(
+        filters, kernel_size, init=kernel_initializer,
+        activation=activation, border_mode=_PADDING[padding],
+        subsample_length=strides, bias=use_bias,
+        input_shape=input_shape, name=name)
+
+
+def Conv2D(filters: int, kernel_size, strides=(1, 1), padding="valid",
+           activation=None, use_bias: bool = True,
+           kernel_initializer="glorot_uniform", input_shape=None,
+           name=None, **kw):
+    kh, kw_ = _pair(kernel_size)
+    return k1.Convolution2D(
+        filters, kh, kw_, init=kernel_initializer, activation=activation,
+        border_mode=_PADDING[padding], subsample=_pair(strides),
+        bias=use_bias, input_shape=input_shape, name=name)
+
+
+def SeparableConv2D(filters: int, kernel_size, strides=(1, 1),
+                    padding="valid", activation=None, use_bias=True,
+                    depth_multiplier: int = 1, input_shape=None,
+                    name=None, **kw):
+    kh, kw_ = _pair(kernel_size)
+    return k1.SeparableConvolution2D(
+        filters, kh, kw_, activation=activation,
+        border_mode=_PADDING[padding], subsample=_pair(strides),
+        depth_multiplier=depth_multiplier, bias=use_bias,
+        input_shape=input_shape, name=name)
+
+
+def Activation(activation, input_shape=None, name=None, **kw):
+    return k1.Activation(activation, input_shape=input_shape, name=name)
+
+
+def Dropout(rate: float, input_shape=None, name=None, **kw):
+    return k1.Dropout(rate, input_shape=input_shape, name=name)
+
+
+def Flatten(input_shape=None, name=None, **kw):
+    return k1.Flatten(input_shape=input_shape, name=name)
+
+
+def Embedding(input_dim: int, output_dim: int,
+              embeddings_initializer="uniform", input_length=None,
+              input_shape=None, name=None, **kw):
+    return k1.Embedding(input_dim, output_dim,
+                        init=embeddings_initializer,
+                        input_length=input_length,
+                        input_shape=input_shape, name=name)
+
+
+def BatchNormalization(axis: int = 1, momentum: float = 0.99,
+                       epsilon: float = 1e-3, input_shape=None,
+                       name=None, **kw):
+    return k1.BatchNormalization(epsilon=epsilon, momentum=momentum,
+                                 axis=axis, input_shape=input_shape,
+                                 name=name)
+
+
+def MaxPooling1D(pool_size: int = 2, strides=None, padding="valid",
+                 input_shape=None, name=None, **kw):
+    return k1.MaxPooling1D(pool_length=pool_size, stride=strides,
+                           border_mode=_PADDING[padding],
+                           input_shape=input_shape, name=name)
+
+
+def MaxPooling2D(pool_size=(2, 2), strides=None, padding="valid",
+                 input_shape=None, name=None, **kw):
+    return k1.MaxPooling2D(pool_size=_pair(pool_size),
+                           strides=None if strides is None
+                           else _pair(strides),
+                           border_mode=_PADDING[padding],
+                           input_shape=input_shape, name=name)
+
+
+def AveragePooling1D(pool_size: int = 2, strides=None, padding="valid",
+                     input_shape=None, name=None, **kw):
+    return k1.AveragePooling1D(pool_length=pool_size, stride=strides,
+                               border_mode=_PADDING[padding],
+                               input_shape=input_shape, name=name)
+
+
+def AveragePooling2D(pool_size=(2, 2), strides=None, padding="valid",
+                     input_shape=None, name=None, **kw):
+    return k1.AveragePooling2D(pool_size=_pair(pool_size),
+                               strides=None if strides is None
+                               else _pair(strides),
+                               border_mode=_PADDING[padding],
+                               input_shape=input_shape, name=name)
+
+
+def GlobalMaxPooling1D(input_shape=None, name=None, **kw):
+    return k1.GlobalMaxPooling1D(input_shape=input_shape, name=name)
+
+
+def GlobalMaxPooling2D(input_shape=None, name=None, **kw):
+    return k1.GlobalMaxPooling2D(input_shape=input_shape, name=name)
+
+
+def GlobalAveragePooling1D(input_shape=None, name=None, **kw):
+    return k1.GlobalAveragePooling1D(input_shape=input_shape, name=name)
+
+
+def GlobalAveragePooling2D(input_shape=None, name=None, **kw):
+    return k1.GlobalAveragePooling2D(input_shape=input_shape, name=name)
+
+
+# -- functional merges (keras-2 style: callable on a list) -----------------
+
+from ..keras.layers.merge import (Add as _Add, Average as _Average,  # noqa
+                                  Concatenate as _Concatenate,
+                                  Maximum as _Maximum,
+                                  Multiply as _Multiply)
+
+
+def Add(name=None, **kw):
+    return _Add(name=name)
+
+
+def Multiply(name=None, **kw):
+    return _Multiply(name=name)
+
+
+def Average(name=None, **kw):
+    return _Average(name=name)
+
+
+def Maximum(name=None, **kw):
+    return _Maximum(name=name)
+
+
+def Concatenate(axis: int = -1, name=None, **kw):
+    return _Concatenate(axis=axis, name=name)

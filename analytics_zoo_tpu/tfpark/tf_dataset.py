@@ -73,20 +73,35 @@ class TFDataset:
         if image_transformer is not None:
             image_set = image_set.transform(image_transformer)
         feats, labels = [], []
-        for feat in image_set.to_local():
-            feats.append(feat.to_sample().features[0])
-            lab = feat.get(label_key)
-            if lab is not None:
-                labels.append(lab)
-        fs = ArrayFeatureSet([np.stack(feats)],
-                             [np.asarray(labels)] if labels else None)
+        features = image_set.to_local().features
+        for feat in features:
+            sample = feat.get_sample()
+            if sample is None:
+                raise ValueError(
+                    "image features carry no Sample — the transformer "
+                    "chain must end in ImageSetToSample (or pass "
+                    "image_transformer ending in it)")
+            feats.append(sample.features[0])
+            labels.append(feat.get(label_key))
+        n_labeled = sum(l is not None for l in labels)
+        if 0 < n_labeled < len(features):
+            raise ValueError(
+                f"{n_labeled}/{len(features)} images have a "
+                f"'{label_key}' — labels must be all-or-nothing")
+        fs = ArrayFeatureSet(
+            [np.stack(feats)],
+            [np.asarray(labels)] if n_labeled else None)
         return cls(fs, batch_size=batch_size, **kw)
 
     @classmethod
     def from_text_set(cls, text_set, batch_size: int = 32,
                       **kw) -> "TFDataset":
-        """TextSet (word2idx'ed) → TFDataset."""
-        samples = [f.to_sample() for f in text_set.to_local()]
+        """TextSet (word2idx'ed + generate_sample'd) → TFDataset."""
+        samples = text_set.to_local().get_samples()
+        if any(s is None for s in samples):
+            raise ValueError(
+                "text features carry no Sample — run generate_sample() "
+                "on the TextSet first")
         return cls(FeatureSet.samples(samples), batch_size=batch_size, **kw)
 
     @classmethod

@@ -1,0 +1,63 @@
+"""Keras-2 API subset tests (reference keras2/ parity)."""
+
+import numpy as np
+
+from analytics_zoo_tpu.pipeline.api import keras2
+
+
+class TestKeras2:
+    def test_sequential_cnn(self):
+        model = keras2.Sequential()
+        model.add(keras2.Conv2D(8, 3, padding="same", activation="relu",
+                                input_shape=(1, 16, 16)))
+        model.add(keras2.MaxPooling2D(pool_size=2))
+        model.add(keras2.Flatten())
+        model.add(keras2.Dense(10, activation="softmax"))
+        x = np.random.default_rng(0).standard_normal(
+            (4, 1, 16, 16)).astype(np.float32)
+        out = np.asarray(model.predict(x, batch_size=4))
+        assert out.shape == (4, 10)
+        np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-4)
+
+    def test_functional_merge(self):
+        a = keras2.Input(shape=(8,), name="a")
+        b = keras2.Input(shape=(8,), name="b")
+        ha = keras2.Dense(4)(a)
+        hb = keras2.Dense(4)(b)
+        merged = keras2.Add()([ha, hb])
+        cat = keras2.Concatenate(axis=-1)([merged, hb])
+        out = keras2.Dense(2, activation="softmax")(cat)
+        model = keras2.Model([a, b], out)
+        xs = [np.random.default_rng(i).standard_normal(
+            (4, 8)).astype(np.float32) for i in range(2)]
+        pred = np.asarray(model.predict(xs, batch_size=4))
+        assert pred.shape == (4, 2)
+
+    def test_training_with_keras2_args(self):
+        model = keras2.Sequential()
+        model.add(keras2.Dense(16, activation="relu", input_shape=(6,),
+                               kernel_initializer="he_normal"))
+        model.add(keras2.Dropout(rate=0.1))
+        model.add(keras2.Dense(2, activation="softmax"))
+        from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+
+        model.compile(optimizer=Adam(lr=1e-2),
+                      loss="sparse_categorical_crossentropy",
+                      metrics=["accuracy"])
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((128, 6)).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.int32)
+        model.fit(x, y, batch_size=32, nb_epoch=25)
+        res = model.evaluate(x, y, batch_size=32)
+        assert res["accuracy"] > 0.8
+
+    def test_embedding_and_1d_stack(self):
+        model = keras2.Sequential()
+        model.add(keras2.Embedding(50, 8, input_length=12,
+                                   input_shape=(12,)))
+        model.add(keras2.Conv1D(4, 3, activation="relu"))
+        model.add(keras2.GlobalMaxPooling1D())
+        model.add(keras2.Dense(2, activation="softmax"))
+        x = np.random.default_rng(1).integers(0, 50, (4, 12))
+        out = np.asarray(model.predict(x, batch_size=4))
+        assert out.shape == (4, 2)

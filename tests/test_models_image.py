@@ -1,0 +1,141 @@
+"""Image model zoo tests: ImageClassifier backbones, SSD ObjectDetector."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.feature.image.image_set import ImageSet
+from analytics_zoo_tpu.models.image.imageclassification import (
+    ImageClassifier, backbones)
+from analytics_zoo_tpu.models.image.objectdetection import (
+    MultiBoxLoss, ObjectDetector, decode_boxes, generate_priors,
+    match_priors, nms)
+from analytics_zoo_tpu.models.image.objectdetection.ssd import encode_boxes
+
+
+class TestImageClassifier:
+    @pytest.mark.parametrize("name,shape", [
+        ("lenet", (1, 28, 28)),
+        ("squeezenet", (3, 64, 64)),
+        ("mobilenet", (3, 64, 64)),
+    ])
+    def test_backbones_forward(self, name, shape):
+        m = ImageClassifier(class_num=7, model_name=name, input_shape=shape)
+        x = np.random.default_rng(0).standard_normal(
+            (2,) + shape).astype(np.float32)
+        out = np.asarray(m.predict(x, batch_size=2))
+        assert out.shape == (2, 7)
+        np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-4)
+
+    def test_resnet50_builds(self):
+        m = ImageClassifier(class_num=5, model_name="resnet-50",
+                            input_shape=(3, 64, 64))
+        x = np.zeros((1, 3, 64, 64), np.float32)
+        assert np.asarray(m.predict(x, batch_size=1)).shape == (1, 5)
+
+    def test_registry_complete(self):
+        assert {"lenet", "vgg-16", "mobilenet", "resnet-50",
+                "squeezenet"} <= set(backbones)
+
+    def test_predict_image_set_with_labels(self):
+        m = ImageClassifier(class_num=3, model_name="lenet",
+                            input_shape=(3, 32, 32),
+                            label_map={0: "cat", 1: "dog", 2: "frog"})
+        # lenet config has no pre_processor; feed pre-baked image set
+        rng = np.random.default_rng(1)
+        imgs = [rng.integers(0, 255, (40, 50, 3)).astype(np.uint8)
+                for _ in range(3)]
+        iset = ImageSet.array(imgs)
+        from analytics_zoo_tpu.feature.common import ChainedPreprocessing
+        from analytics_zoo_tpu.feature.image.preprocessing import (
+            ImageMatToTensor, ImageResize, ImageSetToSample)
+        from analytics_zoo_tpu.models.image.common import (ImageConfigure,
+                                                           LabelOutput)
+        cfg = ImageConfigure(
+            pre_processor=ChainedPreprocessing([
+                ImageResize(32, 32), ImageMatToTensor(format="NCHW"),
+                ImageSetToSample()]),
+            post_processor=LabelOutput({0: "cat", 1: "dog", 2: "frog"}))
+        out = m.predict_image_set(iset, cfg)
+        for f in out.to_local().features:
+            assert f.get_predict() is not None
+            assert len(f["clses"]) == 3  # top_n capped at class count
+            assert f["clses"][0] in ("cat", "dog", "frog")
+
+
+class TestSSD:
+    def test_encode_decode_roundtrip(self):
+        priors = generate_priors(96, (4,), (20,), (40,), ((2,),))
+        rng = np.random.default_rng(0)
+        boxes = np.sort(rng.uniform(0, 1, (priors.shape[0], 4)).astype(
+            np.float32), axis=-1)[:, [0, 1, 2, 3]]
+        # make corner boxes: x1<x2, y1<y2
+        boxes = np.stack([boxes[:, 0] * 0.5, boxes[:, 1] * 0.5,
+                          boxes[:, 0] * 0.5 + 0.3 + 0.1 * boxes[:, 2],
+                          boxes[:, 1] * 0.5 + 0.3 + 0.1 * boxes[:, 3]],
+                         axis=1)
+        enc = encode_boxes(boxes, priors)
+        dec = np.asarray(decode_boxes(enc, priors))
+        np.testing.assert_allclose(dec, boxes, atol=1e-4)
+
+    def test_nms_suppresses_overlaps(self):
+        boxes = np.asarray([
+            [0.0, 0.0, 0.5, 0.5],
+            [0.02, 0.02, 0.52, 0.52],   # heavy overlap with 0
+            [0.6, 0.6, 0.9, 0.9],
+        ], np.float32)
+        scores = np.asarray([0.9, 0.8, 0.7], np.float32)
+        idx, kept = nms(boxes, scores, iou_threshold=0.5, max_out=3)
+        idx, kept = np.asarray(idx), np.asarray(kept)
+        valid = idx[kept > 0]
+        assert list(valid) == [0, 2]
+
+    def test_match_priors_assigns_positives(self):
+        priors = generate_priors(96, (6,), (20,), (40,), ((2,),))
+        gt = np.asarray([[0.1, 0.1, 0.45, 0.45]], np.float32)
+        target = match_priors(gt, np.asarray([3]), priors)
+        assert target.shape == (priors.shape[0], 5)
+        assert (target[:, 4] == 3).sum() >= 1  # best prior forced positive
+
+    def test_detector_pipeline_and_training(self):
+        det = ObjectDetector(class_num=3, image_size=64, base_channels=4)
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 3, 64, 64)).astype(np.float32)
+        rows = det.detect(x)
+        assert rows.shape[0] == 2 and rows.shape[2] == 6
+
+        gt_boxes = [np.asarray([[0.2, 0.2, 0.6, 0.6]], np.float32),
+                    np.asarray([[0.1, 0.5, 0.4, 0.9],
+                                [0.5, 0.1, 0.9, 0.4]], np.float32)]
+        gt_labels = [np.asarray([1]), np.asarray([2, 1])]
+        targets = det.encode_targets(gt_boxes, gt_labels)
+        assert (targets[..., 4] > 0).sum() >= 3
+        det.compile(optimizer="adam")
+        ev0 = det.model.evaluate(x, targets, batch_size=2)["loss"]
+        det.model.fit(x, targets, batch_size=2, nb_epoch=8)
+        ev1 = det.model.evaluate(x, targets, batch_size=2)["loss"]
+        assert ev1 < ev0
+
+    def test_multibox_loss_hard_negative_mining(self):
+        import jax.numpy as jnp
+        loss = MultiBoxLoss(num_classes=3)
+        b, n = 2, 16
+        rng = np.random.default_rng(0)
+        y_pred = jnp.asarray(rng.standard_normal((b, n, 7)), jnp.float32)
+        y_true = np.zeros((b, n, 5), np.float32)
+        y_true[:, :2, 4] = 1  # two positives per image
+        val = loss(y_pred, jnp.asarray(y_true))
+        assert np.isfinite(float(val)) and float(val) > 0
+
+    def test_predict_image_set_scales_boxes(self):
+        det = ObjectDetector(class_num=3, image_size=64, base_channels=4,
+                             conf_threshold=0.0)
+        rng = np.random.default_rng(2)
+        imgs = [rng.integers(0, 255, (100, 200, 3)).astype(np.uint8)]
+        iset = ImageSet.array(imgs)
+        out = det.predict_image_set(iset)
+        f = out.to_local().features[0]
+        rows = f["detection"]
+        assert rows.ndim == 2 and rows.shape[1] == 6
+        if len(rows):
+            assert rows[:, [2, 4]].max() <= 200 + 1e-3
+            assert rows[:, [3, 5]].max() <= 100 + 1e-3
