@@ -14,7 +14,8 @@ from __future__ import annotations
 import math
 import queue
 import threading
-from typing import Any, Callable, Iterator, List, Optional, Sequence, Tuple
+from typing import (Any, Callable, Dict, Iterator, List, Optional, Sequence,
+                    Tuple)
 
 import numpy as np
 
@@ -100,6 +101,38 @@ class FeatureSet:
                   batch_size_hint: Optional[int] = None):
         return GeneratorFeatureSet(fn, size)
 
+    @staticmethod
+    def rdd(data, memory_type: str = "DRAM", **kw) -> "FeatureSet":
+        """Memory-tier factory (parity: ``FeatureSet.rdd``
+        ``feature/FeatureSet.scala:423-455`` with DRAM | PMEM | DIRECT |
+        DISK_AND_DRAM(n)).
+
+        ``data``: a FeatureSet, a sequence of Samples, or for
+        DISK_AND_DRAM a list of ``.npz`` shard paths. PMEM and DIRECT
+        both map to the native host arena (``native/zoo_data.cpp``) —
+        off-GC staging RAM replaces Optane.
+        """
+        mt = str(memory_type).upper()
+        if mt.startswith("DISK_AND_DRAM"):
+            num_slice = 1
+            if "(" in mt:
+                num_slice = int(mt.split("(")[1].rstrip(")"))
+            return DiskFeatureSet(list(data), num_slice=num_slice)
+        if isinstance(data, FeatureSet):
+            fs = data
+        else:
+            fs = FeatureSet.samples(list(data))
+        if mt in ("PMEM", "DIRECT") and isinstance(fs, ArrayFeatureSet):
+            try:
+                return DirectFeatureSet(fs.features, fs.labels, fs.weights)
+            except (ImportError, MemoryError):
+                return fs  # native arena unavailable/full: stay in DRAM
+        return fs
+
+    @staticmethod
+    def disk(paths: Sequence[str], num_slice: int = 1) -> "DiskFeatureSet":
+        return DiskFeatureSet(list(paths), num_slice=num_slice)
+
 
 class ArrayFeatureSet(FeatureSet):
     """In-memory (host-RAM tier) dataset of numpy arrays."""
@@ -146,6 +179,114 @@ class ArrayFeatureSet(FeatureSet):
             if pad:
                 w[-pad:] = 0.0
             yield MiniBatch(xs, ys, w)
+
+
+class DirectFeatureSet(ArrayFeatureSet):
+    """Samples staged in the native host arena (off-GC, 64-byte aligned).
+
+    The PMEM/DIRECT tier equivalent (``feature/pmem/NativeArray.scala`` +
+    ``PersistentMemoryAllocator.java:19``): sample bytes live outside the
+    Python heap in one contiguous slab, and batch slices are zero-copy
+    numpy views handed straight to ``jax.device_put``.
+    """
+
+    def __init__(self, features, labels=None, weights=None):
+        from ..utils.native_loader import load_zoo_data
+
+        lib = load_zoo_data()  # raises ImportError when unavailable
+        feats = [np.asarray(f) for f in (
+            features if isinstance(features, (list, tuple)) else [features])]
+        labs = None
+        if labels is not None:
+            labs = [np.asarray(l) for l in (
+                labels if isinstance(labels, (list, tuple)) else [labels])]
+        def aligned(a):  # arena rounds every allocation up to 64 bytes
+            return (a.nbytes + 63) & ~63
+
+        total = sum(aligned(a) for a in feats) + \
+            sum(aligned(a) for a in (labs or []))
+        self._arena = lib.arena(max(total + 64, 4096))
+        staged_feats = [self._arena.store(a).numpy() for a in feats]
+        staged_labs = [self._arena.store(a).numpy() for a in labs] \
+            if labs is not None else None
+        super().__init__(staged_feats, staged_labs, weights)
+
+    memory_type = "DIRECT"
+
+
+class DiskFeatureSet(FeatureSet):
+    """Sliced-epoch dataset over ``.npz`` shards.
+
+    Parity: ``DiskFeatureSet`` / DISK_AND_DRAM(n) (FeatureSet.scala:332)
+    — only ``num_slice`` shards are resident at a time; an epoch streams
+    through all shards. Shards hold arrays ``x0..xK`` (features) and
+    optional ``y0..yK`` (labels).
+    """
+
+    def __init__(self, paths: Sequence[str], num_slice: int = 1):
+        self.paths = list(paths)
+        self.num_slice = max(1, num_slice)
+        self._sizes = []
+        for p in self.paths:
+            with np.load(p) as z:
+                self._sizes.append(z["x0"].shape[0])
+
+    @staticmethod
+    def write_shard(path: str, features, labels=None):
+        """Helper to produce shard files in the expected layout."""
+        feats = features if isinstance(features, (list, tuple)) \
+            else [features]
+        arrays = {f"x{i}": np.asarray(a) for i, a in enumerate(feats)}
+        if labels is not None:
+            labs = labels if isinstance(labels, (list, tuple)) else [labels]
+            arrays.update({f"y{i}": np.asarray(a)
+                           for i, a in enumerate(labs)})
+        np.savez(path, **arrays)
+
+    def size(self):
+        return sum(self._sizes)
+
+    def batches(self, batch_size, shuffle=False, drop_remainder=True,
+                pad_remainder=False, seed=0):
+        order = np.arange(len(self.paths))
+        if shuffle:
+            np.random.default_rng(seed).shuffle(order)
+        def numkey(k):
+            return (k[0], int(k[1:]))
+
+        carry: Optional[List[List[np.ndarray]]] = None  # [xs, ys]
+        groups = [order[s:s + self.num_slice]
+                  for s in range(0, len(order), self.num_slice)]
+        for gi, group in enumerate(groups):
+            feats_acc: Dict[str, List[np.ndarray]] = {}
+            for pi in group:
+                with np.load(self.paths[pi]) as z:
+                    for k in z.files:
+                        feats_acc.setdefault(k, []).append(z[k])
+            merged = {k: np.concatenate(v) for k, v in feats_acc.items()}
+            xs = [merged[k] for k in sorted(merged, key=numkey)
+                  if k.startswith("x")]
+            ys = [merged[k] for k in sorted(merged, key=numkey)
+                  if k.startswith("y")]
+            if carry is not None:  # remainder samples from the last group
+                xs = [np.concatenate([c, a]) for c, a in zip(carry[0], xs)]
+                if ys:
+                    ys = [np.concatenate([c, a])
+                          for c, a in zip(carry[1], ys)]
+            last = gi == len(groups) - 1
+            n = xs[0].shape[0]
+            # keep the tail for the next group so drop_remainder only
+            # applies once per epoch, matching a flat dataset's count
+            keep = n if last else (n // batch_size) * batch_size
+            carry = None if last else [[a[keep:] for a in xs],
+                                       [a[keep:] for a in ys]]
+            slice_fs = ArrayFeatureSet([a[:keep] for a in xs],
+                                       [a[:keep] for a in ys] if ys
+                                       else None)
+            yield from slice_fs.batches(
+                batch_size, shuffle=shuffle,
+                drop_remainder=drop_remainder,
+                pad_remainder=pad_remainder, seed=seed + gi)
 
 
 class GeneratorFeatureSet(FeatureSet):

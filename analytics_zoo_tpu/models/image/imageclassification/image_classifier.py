@@ -176,17 +176,23 @@ class ImageClassifier(ImageModel):
                             dataset=dataset, input_shape=input_shape)
         kwargs = {} if input_shape is None else {"shape": tuple(input_shape)}
         self.model = backbones[key](class_num, **kwargs)
-        size = 28 if key == "lenet" else 224
         self.config = ImageConfigure(
-            pre_processor=imagenet_preprocess(size) if key != "lenet"
-            else None,
+            pre_processor=_default_preprocess(key, input_shape),
             post_processor=LabelOutput(label_map))
 
     @classmethod
     def load_model(cls, path, weight_path=None):
         obj = super().load_model(path, weight_path)
         obj.config = ImageConfigure(
-            pre_processor=imagenet_preprocess(
-                28 if obj.model_name == "lenet" else 224),
+            pre_processor=_default_preprocess(obj.model_name,
+                                              obj.input_shape),
             post_processor=LabelOutput(None))
         return obj
+
+
+def _default_preprocess(key: str, input_shape):
+    """Crop size follows the graph's actual input, not a fixed 224."""
+    if key == "lenet":
+        return None
+    size = 224 if input_shape is None else int(input_shape[-1])
+    return imagenet_preprocess(size)

@@ -198,7 +198,8 @@ def detection_output(preds, priors, num_classes: int,
 
     def per_image(boxes_i, conf_i):
         rows = []
-        per_class = max(1, top_k // max(1, num_classes - 1))
+        # ceil so the class-wise pools always cover top_k total rows
+        per_class = max(1, -(-top_k // max(1, num_classes - 1)))
         for c in range(1, num_classes):
             scores = jnp.where(conf_i[:, c] >= conf_threshold,
                                conf_i[:, c], -jnp.inf)
@@ -291,7 +292,6 @@ def build_ssd(num_classes: int, image_size: int = 300,
     while len(feats) < max_scales and feats[-1].shape[2] > 1:
         stride_feat = conv_bn(feats[-1], ch, 2)
         feats.append(conv_bn(stride_feat, ch))
-        ch = min(ch, c * 8)
 
     base_aspect = [(2,), (2, 3), (2, 3), (2, 3), (2,), (2,)]
     aspect = [base_aspect[min(k, len(base_aspect) - 1)]
