@@ -51,9 +51,13 @@ class ImageConfigure:
 def imagenet_preprocess(size: int = 224,
                         mean=(123.68, 116.779, 103.939)) -> Preprocessing:
     """Standard imagenet eval chain: resize-256 → center-crop → normalize
-    → NCHW tensor (the reference's default classifier preprocessing)."""
+    → NCHW tensor (the reference's default classifier preprocessing).
+
+    The resize edge scales with the crop (256/224 ratio) so crops larger
+    than 256 still fit inside the resized image."""
+    edge = max(256, int(round(size * 256 / 224)))
     return ChainedPreprocessing([
-        ImageResize(256, 256),
+        ImageResize(edge, edge),
         ImageCenterCrop(size, size),
         ImageChannelNormalize(*mean),
         ImageMatToTensor(format="NCHW"),

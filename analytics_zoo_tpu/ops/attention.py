@@ -7,12 +7,19 @@ utils/zoo Attention) — sequence length bounded by one worker's RAM
 blockwise online-softmax so the L×L score matrix never hits HBM, MXU-sized
 (128×128) tiles, f32 accumulation. ``ring`` sequence parallelism layers on
 top of this in ``parallel/ring_attention.py``.
+
+The kernel takes an optional *key bias* — an additive (B, Lk) bias broadcast
+over heads and query positions, which is exactly the shape of the BERT/
+padding-mask bias ``(1-mask)*-10000`` (self_attention.py) — so the model-zoo
+transformer path runs through the kernel, not the fallback.  Full (B,H,Lq,Lk)
+biases fall back to the fused-XLA reference path.
 """
 
 from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import Optional
 
 import jax
@@ -20,6 +27,12 @@ import jax.numpy as jnp
 import numpy as np
 
 DEFAULT_MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
+
+
+def _interpret_mode() -> bool:
+    """Run the Pallas kernel in interpreter mode (CPU coverage of the kernel
+    body; also used by tests)."""
+    return os.environ.get("ZOO_TPU_PALLAS_INTERPRET", "0") == "1"
 
 
 # ---------------------------------------------------------------------------
@@ -46,8 +59,9 @@ def attention_reference(q, k, v, bias=None, causal=False, sm_scale=None):
 # Pallas flash attention (forward; backward via custom_vjp recompute)
 # ---------------------------------------------------------------------------
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-                      sm_scale, causal, block_q, block_k, num_k_blocks):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, kb_ref, o_ref, m_scr, l_scr,
+                      acc_scr, *, sm_scale, causal, block_q, block_k,
+                      num_k_blocks):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
@@ -66,6 +80,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
+        # additive key bias (padding mask), broadcast over query rows
+        s = s + kb_ref[...].astype(jnp.float32)    # (1, block_k) -> rows
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -96,7 +112,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
                     jnp.maximum(l_scr[...], 1e-30)).astype(o_ref.dtype)
 
 
-def _flash_forward(q, k, v, causal, sm_scale, block_q=128, block_k=128):
+def _flash_forward(q, k, v, kbias, num_heads, causal, sm_scale,
+                   block_q=128, block_k=128):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -118,6 +135,10 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q=128, block_k=128):
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            # kbias is (B, Lk); the flat grid axis is batch*heads, so the
+            # index map folds heads away: bias row = b // num_heads
+            pl.BlockSpec((1, block_k),
+                         lambda b, i, j, h=num_heads: (b // h, j)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
@@ -128,61 +149,80 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q=128, block_k=128):
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
-    )(q, k, v)
+        interpret=_interpret_mode(),
+    )(q, k, v, kbias)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_attention_bhld(q, k, v, causal, sm_scale):
-    return _flash_forward(q, k, v, causal, sm_scale)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_attention_bhld(q, k, v, kbias, num_heads, causal, sm_scale):
+    return _flash_forward(q, k, v, kbias, num_heads, causal, sm_scale)
 
 
-def _flash_fwd_rule(q, k, v, causal, sm_scale):
-    o = _flash_forward(q, k, v, causal, sm_scale)
-    return o, (q, k, v)
+def _flash_fwd_rule(q, k, v, kbias, num_heads, causal, sm_scale):
+    o = _flash_forward(q, k, v, kbias, num_heads, causal, sm_scale)
+    return o, (q, k, v, kbias)
 
 
-def _flash_bwd_rule(causal, sm_scale, res, do):
+def _flash_bwd_rule(num_heads, causal, sm_scale, res, do):
     """Backward by recompute through the reference math (XLA fuses well and
     this keeps the kernel surface small; a dedicated bwd kernel is an
     optimization for a later round)."""
-    q, k, v = res
+    q, k, v, kbias = res
 
-    def ref(q, k, v):
+    def ref(q, k, v, kb):
         qf = q[:, None]
         kf = k[:, None]
         vf = v[:, None]
-        return attention_reference(qf, kf, vf, causal=causal,
+        # kb: (B, Lk) -> per-(batch*head) rows -> (BH, 1, 1, Lk)
+        kbf = jnp.repeat(kb, num_heads, axis=0)[:, None, None, :]
+        return attention_reference(qf, kf, vf, bias=kbf, causal=causal,
                                    sm_scale=sm_scale)[:, 0]
 
-    _, vjp = jax.vjp(ref, q, k, v)
-    return vjp(do)
+    return jax.vjp(ref, q, k, v, kbias)[1](do)
 
 
 _flash_attention_bhld.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def _as_key_bias(bias, b, lk) -> Optional[jnp.ndarray]:
+    """(B|1, 1, 1, Lk)-broadcastable bias -> (B, Lk); else None."""
+    if bias is None:
+        return jnp.zeros((b, lk), jnp.float32)
+    if bias.ndim == 4 and bias.shape[1] == 1 and bias.shape[2] == 1 \
+            and bias.shape[3] == lk and bias.shape[0] in (1, b):
+        kb = bias.reshape(bias.shape[0], lk).astype(jnp.float32)
+        if bias.shape[0] == 1 and b > 1:
+            kb = jnp.broadcast_to(kb, (b, lk))
+        return kb
+    return None
 
 
 def flash_attention(q, k, v, bias=None, causal=False, sm_scale=None,
                     block_q=128, block_k=128):
     """q,k,v: (B, H, L, D) -> (B, H, L, D).
 
-    Uses the Pallas kernel on TPU for bias-free long sequences; falls back to
-    the fused-XLA reference path otherwise (bias support in the kernel comes
-    with the ring-attention work).
+    Uses the Pallas kernel on TPU (or in interpreter mode when
+    ``ZOO_TPU_PALLAS_INTERPRET=1``) whenever the bias is absent or a
+    key-padding bias; falls back to the fused-XLA reference path for full
+    (B,H,Lq,Lk) biases and shapes the kernel can't tile.
     """
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
-    on_tpu = jax.default_backend() == "tpu"
-    lq, lk, d = q.shape[2], k.shape[2], q.shape[3]
+    on_tpu = jax.default_backend() == "tpu" or _interpret_mode()
+    b, h, lq, d = q.shape
+    lk = k.shape[2]
+    kb = _as_key_bias(bias, b, lk) if on_tpu else None
     # d=64 (the common head dim) is allowed: Mosaic pads the lane dim.
-    use_kernel = (on_tpu and bias is None and lq >= 128 and lk >= 128 and
+    # causal requires lq == lk: the kernel masks top-left aligned while the
+    # reference (and the bwd recompute) masks bottom-right aligned.
+    use_kernel = (on_tpu and kb is not None and lq >= 128 and lk >= 128 and
                   lq % block_q == 0 and lk % block_k == 0 and
-                  d % 64 == 0)
+                  d % 64 == 0 and (not causal or lq == lk))
     if not use_kernel:
         return attention_reference(q, k, v, bias=bias, causal=causal,
                                    sm_scale=sm_scale)
-    b, h = q.shape[0], q.shape[1]
     qf = q.reshape(b * h, lq, d)
     kf = k.reshape(b * h, lk, d)
     vf = v.reshape(b * h, lk, d)
-    o = _flash_attention_bhld(qf, kf, vf, causal, sm_scale)
+    o = _flash_attention_bhld(qf, kf, vf, kb, h, causal, sm_scale)
     return o.reshape(b, h, lq, d)

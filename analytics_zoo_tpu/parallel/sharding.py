@@ -24,6 +24,8 @@ DEFAULT_RULES: Dict[str, Optional[str]] = {
     "in": None,
     "out": None,
     "kv": None,
+    "expert": "expert",   # stacked expert weights over the EP axis
+    "stage": "pipe",      # stacked pipeline-stage weights over the PP axis
 }
 
 FSDP_RULES = dict(DEFAULT_RULES, embed="data")  # fully-sharded variant

@@ -30,6 +30,7 @@ from .recurrent import (GRU, LSTM, ConvLSTM2D, ConvLSTM3D, SimpleRNN)
 from .wrappers import Bidirectional, KerasLayerWrapper, TimeDistributed
 from .advanced_activations import (ELU, LeakyReLU, PReLU, RReLU, Softmax,
                                    SReLU, ThresholdedReLU)
+from .moe import SparseMoE
 
 # Convenience aliases matching Keras-2-style names used around the reference
 Conv1D = Convolution1D

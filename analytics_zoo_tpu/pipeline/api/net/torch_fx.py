@@ -188,9 +188,8 @@ class TorchFxConverter:
             return lambda P, x: jnp.mean(
                 x, axis=tuple(range(2, x.ndim)), keepdims=True)
         if isinstance(mod, tnn.Flatten):
-            start = mod.start_dim
-            return lambda P, x: jnp.reshape(
-                x, x.shape[:start] + (-1,))
+            start, end = mod.start_dim, mod.end_dim
+            return lambda P, x: _flatten_mid(x, start, end)
         if isinstance(mod, tnn.Dropout):
             return lambda P, x: x
         if isinstance(mod, tnn.Identity):

@@ -225,6 +225,12 @@ def _conv_transpose(attrs, inputs):
     spatial = x.ndim - 2
     strides = [int(s) for s in attrs.get("strides", [1] * spatial)]
     kernel = attrs.get("kernel_shape", list(w.shape[2:]))
+    if "output_shape" in attrs:
+        raise NotImplementedError(
+            "ConvTranspose with explicit output_shape is not supported; "
+            "re-export with pads/output_padding instead")
+    out_pad = [int(v) for v in
+               attrs.get("output_padding", [0] * spatial)]
     auto = attrs.get("auto_pad", "NOTSET")
     if auto in ("SAME_UPPER", "SAME_LOWER"):
         # deconv SAME: output = input * stride, total pad = eff - stride
@@ -240,10 +246,11 @@ def _conv_transpose(attrs, inputs):
     # ONNX deconv kernel layout is (C_in, C_out, ...spatial) = IO + spatial
     sp = "XYZ"[:spatial]
     dims = ("NC" + sp, "IO" + sp, "NC" + sp)
+    # output_padding adds rows/cols on the high side only (ONNX spec)
     out = lax.conv_transpose(
         x, w, strides=strides,
-        padding=[(k - 1 - p[0], k - 1 - p[1])
-                 for k, p in zip(kernel, pads)],
+        padding=[(k - 1 - p[0], k - 1 - p[1] + op_)
+                 for k, p, op_ in zip(kernel, pads, out_pad)],
         dimension_numbers=dims, transpose_kernel=True)
     if len(inputs) > 2 and inputs[2] is not None:
         out = out + inputs[2].reshape((1, -1) + (1,) * spatial)

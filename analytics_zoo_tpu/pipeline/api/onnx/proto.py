@@ -270,13 +270,27 @@ def tensor_to_numpy(t: Msg) -> np.ndarray:
     dims = [int(d) for d in t.get("dims", [])]
     raw = t.get("raw_data")
     if raw:
-        arr = np.frombuffer(raw, dtype=dtype)
+        if t.get("data_type") == 16:
+            # bfloat16 raw bytes: widen bit patterns to float32
+            bits = np.frombuffer(raw, dtype=np.uint16).astype(np.uint32)
+            arr = (bits << 16).view(np.float32)
+        else:
+            arr = np.frombuffer(raw, dtype=dtype)
     elif t.get("float_data"):
         arr = np.asarray(t["float_data"], dtype=dtype)
     elif t.get("int64_data"):
         arr = np.asarray(t["int64_data"], dtype=dtype)
     elif t.get("int32_data"):
-        arr = np.asarray(t["int32_data"], dtype=dtype)
+        code = t.get("data_type", 1)
+        if code in (10, 16):
+            # fp16/bf16 tensors store uint16 bit patterns in int32_data
+            bits = np.asarray(t["int32_data"], dtype=np.uint16)
+            arr = bits.view(np.float16) if code == 10 else \
+                bits.astype(np.uint32) << 16
+            if code == 16:
+                arr = arr.view(np.float32)
+        else:
+            arr = np.asarray(t["int32_data"], dtype=dtype)
     elif t.get("double_data"):
         arr = np.asarray(t["double_data"], dtype=dtype)
     else:

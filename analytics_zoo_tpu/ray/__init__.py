@@ -1,0 +1,5 @@
+from .raycontext import RayContext, RemoteFunction, get_ray_context
+from .process import ProcessMonitor, ProcessGuard
+
+__all__ = ["RayContext", "RemoteFunction", "get_ray_context",
+           "ProcessMonitor", "ProcessGuard"]

@@ -1,0 +1,241 @@
+"""RayContext: the distributed-task runtime (RayOnSpark equivalent).
+
+Reference: ``pyzoo/zoo/ray/util/raycontext.py:192`` boots a Ray cluster
+*inside* a Spark app — partition 0 runs ``ray start --head``, the other
+barrier tasks run raylets, the driver joins via ``ray.init(redis_address)``,
+and JVMGuard ties process lifetimes to the executors (:32-51, :155-189).
+
+TPU-native redesign: there is no Spark app to piggyback on and no Redis to
+rendezvous through. A TPU-VM host already *is* a worker box, and multi-host
+coordination already rides the JAX coordination service (DCN). So the
+runtime is:
+
+* a **per-host worker pool** of forked Python processes fed by a work queue
+  (the raylet equivalent), sized like the reference (``num_nodes`` ×
+  ``cores_per_node``);
+* a **driver API** in the Ray style — ``ctx.remote(fn)`` →
+  ``handle.remote(*args)`` → ``ObjectRef`` → ``ctx.get(ref)`` — with
+  cloudpickle for closures so arbitrary driver-defined functions ship to
+  workers;
+* **lifecycle guards** (process.py): parent-death watch in every worker +
+  atexit/SIGTERM sweep in the driver, replacing JVMGuard/ProcessMonitor;
+* on a TPU pod, each host process creates its own RayContext for host-local
+  task fan-out (data prep, AutoML trials), while chip-level work stays in
+  XLA collectives — the two planes compose instead of competing.
+
+AutoML (``analytics_zoo_tpu.automl``) schedules its trials on this runtime.
+"""
+
+from __future__ import annotations
+
+import logging
+import multiprocessing as mp
+import os
+import queue as queue_mod
+import threading
+import time
+import traceback
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from .process import ProcessGuard, ProcessMonitor
+
+logger = logging.getLogger("analytics_zoo_tpu.ray")
+
+_global_ray_context: Optional["RayContext"] = None
+
+
+def get_ray_context() -> Optional["RayContext"]:
+    return _global_ray_context
+
+
+class ObjectRef:
+    """Future handle for a submitted task (ray.ObjectRef equivalent)."""
+
+    __slots__ = ("task_id",)
+
+    def __init__(self, task_id: str):
+        self.task_id = task_id
+
+    def __repr__(self):
+        return f"ObjectRef({self.task_id[:8]})"
+
+
+class RemoteFunction:
+    """``ctx.remote(fn)`` wrapper: ``.remote(*args)`` submits a task."""
+
+    def __init__(self, ctx: "RayContext", fn: Callable,
+                 num_returns: int = 1):
+        if num_returns != 1:
+            raise NotImplementedError(
+                "num_returns != 1 is not supported; return a tuple and "
+                "index it after get()")
+        self._ctx = ctx
+        self._fn = fn
+
+    def remote(self, *args, **kwargs) -> ObjectRef:
+        return self._ctx._submit(self._fn, args, kwargs)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError("Remote functions must be invoked with .remote()")
+
+
+class RemoteTaskError(RuntimeError):
+    """A task raised in the worker; carries the remote traceback."""
+
+
+def _worker_main(worker_id: int, parent_pid: int, task_q, result_q,
+                 platform: Optional[str], env: Optional[Dict[str, str]]):
+    ProcessGuard(parent_pid).start()
+    if env:
+        os.environ.update(env)
+    if platform:
+        os.environ["JAX_PLATFORMS"] = platform
+        try:
+            import jax
+            # env var alone is ignored when a TPU plugin is registered
+            jax.config.update("jax_platforms", platform)
+        except Exception:  # noqa: BLE001 - jax optional in workers
+            pass
+    import cloudpickle
+
+    while True:
+        item = task_q.get()
+        if item is None:
+            break
+        task_id, fn_blob, args_blob = item
+        try:
+            fn = cloudpickle.loads(fn_blob)
+            args, kwargs = cloudpickle.loads(args_blob)
+            result = fn(*args, **kwargs)
+            result_q.put((task_id, True,
+                          cloudpickle.dumps(result)))
+        except BaseException as e:  # noqa: BLE001 - report, don't die
+            result_q.put((task_id, False,
+                          f"{type(e).__name__}: {e}\n"
+                          f"{traceback.format_exc()}"))
+
+
+class RayContext:
+    """Boot and drive the per-host worker pool.
+
+    Parameters mirror the reference's surface where they make sense:
+    ``num_ray_nodes``×``ray_node_cpu_cores`` sizes the pool (reference:
+    executors × cores); ``platform`` pins the JAX backend inside workers
+    (tests use ``"cpu"`` so trials never grab the TPU).
+    """
+
+    def __init__(self, num_ray_nodes: int = 2, ray_node_cpu_cores: int = 1,
+                 platform: Optional[str] = None,
+                 env: Optional[Dict[str, str]] = None,
+                 object_store_memory: Optional[int] = None, **_compat):
+        self.num_workers = max(1, num_ray_nodes * ray_node_cpu_cores)
+        self.platform = platform
+        self.env = dict(env or {})
+        self.stopped = True
+        self._monitor = ProcessMonitor()
+        self._procs: List[mp.Process] = []
+        self._task_q = None
+        self._result_q = None
+        self._results: Dict[str, Any] = {}
+        self._results_lock = threading.Lock()
+        self._pending: set = set()
+
+    # ------------------------------------------------------------------
+    def init(self) -> "RayContext":
+        global _global_ray_context
+        if not self.stopped:
+            return self
+        ctx = mp.get_context("spawn")  # hermetic workers (no jax state leak)
+        self._task_q = ctx.Queue()
+        self._result_q = ctx.Queue()
+        parent = os.getpid()
+        for i in range(self.num_workers):
+            p = ctx.Process(
+                target=_worker_main,
+                args=(i, parent, self._task_q, self._result_q,
+                      self.platform, self.env),
+                daemon=True, name=f"zoo-ray-worker-{i}")
+            p.start()
+            self._procs.append(p)
+            self._monitor.register(p)
+        self.stopped = False
+        _global_ray_context = self
+        logger.info("RayContext: %d workers up", self.num_workers)
+        return self
+
+    def stop(self):
+        global _global_ray_context
+        if self.stopped:
+            return
+        for _ in self._procs:
+            try:
+                self._task_q.put(None)
+            except Exception:  # noqa: BLE001
+                break
+        self._monitor.shutdown()
+        self._procs = []
+        self.stopped = True
+        if _global_ray_context is self:
+            _global_ray_context = None
+
+    # ------------------------------------------------------------------
+    def remote(self, fn: Callable = None, **opts) -> RemoteFunction:
+        """Decorator/wrapper: ``sq = ctx.remote(lambda x: x*x)``."""
+        if fn is None:
+            return lambda f: RemoteFunction(self, f, **opts)
+        return RemoteFunction(self, fn)
+
+    def _submit(self, fn, args, kwargs) -> ObjectRef:
+        if self.stopped:
+            raise RuntimeError("RayContext not initialized; call init()")
+        import cloudpickle
+
+        task_id = uuid.uuid4().hex
+        self._pending.add(task_id)
+        self._task_q.put((task_id, cloudpickle.dumps(fn),
+                          cloudpickle.dumps((args, kwargs))))
+        return ObjectRef(task_id)
+
+    def get(self, refs, timeout: Optional[float] = None):
+        """Block for one ObjectRef or a list of them (ray.get parity)."""
+        single = isinstance(refs, ObjectRef)
+        ref_list = [refs] if single else list(refs)
+        deadline = None if timeout is None else time.time() + timeout
+        out = [self._wait_one(r.task_id, deadline) for r in ref_list]
+        return out[0] if single else out
+
+    def _wait_one(self, task_id: str, deadline: Optional[float]):
+        import cloudpickle
+
+        while True:
+            with self._results_lock:
+                if task_id in self._results:
+                    ok, payload = self._results.pop(task_id)
+                    if not ok:
+                        raise RemoteTaskError(payload)
+                    return cloudpickle.loads(payload)
+            remain = None if deadline is None else deadline - time.time()
+            if remain is not None and remain <= 0:
+                raise TimeoutError(f"task {task_id[:8]} timed out")
+            try:
+                tid, ok, payload = self._result_q.get(
+                    timeout=min(remain, 1.0) if remain else 1.0)
+            except queue_mod.Empty:
+                if not any(p.is_alive() for p in self._procs):
+                    raise RuntimeError("all workers died") from None
+                continue
+            with self._results_lock:
+                self._results[tid] = (ok, payload)
+                self._pending.discard(tid)
+
+    # convenience ------------------------------------------------------
+    def map(self, fn: Callable, items: Sequence, timeout=None) -> List:
+        refs = [self._submit(fn, (it,), {}) for it in items]
+        return self.get(refs, timeout=timeout)
+
+    def __enter__(self):
+        return self.init()
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -112,12 +112,12 @@ class TFEstimator:
         dataset = _resolve(input_fn_or_dataset)
         self._trace(dataset)
         from ..feature.feature_set import ArrayFeatureSet
+        from .tf_optimizer import _all_arrays
 
         fs = dataset.feature_set
-        arrays = list(getattr(fs, "features", [])) + \
-            list(getattr(fs, "labels", []) or [])
+        arrays = [np.asarray(a) for a in _all_arrays(fs)]
         train_fs = ArrayFeatureSet(
-            arrays, [np.zeros((len(fs), 1), np.float32)])
+            arrays, [np.zeros((arrays[0].shape[0], 1), np.float32)])
         trainer = self._zoo._ensure_trainer()
         if end_trigger is None and steps is not None:
             from ..common.zoo_trigger import MaxIteration

@@ -110,11 +110,10 @@ class TFOptimizer:
         fs = self.dataset.feature_set
         # feed ALL batch arrays (features + labels) as model inputs; the
         # graph computes the loss itself, trained with the identity loss.
-        if getattr(fs, "labels", None) is not None:
-            from ..feature.feature_set import ArrayFeatureSet
-            fs = ArrayFeatureSet(
-                list(np.asarray(a) for a in _all_arrays(fs)),
-                [np.zeros((len(fs), 1), np.float32)])
+        from ..feature.feature_set import ArrayFeatureSet
+        arrays = [np.asarray(a) for a in _all_arrays(fs)]
+        fs = ArrayFeatureSet(arrays,
+                             [np.zeros((arrays[0].shape[0], 1), np.float32)])
         trainer = model._ensure_trainer()
         trainer.train(fs, batch_size=batch_size or self.dataset.batch_size,
                       end_trigger=end_trigger or MaxEpoch(1))
@@ -129,9 +128,28 @@ def _tf_dtype(tf, a):
 
 
 def _all_arrays(fs) -> List[np.ndarray]:
+    """Features + labels of any FeatureSet as host arrays.
+
+    ArrayFeatureSet exposes them directly; Generator/Disk/Transformed
+    tiers are materialized by iterating one epoch of batches.
+    """
     feats = list(getattr(fs, "features", []))
-    labs = list(getattr(fs, "labels", []) or [])
-    return feats + labs
+    if feats:
+        return feats + list(getattr(fs, "labels", []) or [])
+    xs_parts, ys_parts = [], []
+    for mb in fs.batches(batch_size=256, drop_remainder=False):
+        xs_parts.append([np.asarray(a) for a in mb.inputs])
+        if mb.targets is not None:
+            ys = mb.targets if isinstance(mb.targets, tuple) else (mb.targets,)
+            ys_parts.append([np.asarray(a) for a in ys])
+    if not xs_parts:
+        raise ValueError(
+            f"{type(fs).__name__} produced no batches; cannot rebuild a "
+            "training array set from it")
+    out = [np.concatenate(cols) for cols in zip(*xs_parts)]
+    if ys_parts:
+        out += [np.concatenate(cols) for cols in zip(*ys_parts)]
+    return out
 
 
 def _trigger_epochs(end_trigger) -> int:

@@ -1,0 +1,141 @@
+"""RayContext runtime (multi-process) + AutoML search tests."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.ray import RayContext
+from analytics_zoo_tpu.ray.raycontext import RemoteTaskError
+
+
+def _square(x):
+    return x * x
+
+
+def _boom():
+    raise ValueError("kaboom")
+
+
+@pytest.fixture(scope="module")
+def ray_ctx():
+    ctx = RayContext(num_ray_nodes=2, ray_node_cpu_cores=1, platform="cpu")
+    ctx.init()
+    yield ctx
+    ctx.stop()
+
+
+def test_remote_tasks_round_trip(ray_ctx):
+    sq = ray_ctx.remote(_square)
+    refs = [sq.remote(i) for i in range(6)]
+    assert ray_ctx.get(refs) == [i * i for i in range(6)]
+
+
+def test_remote_closure_and_numpy(ray_ctx):
+    scale = 3.0
+    ref = ray_ctx.remote(lambda a: (a * scale).sum()).remote(
+        np.ones((4, 4), np.float32))
+    assert ray_ctx.get(ref) == pytest.approx(48.0)
+
+
+def test_map_convenience(ray_ctx):
+    assert ray_ctx.map(_square, [1, 2, 3]) == [1, 4, 9]
+
+
+def test_remote_error_propagates(ray_ctx):
+    ref = ray_ctx.remote(_boom).remote()
+    with pytest.raises(RemoteTaskError, match="kaboom"):
+        ray_ctx.get(ref)
+    # the pool must survive a failing task
+    assert ray_ctx.get(ray_ctx.remote(_square).remote(5)) == 25
+
+
+def test_tasks_run_in_separate_processes(ray_ctx):
+    pids = set(ray_ctx.map(lambda _: __import__("os").getpid(),
+                           range(8), timeout=60))
+    assert os.getpid() not in pids
+    assert len(pids) >= 1
+
+
+def test_remote_requires_dot_remote(ray_ctx):
+    fn = ray_ctx.remote(_square)
+    with pytest.raises(TypeError):
+        fn(2)
+
+
+def test_stop_then_submit_raises():
+    ctx = RayContext(num_ray_nodes=1)
+    ctx.init()
+    ctx.stop()
+    with pytest.raises(RuntimeError):
+        ctx.remote(_square).remote(1)
+
+
+# ---------------------------------------------------------------------------
+# AutoML
+# ---------------------------------------------------------------------------
+
+
+def _sine_series(n=400, noise=0.05, seed=0):
+    rng = np.random.default_rng(seed)
+    t = np.arange(n)
+    return (np.sin(2 * np.pi * t / 24) +
+            noise * rng.standard_normal(n)).astype(np.float32)
+
+
+def test_rolling_window_shapes():
+    from analytics_zoo_tpu.automl import rolling_window
+
+    x, y = rolling_window(_sine_series(100), lookback=24, horizon=2)
+    assert x.shape == (75, 24, 1)
+    assert y.shape == (75, 2)
+    np.testing.assert_allclose(x[1, :, 0], _sine_series(100)[1:25])
+
+
+def test_forecasters_fit_predict():
+    from analytics_zoo_tpu.automl import (LSTMForecaster, TCNForecaster,
+                                          rolling_window)
+
+    x, y = rolling_window(_sine_series(160), lookback=12, horizon=1)
+    for cls, kw in ((LSTMForecaster, {"lstm_units": (8,)}),
+                    (TCNForecaster, {"n_filters": 4, "n_blocks": 1})):
+        f = cls(lookback=12, feature_dim=1, horizon=1, **kw)
+        f.fit(x, y, batch_size=32, epochs=1)
+        preds = f.predict(x[:8])
+        assert preds.shape == (8, 1)
+        assert np.isfinite(preds).all()
+
+
+def test_search_engine_inprocess():
+    from analytics_zoo_tpu.automl import Choice, RandomSearchEngine
+    from analytics_zoo_tpu.automl.feature import (rolling_window,
+                                                  train_val_split)
+
+    x, y = rolling_window(_sine_series(200), lookback=12, horizon=1)
+    data = train_val_split(x, y, 0.2)
+    space = {"model": "tcn", "n_filters": Choice([4, 8]), "n_blocks": 1,
+             "lr": 1e-2, "batch_size": 32}
+    best = RandomSearchEngine().run(
+        space, (data[0][0], data[0][1], data[1][0], data[1][1]),
+        num_samples=2)
+    assert best["val_loss"] < 1.0
+    assert best["config"]["n_filters"] in (4, 8)
+
+
+def test_auto_forecaster_distributed(ray_ctx):
+    """End-to-end: search trials scheduled on the RayContext worker pool,
+    winner refit, predictions roughly track the sine."""
+    from analytics_zoo_tpu.automl import AutoForecaster, TCNRandomRecipe
+    from analytics_zoo_tpu.automl.feature import rolling_window
+
+    series = _sine_series(260)
+    recipe = TCNRandomRecipe(num_samples=2, epochs=1)
+    auto = AutoForecaster(recipe=recipe, ray_ctx=ray_ctx).fit(
+        series, lookback=24, horizon=1)
+    assert auto.best_trial is not None
+    assert len(auto.engine.trials) == 2
+    x, _ = rolling_window(auto.scaler.transform(series), 24, 1)
+    preds = auto.predict(x[-20:])
+    assert preds.shape == (20, 1)
+    assert np.isfinite(preds).all()
