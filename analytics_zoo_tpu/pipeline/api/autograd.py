@@ -30,10 +30,12 @@ class Lambda(KerasLayer):
     """Wrap an arbitrary jnp function as a layer (Lambda.scala:49)."""
 
     def __init__(self, function: Callable, output_shape=None,
-                 input_shape=None, name=None, **kwargs):
+                 input_shape=None, name=None, num_outputs: int = 1,
+                 **kwargs):
         super().__init__(input_shape=input_shape, name=name)
         self.function = function
         self.output_shape_spec = output_shape
+        self.num_outputs = num_outputs
 
     def call(self, params, x, training=False, **kw):
         if isinstance(x, (list, tuple)):
@@ -43,6 +45,15 @@ class Lambda(KerasLayer):
     def compute_output_shape(self, input_shape):
         if self.output_shape_spec is not None:
             spec = self.output_shape_spec
+            if self.num_outputs > 1:
+                if not (isinstance(spec, (list, tuple)) and
+                        len(spec) == self.num_outputs and
+                        all(isinstance(s, (list, tuple)) for s in spec)):
+                    raise ValueError(
+                        "num_outputs > 1 needs output_shape as a list of "
+                        f"{self.num_outputs} shape tuples")
+                return [tuple(s) if s and s[0] is None
+                        else (None,) + tuple(s) for s in spec]
             return tuple(spec) if spec and spec[0] is None \
                 else (None,) + tuple(spec)
         # infer via abstract evaluation

@@ -246,9 +246,38 @@ _LOSSES = {
 }
 
 
+class MultiLoss(LossFunction):
+    """Weighted sum of per-output losses for multi-output models (the
+    reference reaches this via multiple criteria on a Table output)."""
+
+    def __init__(self, losses, weights=None):
+        self.losses = [get_loss(l) for l in losses]
+        self.weights = list(weights) if weights is not None else \
+            [1.0] * len(self.losses)
+        if len(self.weights) != len(self.losses):
+            raise ValueError("loss_weights length mismatch")
+
+    def per_sample(self, y_pred, y_true):
+        if not isinstance(y_pred, (list, tuple)) or \
+                not isinstance(y_true, (list, tuple)) or \
+                len(y_pred) != len(self.losses) or \
+                len(y_true) != len(self.losses):
+            raise ValueError(
+                f"MultiLoss over {len(self.losses)} outputs needs matching "
+                "prediction/target tuples")
+        total = None
+        for loss, w, yp, yt in zip(self.losses, self.weights, y_pred,
+                                   y_true):
+            term = w * loss.per_sample(yp, yt)
+            total = term if total is None else total + term
+        return total
+
+
 def get_loss(identifier):
     if identifier is None or isinstance(identifier, LossFunction):
         return identifier
+    if isinstance(identifier, (list, tuple)):
+        return MultiLoss(identifier)
     if callable(identifier):
         fn = identifier
 

@@ -1,0 +1,120 @@
+"""NER: BiLSTM tagger over word + per-word character features.
+
+Parity target: ``pyzoo/zoo/tfpark/text/keras/ner.py`` (which delegates to
+nlp_architect's NERCRF). Rebuilt on the in-repo layers: word embedding ∥
+char-BiLSTM word features → two stacked BiLSTM taggers → per-token softmax.
+The reference's CRF head is delegated to an external package there; here
+``crf_mode`` is accepted for API parity and the 'crf' decode is not yet
+implemented (softmax tagging, the nlp_architect default path, is).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ....pipeline.api.keras.engine.base import Input, KerasLayer
+from ....pipeline.api.keras.layers import LSTM, Bidirectional, Dense, \
+    Embedding
+from ....pipeline.api.keras.layers.self_attention import _dropout
+from ....pipeline.api.keras.models import Model
+from .text_model import TextKerasModel
+
+
+class _NERNet(KerasLayer):
+    """Inputs: [word (B,L), chars (B,L,W)] → tags (B,L,E)."""
+
+    stochastic = True
+
+    def __init__(self, num_entities, word_vocab_size, char_vocab_size,
+                 word_length=12, word_emb_dim=100, char_emb_dim=30,
+                 tagger_lstm_dim=100, dropout=0.5, input_shape=None,
+                 name=None, **kw):
+        super().__init__(input_shape=input_shape, name=name)
+        self.num_entities = num_entities
+        self.dropout = dropout
+        self.word_emb = Embedding(word_vocab_size, word_emb_dim)
+        self.char_emb = Embedding(char_vocab_size, char_emb_dim)
+        self.char_lstm = Bidirectional(LSTM(char_emb_dim,
+                                            return_sequences=False))
+        self.tagger1 = Bidirectional(LSTM(tagger_lstm_dim,
+                                          return_sequences=True))
+        self.tagger2 = Bidirectional(LSTM(tagger_lstm_dim,
+                                          return_sequences=True))
+        self.out = Dense(num_entities, activation="softmax")
+        self._subs = [self.word_emb, self.char_emb, self.char_lstm,
+                      self.tagger1, self.tagger2, self.out]
+        self._dims = (word_emb_dim, char_emb_dim, tagger_lstm_dim)
+
+    def build(self, rng, input_shape):
+        word_emb_dim, char_emb_dim, tagger_dim = self._dims
+        rngs = jax.random.split(rng, len(self._subs))
+        shapes = [
+            (None, None), (None, None),          # embeddings ignore shape
+            (None, None, char_emb_dim),          # char lstm over word chars
+            (None, None, word_emb_dim + 2 * char_emb_dim),
+            (None, None, 2 * tagger_dim),
+            (None, 2 * tagger_dim),
+        ]
+        return {sub.name: sub.build(r, s)
+                for sub, r, s in zip(self._subs, rngs, shapes)}
+
+    def compute_output_shape(self, input_shape):
+        words = input_shape[0]
+        return (words[0], words[1], self.num_entities)
+
+    def call(self, params, inputs, training=False, rng=None, **kw):
+        words, chars = inputs
+        words = words.astype(jnp.int32)
+        chars = chars.astype(jnp.int32)
+        b, l = words.shape
+        w = self.word_emb.call(params[self.word_emb.name], words)
+        c = self.char_emb.call(params[self.char_emb.name], chars)
+        cw = c.reshape((b * l,) + c.shape[2:])          # (B*L, W, ce)
+        cf = self.char_lstm.call(params[self.char_lstm.name], cw,
+                                 training=training)
+        cf = cf.reshape(b, l, -1)                        # (B, L, 2*ce)
+        x = jnp.concatenate([w, cf], axis=-1)
+        if rng is not None:
+            rng, sub = jax.random.split(rng)
+            x = _dropout(x, self.dropout, sub, training)
+        x = self.tagger1.call(params[self.tagger1.name], x,
+                              training=training)
+        x = self.tagger2.call(params[self.tagger2.name], x,
+                              training=training)
+        return self.out.call(params[self.out.name], x)
+
+
+class NER(TextKerasModel):
+    """Named-entity tagger (ner.py parity surface).
+
+    Inputs: word indices (B, L) + char indices (B, L, word_length);
+    output: entity-tag probabilities (B, L, num_entities).
+    """
+
+    def __init__(self, num_entities, word_vocab_size, char_vocab_size,
+                 word_length=12, word_emb_dim=100, char_emb_dim=30,
+                 tagger_lstm_dim=100, dropout=0.5, crf_mode="reg",
+                 optimizer=None, seq_len: Optional[int] = None):
+        if crf_mode not in ("reg", "pad"):
+            raise ValueError("crf_mode should be either 'reg' or 'pad'")
+        if crf_mode == "pad":
+            raise NotImplementedError(
+                "crf_mode='pad' (explicit sequence lengths) is not yet "
+                "supported; use 'reg'")
+        self.num_entities = num_entities
+        net = _NERNet(num_entities, word_vocab_size, char_vocab_size,
+                      word_length=word_length, word_emb_dim=word_emb_dim,
+                      char_emb_dim=char_emb_dim,
+                      tagger_lstm_dim=tagger_lstm_dim, dropout=dropout)
+        words = Input(shape=(seq_len,), name="words")
+        chars = Input(shape=(seq_len, word_length), name="chars")
+        tags = net([words, chars])
+        super().__init__(Model([words, chars], tags), optimizer,
+                         losses=["sparse_categorical_crossentropy"])
+
+    @staticmethod
+    def load_model(path):
+        return NER._load_model(path)

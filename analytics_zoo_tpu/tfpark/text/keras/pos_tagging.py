@@ -1,0 +1,122 @@
+"""SequenceTagger: POS + chunk multi-task tagger.
+
+Parity target: ``pyzoo/zoo/tfpark/text/keras/pos_tagging.py`` (delegating to
+nlp_architect chunker.SequenceTagger). Rebuilt in-repo: word embedding
+(∥ optional char features) → three stacked BiLSTMs → two per-token softmax
+heads (pos, chunk). ``classifier='crf'`` is accepted for parity but not yet
+implemented (softmax is the nlp_architect default)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ....pipeline.api.keras.engine.base import Input, KerasLayer
+from ....pipeline.api.keras.layers import LSTM, Bidirectional, Dense, \
+    Embedding
+from ....pipeline.api.keras.models import Model
+from .ner import _dropout
+from .text_model import TextKerasModel
+
+
+class _TaggerNet(KerasLayer):
+    """Inputs: [word (B,L)] or [word, chars (B,L,W)] →
+    (pos (B,L,P), chunk (B,L,C))."""
+
+    stochastic = True
+    num_outputs = 2
+
+    def __init__(self, num_pos_labels, num_chunk_labels, word_vocab_size,
+                 char_vocab_size=None, feature_size=100, dropout=0.2,
+                 input_shape=None, name=None, **kw):
+        super().__init__(input_shape=input_shape, name=name)
+        self.num_pos = num_pos_labels
+        self.num_chunk = num_chunk_labels
+        self.has_char = char_vocab_size is not None
+        self.dropout = dropout
+        self.word_emb = Embedding(word_vocab_size, feature_size)
+        self._subs = [self.word_emb]
+        in_dim = feature_size
+        if self.has_char:
+            self.char_emb = Embedding(char_vocab_size, feature_size // 4)
+            self.char_lstm = Bidirectional(LSTM(feature_size // 4,
+                                                return_sequences=False))
+            self._subs += [self.char_emb, self.char_lstm]
+            in_dim += feature_size // 2
+        self.rnns = [Bidirectional(LSTM(feature_size,
+                                        return_sequences=True))
+                     for _ in range(3)]
+        self.pos_out = Dense(num_pos_labels, activation="softmax")
+        self.chunk_out = Dense(num_chunk_labels, activation="softmax")
+        self._subs += self.rnns + [self.pos_out, self.chunk_out]
+        self._in_dim = in_dim
+        self.feature_size = feature_size
+
+    def build(self, rng, input_shape):
+        rngs = jax.random.split(rng, len(self._subs))
+        f = self.feature_size
+        shapes = [(None, None)]
+        if self.has_char:
+            shapes += [(None, None), (None, None, f // 4)]
+        shapes += [(None, None, self._in_dim), (None, None, 2 * f),
+                   (None, None, 2 * f), (None, 2 * f), (None, 2 * f)]
+        return {sub.name: sub.build(r, s)
+                for sub, r, s in zip(self._subs, rngs, shapes)}
+
+    def compute_output_shape(self, input_shape):
+        words = input_shape[0] if isinstance(input_shape, list) else \
+            input_shape
+        base = (words[0], words[1])
+        return [base + (self.num_pos,), base + (self.num_chunk,)]
+
+    def call(self, params, inputs, training=False, rng=None, **kw):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        words = inputs[0].astype(jnp.int32)
+        b, l = words.shape
+        x = self.word_emb.call(params[self.word_emb.name], words)
+        if self.has_char:
+            chars = inputs[1].astype(jnp.int32)
+            c = self.char_emb.call(params[self.char_emb.name], chars)
+            cw = c.reshape((b * l,) + c.shape[2:])
+            cf = self.char_lstm.call(params[self.char_lstm.name], cw,
+                                     training=training)
+            x = jnp.concatenate([x, cf.reshape(b, l, -1)], axis=-1)
+        if rng is not None:
+            rng, sub = jax.random.split(rng)
+            x = _dropout(x, self.dropout, sub, training)
+        for rnn in self.rnns:
+            x = rnn.call(params[rnn.name], x, training=training)
+        pos = self.pos_out.call(params[self.pos_out.name], x)
+        chunk = self.chunk_out.call(params[self.chunk_out.name], x)
+        return pos, chunk
+
+
+class SequenceTagger(TextKerasModel):
+    """POS-tagger + chunker (pos_tagging.py parity surface)."""
+
+    def __init__(self, num_pos_labels, num_chunk_labels, word_vocab_size,
+                 char_vocab_size=None, word_length=12, feature_size=100,
+                 dropout=0.2, classifier="softmax", optimizer=None,
+                 seq_len: Optional[int] = None):
+        classifier = classifier.lower()
+        if classifier not in ("softmax", "crf"):
+            raise ValueError("classifier should be either softmax or crf")
+        if classifier == "crf":
+            raise NotImplementedError(
+                "classifier='crf' is not yet supported; use 'softmax'")
+        net = _TaggerNet(num_pos_labels, num_chunk_labels, word_vocab_size,
+                         char_vocab_size=char_vocab_size,
+                         feature_size=feature_size, dropout=dropout)
+        words = Input(shape=(seq_len,), name="words")
+        ins = [words]
+        if char_vocab_size is not None:
+            ins.append(Input(shape=(seq_len, word_length), name="chars"))
+        pos, chunk = net(ins)
+        super().__init__(Model(ins, [pos, chunk]), optimizer,
+                         losses=["sparse_categorical_crossentropy"] * 2)
+
+    @staticmethod
+    def load_model(path):
+        return SequenceTagger._load_model(path)

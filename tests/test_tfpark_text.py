@@ -1,0 +1,138 @@
+"""TFPark text models (NER/SequenceTagger/IntentEntity) + BERT estimators."""
+
+import numpy as np
+import pytest
+
+
+def _tag_data(n=24, vocab=30, cvocab=12, seq=6, wlen=4, n_tags=5, seed=0):
+    rng = np.random.default_rng(seed)
+    words = rng.integers(0, vocab, (n, seq)).astype(np.int32)
+    chars = rng.integers(0, cvocab, (n, seq, wlen)).astype(np.int32)
+    tags = rng.integers(0, n_tags, (n, seq)).astype(np.int32)
+    return words, chars, tags
+
+
+def test_ner_fit_predict_save_load(tmp_path):
+    from analytics_zoo_tpu.tfpark.text import NER
+
+    words, chars, tags = _tag_data()
+    ner = NER(num_entities=5, word_vocab_size=30, char_vocab_size=12,
+              word_length=4, word_emb_dim=8, char_emb_dim=4,
+              tagger_lstm_dim=8, dropout=0.1)
+    ner.fit([words, chars], tags, batch_size=8, epochs=1)
+    preds = ner.predict([words[:4], chars[:4]])
+    assert preds.shape == (4, 6, 5)
+    np.testing.assert_allclose(preds.sum(-1), 1.0, rtol=1e-4)
+
+    path = str(tmp_path / "ner_model")
+    ner.save_model(path)
+    again = NER.load_model(path)
+    preds2 = again.predict([words[:4], chars[:4]])
+    np.testing.assert_allclose(preds, preds2, rtol=1e-5, atol=1e-6)
+
+
+def test_ner_crf_pad_unsupported():
+    from analytics_zoo_tpu.tfpark.text import NER
+
+    with pytest.raises(NotImplementedError):
+        NER(num_entities=3, word_vocab_size=10, char_vocab_size=5,
+            crf_mode="pad")
+
+
+def test_sequence_tagger_word_only_and_char():
+    from analytics_zoo_tpu.tfpark.text import SequenceTagger
+
+    words, chars, _ = _tag_data()
+    rng = np.random.default_rng(1)
+    pos = rng.integers(0, 4, (24, 6)).astype(np.int32)
+    chunk = rng.integers(0, 3, (24, 6)).astype(np.int32)
+
+    tag = SequenceTagger(num_pos_labels=4, num_chunk_labels=3,
+                         word_vocab_size=30, feature_size=8)
+    tag.fit(words, [pos, chunk], batch_size=8, epochs=1)
+    p, c = tag.predict(words[:4])
+    assert p.shape == (4, 6, 4) and c.shape == (4, 6, 3)
+
+    tag2 = SequenceTagger(num_pos_labels=4, num_chunk_labels=3,
+                          word_vocab_size=30, char_vocab_size=12,
+                          word_length=4, feature_size=8)
+    tag2.fit([words, chars], [pos, chunk], batch_size=8, epochs=1)
+    p2, c2 = tag2.predict([words[:4], chars[:4]])
+    assert p2.shape == (4, 6, 4) and c2.shape == (4, 6, 3)
+
+    with pytest.raises(NotImplementedError):
+        SequenceTagger(4, 3, 30, classifier="crf")
+
+
+def test_intent_entity_two_outputs():
+    from analytics_zoo_tpu.tfpark.text import IntentEntity
+
+    words, chars, tags = _tag_data()
+    intents = np.random.default_rng(2).integers(0, 3, (24,)).astype(np.int32)
+    model = IntentEntity(num_intents=3, num_entities=5, word_vocab_size=30,
+                         char_vocab_size=12, word_length=4, word_emb_dim=8,
+                         char_emb_dim=4, char_lstm_dim=4, tagger_lstm_dim=8)
+    model.fit([words, chars], [intents, tags], batch_size=8, epochs=1)
+    intent_p, tag_p = model.predict([words[:4], chars[:4]])
+    assert intent_p.shape == (4, 3)
+    assert tag_p.shape == (4, 6, 5)
+    np.testing.assert_allclose(intent_p.sum(-1), 1.0, rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# BERT estimators (tiny configs)
+# ---------------------------------------------------------------------------
+
+_TINY = dict(vocab_size=40, hidden_size=16, n_block=1, n_head=2,
+             seq_length=8, intermediate_size=32)
+
+
+def _bert_features(n=16, seq=8, vocab=40, seed=3):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(0, vocab, (n, seq)),
+            "input_mask": np.ones((n, seq)),
+            "token_type_ids": np.zeros((n, seq))}
+
+
+def test_bert_classifier_train_eval_predict():
+    from analytics_zoo_tpu.tfpark.text import BERTClassifier, bert_input_fn
+
+    feats = _bert_features()
+    labels = np.random.default_rng(4).integers(0, 2, (16,)).astype(np.int32)
+    est = BERTClassifier(num_classes=2, **_TINY)
+    est.train(bert_input_fn(feats, labels, batch_size=8), steps=3)
+    # repeated train() must keep advancing (triggers are offset)
+    est.train(bert_input_fn(feats, labels, batch_size=8), steps=2)
+    assert est.model._ensure_trainer().step == 5
+    metrics = est.evaluate(bert_input_fn(feats, labels, batch_size=8),
+                           metrics=["accuracy"])
+    assert "loss" in metrics and np.isfinite(metrics["loss"])
+    assert "accuracy" in metrics
+    preds = est.predict(bert_input_fn(feats, batch_size=8))
+    assert preds.shape == (16, 2)
+    np.testing.assert_allclose(preds.sum(-1), 1.0, rtol=1e-4)
+
+
+def test_bert_ner_shapes():
+    from analytics_zoo_tpu.tfpark.text import BERTNER, bert_input_fn
+
+    feats = _bert_features(n=8)
+    tags = np.random.default_rng(5).integers(0, 4, (8, 8)).astype(np.int32)
+    est = BERTNER(num_entities=4, **_TINY)
+    est.train(bert_input_fn(feats, tags, batch_size=4), steps=2)
+    preds = est.predict(bert_input_fn(feats, batch_size=4))
+    assert preds.shape == (8, 8, 4)
+
+
+def test_bert_squad_start_end():
+    from analytics_zoo_tpu.tfpark.text import BERTSQuAD, bert_input_fn
+
+    feats = _bert_features(n=8)
+    rng = np.random.default_rng(6)
+    starts = rng.integers(0, 8, (8,)).astype(np.int32)
+    ends = rng.integers(0, 8, (8,)).astype(np.int32)
+    est = BERTSQuAD(**_TINY)
+    est.train(bert_input_fn(feats, [starts, ends], batch_size=4), steps=2)
+    start_p, end_p = est.predict(bert_input_fn(feats, batch_size=4))
+    assert start_p.shape == (8, 8) and end_p.shape == (8, 8)
+    np.testing.assert_allclose(start_p.sum(-1), 1.0, rtol=1e-4)
