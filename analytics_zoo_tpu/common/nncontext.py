@@ -45,6 +45,15 @@ class ZooConfig:
     # donate params/opt-state buffers into the train step (halves param
     # memory; adds dispatch latency on some backends)
     donate_buffers: bool = False
+    # steps fused into one dispatch via lax.scan (0 = auto: the engine
+    # measures steady-state step wall time and fuses when dispatch-bound —
+    # essential when the TPU runtime sits behind a high-RTT tunnel)
+    steps_per_dispatch: int = 0
+    # §5.1 profiling: when set, capture a jax.profiler trace of
+    # ``profile_num_steps`` steps starting at ``profile_start_step``
+    profile_dir: Optional[str] = None
+    profile_start_step: int = 10
+    profile_num_steps: int = 5
 
     @classmethod
     def from_env(cls, **overrides):
@@ -118,6 +127,13 @@ class ZooContext:
     def data_sharding(self):
         from jax.sharding import NamedSharding, PartitionSpec as P
         return NamedSharding(self.mesh, P("data"))
+
+    def stacked_batch_sharding(self):
+        """Sharding for a k-step super-batch ``(k, batch, ...)``: the step
+        axis is replicated (scanned over), the batch axis data-sharded."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(self.mesh,
+                             P(None, ("data", "pipe", "seq", "expert")))
 
     def replicated_sharding(self):
         from jax.sharding import NamedSharding, PartitionSpec as P

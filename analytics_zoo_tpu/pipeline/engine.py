@@ -27,12 +27,14 @@ import numpy as np
 import optax
 
 from ..common.nncontext import ZooContext, get_nncontext
-from ..common.zoo_trigger import (EveryEpoch, MaxEpoch, TrainRecord,
+from ..common.zoo_trigger import (And, EveryEpoch, MaxEpoch, MaxIteration,
+                                  Or, SeveralIteration, TrainRecord,
                                   ZooTrigger)
 from ..feature.feature_set import (ArrayFeatureSet, FeatureSet, MiniBatch,
                                    minibatch_len, pad_minibatch,
                                    PrefetchIterator)
 from ..utils import serialization
+from ..utils.profiling import ProfilerHook, device_sync, peak_flops
 
 logger = logging.getLogger("analytics_zoo_tpu.engine")
 
@@ -44,6 +46,30 @@ def _cast_tree(tree, dtype):
         return x
 
     return jax.tree.map(cast, tree)
+
+
+def _iteration_granularity(trigger: Optional[ZooTrigger],
+                           record: TrainRecord) -> int:
+    """Upper bound on how many steps may be fused into one dispatch before
+    ``trigger`` could fire or change its answer. Epoch-level triggers are
+    unbounded inside an epoch; iteration-counted triggers bound exactly;
+    unknown (e.g. loss-based MinLoss) triggers force per-step evaluation."""
+    if trigger is None:
+        return 10 ** 9
+    if isinstance(trigger, (EveryEpoch, MaxEpoch)):
+        return 10 ** 9
+    if isinstance(trigger, MaxIteration):
+        return max(1, trigger.max_iteration - record.iteration)
+    if isinstance(trigger, SeveralIteration):
+        return max(1, trigger.interval - record.iteration % trigger.interval)
+    if isinstance(trigger, (And, Or)):
+        return max(1, min(_iteration_granularity(t, record)
+                          for t in trigger.triggers))
+    return 1
+
+
+def _iteration_granularity_all(record: TrainRecord, *triggers) -> int:
+    return max(1, min(_iteration_granularity(t, record) for t in triggers))
 
 
 class GradientClipping:
@@ -106,8 +132,13 @@ class SPMDTrainer:
         self.step = 0
         self.epoch = 0
         self._train_step = None
+        self._multi_steps: Dict[int, Callable] = {}   # scan length -> fn
+        self._auto_k = None      # measured steps-per-dispatch decision
         self._eval_step = None
         self._predict_step = None
+        # optional: matmul FLOPs of one train step; enables the MFU scalar
+        # in TrainSummary (§5.1)
+        self.flops_per_step: Optional[float] = None
         # observability hooks
         self.train_summary = None
         self.val_summary = None
@@ -164,30 +195,61 @@ class SPMDTrainer:
             self.loss_fn(preds_f, None, w)
         return loss, (preds_f, new_state)
 
+    def _step_body(self, params, opt_state, net_state, batch, step):
+        """One optimization step (traced): fwd, bwd, clip, update."""
+        rng = jax.random.fold_in(jax.random.PRNGKey(self.seed), step)
+        (loss, (_, new_state)), grads = jax.value_and_grad(
+            lambda p: self._loss_and_preds(p, net_state, batch, rng,
+                                           True), has_aux=True)(params)
+        grads = self.clipping.apply(grads)
+        updates, opt_state = self.tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        logs = {"loss": loss,
+                "grad_norm": optax.global_norm(grads)}
+        return params, opt_state, new_state, logs
+
     def build_train_step(self):
         if self._train_step is not None:
             return self._train_step
 
         def step_fn(params, opt_state, net_state, batch, step):
-            rng = jax.random.fold_in(jax.random.PRNGKey(self.seed), step)
-            (loss, (_, new_state)), grads = jax.value_and_grad(
-                lambda p: self._loss_and_preds(p, net_state, batch, rng,
-                                               True), has_aux=True)(params)
-            grads = self.clipping.apply(grads)
-            updates, opt_state = self.tx.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            logs = {"loss": loss,
-                    "grad_norm": optax.global_norm(grads)}
-            return params, opt_state, new_state, logs
+            return self._step_body(params, opt_state, net_state, batch, step)
 
-        # Buffer donation halves peak param memory but costs ~30ms/step of
-        # dispatch latency on the axon TPU backend (measured); keep it opt-in
-        # for models whose params actually pressure HBM.
         if self.ctx.config.donate_buffers:
             self._train_step = jax.jit(step_fn, donate_argnums=(0, 1, 2))
         else:
             self._train_step = jax.jit(step_fn)
         return self._train_step
+
+    def build_multi_step(self, k: int):
+        """k steps fused into ONE dispatched XLA program via ``lax.scan``
+        over a device-resident ``(k, batch, ...)`` super-batch.
+
+        This is the dispatch-latency amortizer: when the TPU runtime sits
+        behind a high-RTT tunnel (or the per-step compute is tiny relative
+        to dispatch cost), one dispatch per step leaves the chip idle
+        between steps. The reference has the same structural problem — 2
+        Spark jobs per iteration, with task-launch overhead >10% of compute
+        at scale (wp-bigdl.md:171-173); scan is the XLA-native fix.
+        """
+        if k in self._multi_steps:     # keyed by scan length: alternating
+            return self._multi_steps[k]  # k values must not recompile
+
+        def multi_fn(params, opt_state, net_state, batches, step0):
+            def body(carry, batch):
+                params, opt_state, net_state, step = carry
+                params, opt_state, net_state, logs = self._step_body(
+                    params, opt_state, net_state, batch, step)
+                return (params, opt_state, net_state, step + 1), logs["loss"]
+
+            (params, opt_state, net_state, _), losses = jax.lax.scan(
+                body, (params, opt_state, net_state, step0), batches)
+            return params, opt_state, net_state, {"loss": losses[-1]}
+
+        # donate the carried state: amortized over k steps, and the caller
+        # always rebinds self.params/... to the returned arrays
+        self._multi_steps[k] = jax.jit(multi_fn, donate_argnums=(0, 1, 2))
+        return self._multi_steps[k]
 
     def build_eval_step(self):
         if self._eval_step is not None:
@@ -231,6 +293,18 @@ class SPMDTrainer:
         return jax.tree.map(
             lambda leaf: jax.device_put(leaf, sh) if leaf is not None else
             None, tuple(batch), is_leaf=lambda x: x is None)
+
+    def _put_stacked(self, batches: Sequence[MiniBatch]):
+        """Stack k host minibatches into one (k, batch, ...) super-batch on
+        device: step axis replicated (scanned over), batch axis sharded."""
+        padded = [tuple(self._pad_to_dp_multiple(b)) for b in batches]
+        stacked = jax.tree.map(
+            lambda *leaves: None if leaves[0] is None else np.stack(leaves),
+            *padded, is_leaf=lambda x: x is None)
+        sh = self.ctx.stacked_batch_sharding()
+        return jax.tree.map(
+            lambda leaf: jax.device_put(leaf, sh) if leaf is not None else
+            None, stacked, is_leaf=lambda x: x is None)
 
     def _pad_to_dp_multiple(self, batch: MiniBatch) -> MiniBatch:
         """Batch-dim sharding needs len % dp == 0. Steady-state training
@@ -300,32 +374,147 @@ class SPMDTrainer:
         finally:
             it.close()
 
+    # how many steps one fused dispatch covers, and when auto mode fuses:
+    # if a measured steady-state step (incl. dispatch+RTT share) is faster
+    # than the threshold, per-step dispatch overhead dominates -> scan.
+    MULTI_STEP_K = 16
+    AUTO_MEASURE_STEPS = 8
+    AUTO_SCAN_THRESHOLD_S = 0.040
+
+    def _steps_per_dispatch_target(self):
+        cfg_k = self.ctx.config.steps_per_dispatch
+        if cfg_k > 0:
+            return cfg_k
+        return self._auto_k if self._auto_k is not None else 1
+
     def _epoch_loop(self, it, step_fn, record, batch_size, t0,
                     checkpoint_trigger, validation_set, validation_trigger,
                     end_trigger, log_every):
+        cfg = self.ctx.config
         n_batches = 0
         last_loss = None
-        for host_batch in it:
-            batch = self._put_batch(host_batch)
-            self.params, self.opt_state, self.net_state, logs = step_fn(
-                self.params, self.opt_state, self.net_state, batch,
-                self.step)
-            self.step += 1
+        infeed_wait = 0.0
+        window_t0 = time.perf_counter()
+        window_steps = 0
+        last_log_step = self.step
+        host_iter = iter(it)
+        profiler = ProfilerHook(cfg.profile_dir, cfg.profile_start_step,
+                                cfg.profile_num_steps) \
+            if cfg.profile_dir else None
+        measure_start = None
+        measure_steps = 0
+
+        def fetch():
+            nonlocal infeed_wait
+            tf = time.perf_counter()
+            try:
+                b = next(host_iter)
+            except StopIteration:
+                return None
+            infeed_wait += time.perf_counter() - tf
+            return b
+
+        while True:
+            k = min(self._steps_per_dispatch_target(),
+                    _iteration_granularity_all(
+                        record, end_trigger, checkpoint_trigger,
+                        validation_trigger))
+            eof = False
+            if k > 1:
+                chunk: List[MiniBatch] = []
+                while len(chunk) < k:
+                    hb = fetch()
+                    if hb is None:
+                        eof = True
+                        break
+                    chunk.append(hb)
+                if not chunk:
+                    break
+                if len(chunk) == k:
+                    stacked = self._put_stacked(chunk)
+                    multi = self.build_multi_step(k)
+                    (self.params, self.opt_state, self.net_state,
+                     logs) = multi(self.params, self.opt_state,
+                                   self.net_state, stacked, self.step)
+                    done = k
+                else:
+                    # epoch tail shorter than k: reuse the single-step
+                    # program rather than compiling a second scan length
+                    done = 0
+                    for hb in chunk:
+                        batch = self._put_batch(hb)
+                        (self.params, self.opt_state, self.net_state,
+                         logs) = step_fn(self.params, self.opt_state,
+                                         self.net_state, batch,
+                                         self.step + done)
+                        done += 1
+            else:
+                hb = fetch()
+                if hb is None:
+                    break
+                batch = self._put_batch(hb)
+                self.params, self.opt_state, self.net_state, logs = step_fn(
+                    self.params, self.opt_state, self.net_state, batch,
+                    self.step)
+                done = 1
+                # auto steps_per_dispatch: measure steady-state step wall
+                # time (first step absorbs compilation, so sync there and
+                # time the next AUTO_MEASURE_STEPS dispatches)
+                if cfg.steps_per_dispatch == 0 and self._auto_k is None:
+                    if measure_start is None:
+                        device_sync(logs["loss"])
+                        measure_start = time.perf_counter()
+                        measure_steps = 0
+                    else:
+                        measure_steps += 1
+                        if measure_steps >= self.AUTO_MEASURE_STEPS:
+                            device_sync(logs["loss"])
+                            per = ((time.perf_counter() - measure_start)
+                                   / measure_steps)
+                            self._auto_k = (self.MULTI_STEP_K
+                                            if per <
+                                            self.AUTO_SCAN_THRESHOLD_S
+                                            else 1)
+                            logger.info(
+                                "auto steps_per_dispatch: %.1f ms/step "
+                                "-> k=%d", per * 1e3, self._auto_k)
+            self.step += done
+            n_batches += done
+            window_steps += done
             record.iteration = self.step
             record.epoch_finished = False
             last_loss = logs["loss"]
-            n_batches += 1
-            if self.step % log_every == 0:
-                loss_v = float(last_loss)
+            if profiler is not None:
+                profiler.step(self.step)
+            if self.step - last_log_step >= log_every:
+                last_log_step = self.step
+                loss_v = float(np.asarray(last_loss))
                 record.loss = loss_v
                 lr = float(self.lr_schedule(self.step))
+                now = time.perf_counter()
+                wall = max(now - window_t0, 1e-9)
                 if self.train_summary is not None:
                     self.train_summary.add_scalar("Loss", loss_v, self.step)
                     self.train_summary.add_scalar("LearningRate", lr,
                                                   self.step)
-                    tput = n_batches * batch_size / (time.time() - t0)
-                    self.train_summary.add_scalar("Throughput", tput,
-                                                  self.step)
+                    self.train_summary.add_scalar(
+                        "Throughput", window_steps * batch_size / wall,
+                        self.step)
+                    self.train_summary.add_scalar(
+                        "StepTimeMs", wall / window_steps * 1e3, self.step)
+                    self.train_summary.add_scalar(
+                        "InfeedWaitMs", infeed_wait / window_steps * 1e3,
+                        self.step)
+                    if self.flops_per_step:
+                        peak = peak_flops(
+                            getattr(self.ctx.devices[0], "device_kind", ""))
+                        if peak:
+                            self.train_summary.add_scalar(
+                                "MFU", self.flops_per_step * window_steps
+                                / wall / peak, self.step)
+                window_t0 = now
+                window_steps = 0
+                infeed_wait = 0.0
                 logger.info("epoch %d step %d loss %.5f", record.epoch,
                             self.step, loss_v)
             if checkpoint_trigger is not None and checkpoint_trigger(record):
@@ -334,6 +523,10 @@ class SPMDTrainer:
                 self._run_validation(validation_set, batch_size, record)
             if end_trigger is not None and end_trigger(record):
                 break  # per-iteration end check (parity: endWhen)
+            if eof:
+                break
+        if profiler is not None:
+            profiler.close()
         # epoch end
         if last_loss is not None:
             record.loss = float(last_loss)

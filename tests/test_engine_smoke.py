@@ -110,3 +110,46 @@ def test_shared_layer_weight_sharing():
     bias = [w for w in model.get_weights() if w.ndim == 1][0]
     np.testing.assert_allclose(preds_same, 2 * (half - bias) + 2 * bias,
                                rtol=1e-4, atol=1e-5)
+
+
+def test_multi_step_dispatch_matches_single_step():
+    """lax.scan-fused k-step dispatch must be bit-identical to k=1 (same rng
+    stream, same batch order) — it only amortizes dispatch latency."""
+    from analytics_zoo_tpu.common.nncontext import (ZooConfig, ZooContext,
+                                                    set_nncontext)
+
+    def train(k):
+        set_nncontext(None)
+        set_nncontext(ZooContext(ZooConfig(steps_per_dispatch=k)))
+        x, y = _xor_data()
+        model = Sequential()
+        model.add(Dense(16, activation="relu", input_shape=(8,)))
+        model.add(Dense(1, activation="sigmoid"))
+        model.compile(optimizer=Adam(lr=0.01), loss="binary_crossentropy")
+        model.fit(x, y, batch_size=64, nb_epoch=3)
+        return [np.asarray(w) for w in model.get_weights()]
+
+    w1, w4 = train(1), train(4)
+    for a, b in zip(w1, w4):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_multi_step_dispatch_respects_max_iteration():
+    """A fused dispatch may never overshoot an iteration-granular trigger."""
+    from analytics_zoo_tpu.common.nncontext import (ZooConfig, ZooContext,
+                                                    set_nncontext)
+    from analytics_zoo_tpu.common.zoo_trigger import MaxIteration
+    from analytics_zoo_tpu.feature.feature_set import ArrayFeatureSet
+
+    set_nncontext(None)
+    set_nncontext(ZooContext(ZooConfig(steps_per_dispatch=16)))
+    x, y = _xor_data()
+    model = Sequential()
+    model.add(Dense(8, activation="relu", input_shape=(8,)))
+    model.add(Dense(1, activation="sigmoid"))
+    model.compile(optimizer=Adam(lr=0.01), loss="binary_crossentropy")
+    trainer = model._ensure_trainer()
+    record = trainer.train(ArrayFeatureSet([x], y), batch_size=64,
+                           end_trigger=MaxIteration(5))
+    assert trainer.step == 5, trainer.step
+    assert record.iteration == 5
