@@ -31,6 +31,7 @@ from .wrappers import Bidirectional, KerasLayerWrapper, TimeDistributed
 from .advanced_activations import (ELU, LeakyReLU, PReLU, RReLU, Softmax,
                                    SReLU, ThresholdedReLU)
 from .moe import SparseMoE
+from .crf import CRF
 
 # Convenience aliases matching Keras-2-style names used around the reference
 Conv1D = Convolution1D

@@ -221,8 +221,34 @@ class Identity(LossFunction):
         return _flat_mean(y_pred)
 
 
+class CRFLoss(LossFunction):
+    """Negative CRF log-likelihood over a ``CRF`` layer's output pair.
+
+    Expects ``y_pred = [unary (B,L,E), transitions (B,E,E)]`` (optionally a
+    third ``mask (B,L)`` output for 'pad'-style explicit lengths) and
+    ``y_true`` integer tags ``(B, L)``. Parity: the CRF objective inside
+    nlp_architect NERCRF, the head of the reference's NER
+    (pyzoo/zoo/tfpark/text/keras/ner.py:49)."""
+
+    def per_sample(self, y_pred, y_true):
+        from ....ops.crf import crf_log_likelihood
+
+        if not isinstance(y_pred, (list, tuple)) or len(y_pred) < 2:
+            raise ValueError("CRFLoss needs [unary, transitions] outputs "
+                             "(add a CRF layer as the model head)")
+        unary, trans = y_pred[0], y_pred[1]
+        mask = y_pred[2] if len(y_pred) > 2 else None
+        tags = (y_true[0] if isinstance(y_true, (list, tuple)) else y_true)
+        tags = tags.astype(jnp.int32)
+        if tags.ndim == unary.ndim:        # one-hot targets
+            tags = tags.argmax(-1)
+        return -crf_log_likelihood(unary, tags, trans[0], mask)
+
+
 _LOSSES = {
     "identity": Identity,
+    "crf": CRFLoss,
+    "crf_nll": CRFLoss,
     "binary_crossentropy": BinaryCrossEntropy,
     "categorical_crossentropy": CategoricalCrossEntropy,
     "mse": MeanSquaredError,

@@ -31,12 +31,15 @@ def test_ner_fit_predict_save_load(tmp_path):
     np.testing.assert_allclose(preds, preds2, rtol=1e-5, atol=1e-6)
 
 
-def test_ner_crf_pad_unsupported():
+def test_ner_crf_mode_validation():
     from analytics_zoo_tpu.tfpark.text import NER
 
-    with pytest.raises(NotImplementedError):
+    with pytest.raises(ValueError):
         NER(num_entities=3, word_vocab_size=10, char_vocab_size=5,
-            crf_mode="pad")
+            crf_mode="bogus")
+    # both reference modes construct (full CRF coverage in test_crf.py)
+    NER(num_entities=3, word_vocab_size=10, char_vocab_size=5,
+        crf_mode="pad", word_emb_dim=8, char_emb_dim=4, tagger_lstm_dim=8)
 
 
 def test_sequence_tagger_word_only_and_char():
@@ -60,8 +63,8 @@ def test_sequence_tagger_word_only_and_char():
     p2, c2 = tag2.predict([words[:4], chars[:4]])
     assert p2.shape == (4, 6, 4) and c2.shape == (4, 6, 3)
 
-    with pytest.raises(NotImplementedError):
-        SequenceTagger(4, 3, 30, classifier="crf")
+    with pytest.raises(ValueError):
+        SequenceTagger(4, 3, 30, classifier="bogus")
 
 
 def test_intent_entity_two_outputs():
