@@ -50,9 +50,10 @@ class Net:
 
     @staticmethod
     def load_caffe(def_path: str, model_path: str):
-        raise NotImplementedError(
-            "Caffe import is not available in this build; convert the model "
-            "to ONNX and use Net.load_onnx (reference: CaffeLoader.scala)")
+        """Caffe prototxt + caffemodel → zoo Keras Model (parity:
+        ``CaffeLoader.scala:718`` + LayerConverter/V1LayerConverter)."""
+        from ..caffe import load_caffe
+        return load_caffe(def_path, model_path)
 
     # camelCase aliases (scala-side naming)
     loadTF = load_tf
