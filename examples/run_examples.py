@@ -1,0 +1,49 @@
+"""Run every example as a subprocess smoke suite.
+
+Reference analogue: ``pyzoo/zoo/examples/run-example-tests.sh`` (the shell
+runner CI uses to execute the examples tier). Usage::
+
+    python examples/run_examples.py            # all, CPU
+    python examples/run_examples.py ncf bert   # substring filter
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+EXAMPLES = [
+    "recommendation_ncf.py",
+    "recommendation_wide_and_deep.py",
+    "text_classification.py",
+    "anomaly_detection.py",
+    "object_detection_ssd.py",
+    "tfpark_bert_finetune.py",
+]
+
+
+def main() -> int:
+    here = os.path.dirname(os.path.abspath(__file__))
+    filters = [a for a in sys.argv[1:] if not a.startswith("-")]
+    selected = [e for e in EXAMPLES
+                if not filters or any(f in e for f in filters)]
+    failures = []
+    for name in selected:
+        t0 = time.time()
+        print(f"=== {name}", flush=True)
+        proc = subprocess.run([sys.executable, name, "--platform", "cpu"],
+                              cwd=here)
+        status = "OK" if proc.returncode == 0 else \
+            f"FAILED rc={proc.returncode}"
+        print(f"=== {name}: {status} ({time.time() - t0:.1f}s)", flush=True)
+        if proc.returncode != 0:
+            failures.append(name)
+    if failures:
+        print(f"FAILURES: {failures}")
+        return 1
+    print(f"all {len(selected)} examples passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

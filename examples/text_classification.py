@@ -1,0 +1,43 @@
+"""TextClassifier on news20-style token sequences.
+
+Reference example: ``pyzoo/zoo/examples/textclassification/
+text_classification.py`` — news20 + GloVe embeddings into the zoo
+TextClassifier (CNN/LSTM/GRU encoder). Here the embedding table is a small
+random matrix instead of downloaded GloVe vectors.
+"""
+
+import numpy as np
+
+from common import example_args, news_like
+
+from analytics_zoo_tpu.models.textclassification import TextClassifier
+from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+
+VOCAB, SEQ_LEN, CLASSES, EMB_DIM = 500, 64, 5, 32
+
+
+def main():
+    args = example_args("TextClassifier / news20-style documents",
+                        epochs=8, samples=1024)
+    docs, labels = news_like(args.samples, vocab=VOCAB, seq_len=SEQ_LEN,
+                             n_classes=CLASSES, seed=args.seed)
+    embedding = np.random.default_rng(args.seed) \
+        .standard_normal((VOCAB + 1, EMB_DIM)).astype(np.float32) * 0.1
+
+    for encoder, lr, epochs in (("cnn", 2e-3, args.epochs),
+                                ("gru", 5e-3, 2 * args.epochs)):
+        clf = TextClassifier(class_num=CLASSES, embedding=embedding,
+                             sequence_length=SEQ_LEN, encoder=encoder,
+                             encoder_output_dim=32)
+        clf.compile(optimizer=Adam(lr=lr),
+                    loss="sparse_categorical_crossentropy",
+                    metrics=["accuracy"])
+        clf.fit(docs, labels, batch_size=args.batch_size, nb_epoch=epochs)
+        res = clf.evaluate(docs, labels, batch_size=args.batch_size)
+        print(f"encoder={encoder}: {res}")
+        assert res["accuracy"] > 0.6, (encoder, res)
+    print("TextClassifier example OK")
+
+
+if __name__ == "__main__":
+    main()

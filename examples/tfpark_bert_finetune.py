@@ -1,0 +1,55 @@
+"""BERT classifier fine-tune through the TFPark estimator surface.
+
+Reference example: ``pyzoo/zoo/examples/tfpark/estimator/
+estimator_inception.py`` family + the BERTClassifier estimator
+(``pyzoo/zoo/tfpark/text/estimator/bert_classifier.py``) fine-tuned on a
+GLUE-style sentence-pair task. Here: a small BERT encoder on a synthetic
+separable token task (no checkpoint download), driven through train /
+evaluate / predict input_fns.
+"""
+
+import numpy as np
+
+from common import example_args
+
+from analytics_zoo_tpu.tfpark.text import BERTClassifier, bert_input_fn
+
+VOCAB, SEQ, CLASSES = 120, 16, 2
+
+
+def make_task(n, seed):
+    """Class 1 iff the sequence contains token ids from the top half."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, CLASSES, n).astype(np.int32)
+    ids = rng.integers(1, VOCAB // 2, (n, SEQ))
+    hot = labels == 1
+    ids[hot, :SEQ // 2] = rng.integers(VOCAB // 2, VOCAB,
+                                       (int(hot.sum()), SEQ // 2))
+    return {"input_ids": ids,
+            "input_mask": np.ones((n, SEQ)),
+            "token_type_ids": np.zeros((n, SEQ))}, labels
+
+
+def main():
+    args = example_args("BERT fine-tune / TFPark estimator", epochs=3,
+                        samples=256, batch_size=32)
+    feats, labels = make_task(args.samples, args.seed)
+
+    est = BERTClassifier(num_classes=CLASSES, vocab_size=VOCAB,
+                         hidden_size=32, n_block=2, n_head=2,
+                         seq_length=SEQ, intermediate_size=64)
+    steps = args.epochs * (args.samples // args.batch_size)
+    est.train(bert_input_fn(feats, labels, batch_size=args.batch_size),
+              steps=steps)
+    metrics = est.evaluate(
+        bert_input_fn(feats, labels, batch_size=args.batch_size),
+        metrics=["accuracy"])
+    print(f"evaluation: {metrics}")
+    preds = est.predict(bert_input_fn(feats, batch_size=args.batch_size))
+    print(f"predictions: {preds.shape}, first row {preds[0]}")
+    assert metrics["accuracy"] > 0.7, metrics
+    print("BERT fine-tune example OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,42 @@
+"""Examples tier as smoke tests (SURVEY §4: the reference's examples are its
+de-facto integration suite; runner analogue: run-example-tests.sh).
+
+Two fast representatives always run; the full six run via
+``ZOO_RUN_ALL_EXAMPLES=1 pytest tests/test_examples.py`` or
+``python examples/run_examples.py``.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples")
+
+FAST = ["recommendation_wide_and_deep.py", "anomaly_detection.py"]
+ALL = FAST + ["recommendation_ncf.py", "text_classification.py",
+              "object_detection_ssd.py", "tfpark_bert_finetune.py"]
+
+
+def _run(name):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)      # examples are single-host scripts
+    proc = subprocess.run([sys.executable, name, "--platform", "cpu"],
+                          cwd=EXAMPLES_DIR, capture_output=True, text=True,
+                          timeout=900, env=env)
+    assert proc.returncode == 0, \
+        f"{name} failed:\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+
+
+@pytest.mark.parametrize("name", FAST)
+def test_fast_examples(name):
+    _run(name)
+
+
+@pytest.mark.skipif(not os.environ.get("ZOO_RUN_ALL_EXAMPLES"),
+                    reason="set ZOO_RUN_ALL_EXAMPLES=1 for the full tier")
+@pytest.mark.parametrize("name", [n for n in ALL if n not in FAST])
+def test_all_examples(name):
+    _run(name)
