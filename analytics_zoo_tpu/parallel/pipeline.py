@@ -61,28 +61,33 @@ def pipeline_forward(stage_fn: Callable, stacked_params, x, mesh: Mesh,
     Parameters
     ----------
     stage_fn: ``(stage_params, activation) -> activation`` — one pipeline
-        stage; activations must keep the same shape across stages (the
-        transformer-trunk case).
+        stage; activations must keep the same structure/shapes across stages
+        (the transformer-trunk case).
     stacked_params: pytree with leading stage dim ``S == mesh.shape[axis]``,
         laid out with :func:`stage_param_sharding`.
-    x: ``(batch, ...)`` activations entering stage 0.
+    x: ``(batch, ...)`` activations entering stage 0 — an array or a pytree
+        of batch-leading arrays (e.g. hidden states + an attention mask +
+        per-sample dropout seeds riding along the ring unchanged).
     n_microbatch: number of microbatches ``M`` (``batch % M == 0``).
     batch_axis: mesh axis the batch dim is sharded over (dp × pp composes);
         ``None`` for replicated input.
 
-    Returns activations after the last stage, ``(batch, ...)``.
+    Returns activations after the last stage, same structure as ``x``.
     """
     S = mesh.shape[axis]
-    batch = x.shape[0]
+    leaves = jax.tree.leaves(x)
+    batch = leaves[0].shape[0]
     if batch % n_microbatch:
         raise ValueError(f"batch {batch} not divisible by "
                          f"n_microbatch {n_microbatch}")
     mb = batch // n_microbatch
 
-    # (M, mb, ...) microbatch-major view
-    xs = x.reshape((n_microbatch, mb) + x.shape[1:])
+    # (M, mb, ...) microbatch-major view per leaf
+    xs = jax.tree.map(
+        lambda a: a.reshape((n_microbatch, mb) + a.shape[1:]), x)
 
-    data_spec = P(None, batch_axis) if batch_axis else P()
+    data_spec_one = P(None, batch_axis) if batch_axis else P()
+    data_spec = jax.tree.map(lambda _: data_spec_one, xs)
     param_spec = jax.tree.map(
         lambda leaf: P(axis, *([None] * (leaf.ndim - 1))), stacked_params)
 
@@ -97,41 +102,53 @@ def pipeline_forward(stage_fn: Callable, stacked_params, x, mesh: Mesh,
         last = S - 1
         # the carry is device-varying over the pipe ring; mark the zero
         # initializers as such for the vma type system
-        state = _pvary(jnp.zeros_like(xs[0]), axis)
-        outputs = _pvary(jnp.zeros_like(xs), axis)
-        M = xs.shape[0]
+        state = jax.tree.map(
+            lambda a: _pvary(jnp.zeros_like(a[0]), axis), xs)
+        outputs = jax.tree.map(lambda a: _pvary(jnp.zeros_like(a), axis),
+                               xs)
+        M = jax.tree.leaves(xs)[0].shape[0]
 
         def tick(carry, t):
             state, outputs = carry
             # rank 0 consumes fresh input while it lasts; everyone else
             # consumes what the previous rank ppermuted over last tick
             feed_idx = jnp.minimum(t, M - 1)
-            inject = lax.dynamic_index_in_dim(xs, feed_idx, 0,
-                                              keepdims=False)
-            cur = jnp.where(rank == 0, inject, state)
+            inject = jax.tree.map(
+                lambda a: lax.dynamic_index_in_dim(a, feed_idx, 0,
+                                                   keepdims=False), xs)
+            cur = jax.tree.map(
+                lambda i, s: jnp.where(rank == 0, i, s), inject, state)
             out = stage_fn(p_local, cur)
             # the last rank finished microbatch t-(S-1) this tick
             done_idx = t - last
             idx_c = jnp.clip(done_idx, 0, M - 1)
             valid = (done_idx >= 0) & (rank == last)
-            prev = lax.dynamic_index_in_dim(outputs, idx_c, 0,
-                                            keepdims=False)
-            outputs = lax.dynamic_update_index_in_dim(
-                outputs, jnp.where(valid, out, prev), idx_c, 0)
-            state = lax.ppermute(out, axis,
-                                 [(i, (i + 1) % S) for i in range(S)])
+
+            def upd(outs, o):
+                prev = lax.dynamic_index_in_dim(outs, idx_c, 0,
+                                                keepdims=False)
+                return lax.dynamic_update_index_in_dim(
+                    outs, jnp.where(valid, o, prev), idx_c, 0)
+
+            outputs = jax.tree.map(upd, outputs, out)
+            state = jax.tree.map(
+                lambda o: lax.ppermute(o, axis,
+                                       [(i, (i + 1) % S)
+                                        for i in range(S)]), out)
             return (state, outputs), None
 
         (state, outputs), _ = lax.scan(tick, (state, outputs),
                                        jnp.arange(M + S - 1))
         # outputs are only populated on the last rank; broadcast over the
         # ring (psum of zeros elsewhere)
-        outputs = jnp.where(rank == last, outputs, jnp.zeros_like(outputs))
-        outputs = lax.psum(outputs, axis)
+        outputs = jax.tree.map(
+            lambda o: lax.psum(
+                jnp.where(rank == last, o, jnp.zeros_like(o)), axis),
+            outputs)
         return outputs
 
     out = run(stacked_params, xs)
-    return out.reshape((batch,) + out.shape[2:])
+    return jax.tree.map(lambda a: a.reshape((batch,) + a.shape[2:]), out)
 
 
 def sequential_reference(stage_fn: Callable, per_stage_params, x):
