@@ -14,6 +14,8 @@ on symbolic Variables (weight sharing included).
 
 from __future__ import annotations
 
+import json
+import logging
 import os
 import pickle
 from typing import Any, Dict, List, Optional, Sequence
@@ -81,6 +83,7 @@ class KerasNet(KerasLayer):
         self._checkpoint_trigger: Optional[ZooTrigger] = None
         self._tb: Optional[tuple] = None
         self._compute_dtype = None
+        self._frozen: set = set()
 
     # -- abstract ------------------------------------------------------
     def graph_function(self) -> GraphFunction:
@@ -166,7 +169,61 @@ class KerasNet(KerasLayer):
             self.trainer.train_summary = tensorboard.TrainSummary(*self._tb)
             self.trainer.val_summary = tensorboard.ValidationSummary(
                 *self._tb)
+        if self._frozen:
+            self.trainer.set_frozen(self._frozen)
         return self.trainer
+
+    # -- freeze / transfer learning (GraphNet freeze/unFreeze parity) --
+    def freeze(self, names: Optional[Sequence[str]] = None):
+        """Exclude layers from training (all layers when ``names`` is
+        None). Parity: ``GraphNet.freeze`` (NetUtils.scala)."""
+        layer_names = {l.name for l in self.graph_function().layers}
+        if names is None:
+            self._frozen = set(layer_names)
+        else:
+            unknown = set(names) - layer_names
+            if unknown:
+                raise ValueError(f"unknown layers: {sorted(unknown)}")
+            self._frozen |= set(names)
+        if self.trainer is not None:
+            self.trainer.set_frozen(self._frozen)
+        return self
+
+    def unfreeze(self, names: Optional[Sequence[str]] = None):
+        if names is None:
+            self._frozen = set()
+        else:
+            self._frozen -= set(names)
+        if self.trainer is not None:
+            self.trainer.set_frozen(self._frozen)
+        return self
+
+    def freeze_up_to(self, *names: str):
+        """Freeze every layer from the inputs up to (and including) the
+        named layers (parity: ``GraphNet.freezeUpTo``)."""
+        graph = self.graph_function()
+        nodes_by_layer = {}
+        for node in graph.nodes:
+            nodes_by_layer.setdefault(node.layer.name, []).append(node)
+        unknown = set(names) - set(nodes_by_layer)
+        if unknown:
+            raise ValueError(f"unknown layers: {sorted(unknown)}")
+        target = set()
+        visited = set()
+        stack = [n for name in names for n in nodes_by_layer[name]]
+        while stack:
+            node = stack.pop()
+            if node.id in visited:
+                continue
+            visited.add(node.id)
+            target.add(node.layer.name)
+            for v in node.inputs:
+                if v.node is not None:
+                    stack.append(v.node)
+        return self.freeze(sorted(target))
+
+    def frozen_layers(self) -> List[str]:
+        return sorted(self._frozen)
 
     def set_param_sharding(self, fn):
         """Install a params->shardings fn (see parallel.sharding)."""
@@ -250,23 +307,48 @@ class KerasNet(KerasLayer):
 
     # -- persistence ---------------------------------------------------
     def save_model(self, path, weight_path=None, over_write=False):
-        """Saves architecture (pickled python description) + weights (npz).
+        """Saves architecture (definition JSON: layer classes + captured
+        configs + DAG connectivity, ``engine/model_io.py``) + weights (npz).
 
-        Parity: ``KerasNet.saveModel`` (Topology.scala:109); format is
-        TPU-native (no BigDL protobuf).
+        Parity: ``KerasNet.saveModel`` (Topology.scala:109) — the reference
+        also persists a language-neutral module graph, not a pickled
+        object. Graphs holding arbitrary callables (Lambda/CustomLoss)
+        fall back to pickle with a warning.
         """
+        from . import model_io
+
         if os.path.exists(path) and not over_write:
             raise IOError(f"{path} exists; pass over_write=True")
         os.makedirs(path, exist_ok=True)
-        trainer = self.trainer
-        self.trainer = None  # strip unpicklable runtime
-        tb, self._tb = self._tb, None
+        # a re-save may switch formats (json <-> pickle fallback); stale
+        # artifacts of the other format would shadow the fresh ones at
+        # load time, pairing the wrong architecture with the new weights
+        for stale in ("architecture.json", "config_arrays.npz",
+                      "architecture.pkl"):
+            sp = os.path.join(path, stale)
+            if os.path.exists(sp):
+                os.remove(sp)
         try:
-            with open(os.path.join(path, "architecture.pkl"), "wb") as f:
-                pickle.dump(self, f)
-        finally:
-            self.trainer = trainer
-            self._tb = tb
+            spec, arrays = model_io.graph_to_spec(self.graph_function(),
+                                                  self.name)
+            with open(os.path.join(path, "architecture.json"), "w") as f:
+                json.dump(spec, f, indent=1)
+            if arrays:
+                np.savez(os.path.join(path, "config_arrays.npz"), **arrays)
+        except model_io.UnserializableConfig as e:
+            logging.getLogger("analytics_zoo_tpu").warning(
+                "definition serialization unavailable (%s); falling back "
+                "to pickle", e)
+            trainer = self.trainer
+            self.trainer = None  # strip unpicklable runtime
+            tb, self._tb = self._tb, None
+            try:
+                with open(os.path.join(path, "architecture.pkl"),
+                          "wb") as f:
+                    pickle.dump(self, f)
+            finally:
+                self.trainer = trainer
+                self._tb = tb
         params, state = self._params_tuple()
         serialization.save_pytree(
             os.path.join(path, "weights.npz"),
@@ -277,11 +359,68 @@ class KerasNet(KerasLayer):
 
     @staticmethod
     def load_model(path, weight_path=None):
-        with open(os.path.join(path, "architecture.pkl"), "rb") as f:
-            model = pickle.load(f)
+        from . import model_io
+
+        json_path = os.path.join(path, "architecture.json")
+        if os.path.exists(json_path):
+            with open(json_path) as f:
+                spec = json.load(f)
+            arrays = {}
+            arr_path = os.path.join(path, "config_arrays.npz")
+            if os.path.exists(arr_path):
+                with np.load(arr_path, allow_pickle=False) as z:
+                    arrays = {k: z[k] for k in z.files}
+            model = model_io.spec_to_model(spec, arrays)
+        else:  # pre-v1 checkpoints / Lambda fallback
+            with open(os.path.join(path, "architecture.pkl"), "rb") as f:
+                model = pickle.load(f)
         blob = serialization.load_pytree(os.path.join(path, "weights.npz"))
         model._built_params = (blob["params"], blob.get("state") or {})
         return model
+
+    def export_tf(self, path, batch_size: Optional[int] = None):
+        """Export inference as a TensorFlow SavedModel via ``jax2tf``
+        (parity: ``saveToTf``, Topology.scala:568 / util/tf.py export_tf:
+        the reference freezes a TF graph for serving interop)."""
+        import tensorflow as tf  # noqa: F401 - required for export
+        from jax.experimental import jax2tf
+
+        self._ensure_trainer().ensure_initialized()
+        trainer = self.trainer
+        params = jax.tree.map(np.asarray, trainer.params)
+        net_state = jax.tree.map(np.asarray, trainer.net_state)
+        graph = self.graph_function()
+
+        def infer(params, *inputs):
+            return graph.apply(params, list(inputs), state=net_state,
+                               training=False)
+
+        graph_inputs = graph.inputs
+        if batch_size is None:
+            # symbolic batch dim through jax2tf shape polymorphism
+            poly = [None] + [
+                "b, " + ", ".join("_" for _ in v.shape[1:])
+                if len(v.shape) > 1 else "b" for v in graph_inputs]
+        else:
+            poly = None
+        tf_fn = jax2tf.convert(infer, polymorphic_shapes=poly)
+        module = tf.Module()
+        module.params = jax.tree.map(tf.Variable, params)
+        in_specs = [
+            tf.TensorSpec([batch_size] + [d for d in v.shape[1:]],
+                          tf.as_dtype(np.float32), name=v.name)
+            for v in graph_inputs]
+
+        @tf.function(autograph=False, input_signature=in_specs)
+        def serving_fn(*inputs):
+            return tf_fn(module.params, *inputs)
+
+        module.serving = serving_fn
+        tf.saved_model.save(module, path,
+                            signatures={"serving_default": serving_fn})
+        return path
+
+    saveToTf = export_tf
 
     # -- introspection -------------------------------------------------
     def summary(self, line_length=100):
@@ -337,33 +476,69 @@ class Model(KerasNet):
         return shapes[0] if len(shapes) == 1 else shapes
 
     def new_graph(self, outputs: Sequence[str]) -> "Model":
-        """Graph surgery: re-root on named layers' outputs
-        (parity: NetUtils GraphNet.newGraph)."""
+        """Graph surgery: re-root on named layers' outputs (parity:
+        NetUtils GraphNet.newGraph). ``"layer"`` selects output 0 of that
+        layer; ``"layer:k"`` selects output ``k`` of a multi-output layer
+        (every output index is addressable — the round-2 last-var-per-layer
+        map could only reach whichever variable happened to be walked
+        last)."""
         graph = self._graph
-        by_name = {}
+        nodes_by_layer: Dict[str, Any] = {}
+        vars_by_layer: Dict[str, Dict[int, Variable]] = {}
         for node in graph.nodes:
-            for v in [vv for vv in _node_out_vars(node, graph)]:
-                by_name[node.layer.name] = v
-        outs = [by_name[name] for name in outputs]
-        return Model(self.inputs, outs, name=self.name + "_sub")
+            nodes_by_layer.setdefault(node.layer.name, node)
+            for v in _node_out_vars(node, graph):
+                vars_by_layer.setdefault(node.layer.name, {})[v.index] = v
+        outs = []
+        for name in outputs:
+            index = 0
+            if ":" in name:
+                name, idx_s = name.rsplit(":", 1)
+                index = int(idx_s)
+            node = nodes_by_layer.get(name)
+            if node is None:
+                raise ValueError(
+                    f"no layer named {name!r} in the graph "
+                    f"(have: {sorted(nodes_by_layer)})")
+            v = vars_by_layer.get(name, {}).get(index)
+            if v is None:
+                v = _make_out_var(node, index)
+            outs.append(v)
+        return Model(self.inputs, outs if len(outs) > 1 else outs[0],
+                     name=self.name + "_sub")
+
+
+def _layer_out_shapes(node):
+    shape = node.layer.compute_output_shape(
+        node.inputs[0].shape if len(node.inputs) == 1
+        else [v.shape for v in node.inputs])
+    if node.layer.num_outputs > 1:
+        return list(shape)
+    return [shape]
+
+
+def _make_out_var(node, index: int) -> Variable:
+    shapes = _layer_out_shapes(node)
+    if index >= len(shapes):
+        raise ValueError(
+            f"layer {node.layer.name!r} has {len(shapes)} outputs; "
+            f"index {index} out of range")
+    return Variable(node, shapes[index], index=index)
 
 
 def _node_out_vars(node, graph):
-    # find Variables produced by this node among graph vars
+    """Variables produced by ``node`` that are materialized in the graph
+    (as other nodes' inputs or as graph outputs)."""
     seen = []
     for v in graph.outputs:
         if v.node is node:
             seen.append(v)
-    # also walk all node input vars
     for n in graph.nodes:
         for v in n.inputs:
             if v.node is node and v not in seen:
                 seen.append(v)
     if not seen:
-        out_shape = node.layer.compute_output_shape(
-            node.inputs[0].shape if len(node.inputs) == 1
-            else [v.shape for v in node.inputs])
-        seen.append(Variable(node, out_shape))
+        seen.append(_make_out_var(node, 0))
     return seen
 
 

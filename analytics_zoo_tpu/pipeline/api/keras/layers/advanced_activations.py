@@ -112,5 +112,9 @@ class RReLU(KerasLayer):
 
 
 class Softmax(KerasLayer):
+    def __init__(self, axis: int = -1, input_shape=None, name=None, **kw):
+        super().__init__(input_shape=input_shape, name=name)
+        self.axis = int(axis)
+
     def call(self, params, x, training=False, **kw):
-        return jax.nn.softmax(x, axis=-1)
+        return jax.nn.softmax(x, axis=self.axis)

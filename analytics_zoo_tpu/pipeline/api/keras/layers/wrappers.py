@@ -37,27 +37,30 @@ class TimeDistributed(KerasLayer):
     def build(self, rng, input_shape):
         inner_shape = (input_shape[0],) + tuple(input_shape[2:])
         p = self.layer.build(rng, inner_shape)
-        return {self.layer.name: p} if p else {}
+        return {"layer": p} if p else {}
 
     def init_state(self, input_shape):
         inner_shape = (input_shape[0],) + tuple(input_shape[2:])
         s = self.layer.init_state(inner_shape)
-        return {self.layer.name: s} if s else {}
+        return {"layer": s} if s else {}
 
     def call(self, params, x, training=False, state=None, rng=None, **kw):
         b, t = x.shape[0], x.shape[1]
         flat = x.reshape((b * t,) + x.shape[2:])
-        p = params.get(self.layer.name, {}) if params else {}
+        # "layer" role key; pre-v1 checkpoints keyed by the wrapped
+        # layer's auto-generated name — fall back for those
+        p = (params.get("layer", params.get(self.layer.name, {}))
+             if params else {})
         kwargs = {}
         if self.layer.has_state:
-            kwargs["state"] = (state or {}).get(self.layer.name, {})
+            kwargs["state"] = (state or {}).get("layer", {})
         if self.layer.stochastic:
             kwargs["rng"] = rng
         out = self.layer.call(p, flat, training=training, **kwargs)
         if self.layer.has_state:
             out, s = out
             return out.reshape((b, t) + out.shape[1:]), \
-                {self.layer.name: s}
+                {"layer": s}
         return out.reshape((b, t) + out.shape[1:])
 
     def compute_output_shape(self, s):
@@ -79,15 +82,19 @@ class Bidirectional(KerasLayer):
         self.merge_mode = merge_mode
 
     def build(self, rng, input_shape):
+        # stable role keys, NOT the wrapped layer's auto-generated name:
+        # a definition-rebuilt wrapper (model_io) regenerates inner names,
+        # so name-keyed params would KeyError after load_model
         r1, r2 = jax.random.split(rng)
-        return {self.forward.name: self.forward.build(r1, input_shape),
-                self.backward.name: self.backward.build(r2, input_shape)}
+        return {"forward": self.forward.build(r1, input_shape),
+                "backward": self.backward.build(r2, input_shape)}
 
     def call(self, params, x, training=False, **kw):
-        fwd = self.forward.call(params[self.forward.name], x,
-                                training=training)
-        bwd = self.backward.call(params[self.backward.name], x,
-                                 training=training)
+        # role keys; pre-v1 checkpoints keyed by inner layer names
+        p_fwd = params.get("forward", params.get(self.forward.name))
+        p_bwd = params.get("backward", params.get(self.backward.name))
+        fwd = self.forward.call(p_fwd, x, training=training)
+        bwd = self.backward.call(p_bwd, x, training=training)
         if self.merge_mode == "concat":
             return jnp.concatenate([fwd, bwd], axis=-1)
         if self.merge_mode == "sum":

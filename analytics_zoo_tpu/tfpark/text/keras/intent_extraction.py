@@ -50,8 +50,18 @@ class _IntentNet(KerasLayer):
                       self.tags_out]
         self._dims = (word_emb_dim, char_emb_dim, char_lstm_dim,
                       tagger_lstm_dim)
+        self._stabilize_sub_names()
+
+    def _stabilize_sub_names(self):
+        # param keys must be reproducible across process restarts:
+        # auto-generated layer names depend on global counters, so a
+        # rebuilt net (model_io definition load) would otherwise key
+        # its params differently and every lookup would KeyError
+        for i, sub in enumerate(self._subs):
+            sub.name = f"sub{i}_{type(sub).__name__.lower()}"
 
     def build(self, rng, input_shape):
+        self._stabilize_sub_names()
         we, ce, cl, tl = self._dims
         rngs = jax.random.split(rng, len(self._subs))
         shapes = [(None, None), (None, None), (None, None, ce),

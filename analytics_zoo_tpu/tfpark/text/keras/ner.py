@@ -59,8 +59,18 @@ class _NERNet(KerasLayer):
             self._subs.append(self.crf)
             self.num_outputs = 3 if crf_mode == "pad" else 2
         self._dims = (word_emb_dim, char_emb_dim, tagger_lstm_dim)
+        self._stabilize_sub_names()
+
+    def _stabilize_sub_names(self):
+        # param keys must be reproducible across process restarts:
+        # auto-generated layer names depend on global counters, so a
+        # rebuilt net (model_io definition load) would otherwise key
+        # its params differently and every lookup would KeyError
+        for i, sub in enumerate(self._subs):
+            sub.name = f"sub{i}_{type(sub).__name__.lower()}"
 
     def build(self, rng, input_shape):
+        self._stabilize_sub_names()
         word_emb_dim, char_emb_dim, tagger_dim = self._dims
         rngs = jax.random.split(rng, len(self._subs))
         shapes = [

@@ -61,8 +61,18 @@ class _TaggerNet(KerasLayer):
             self.num_outputs = 4
         self._in_dim = in_dim
         self.feature_size = feature_size
+        self._stabilize_sub_names()
+
+    def _stabilize_sub_names(self):
+        # param keys must be reproducible across process restarts:
+        # auto-generated layer names depend on global counters, so a
+        # rebuilt net (model_io definition load) would otherwise key
+        # its params differently and every lookup would KeyError
+        for i, sub in enumerate(self._subs):
+            sub.name = f"sub{i}_{type(sub).__name__.lower()}"
 
     def build(self, rng, input_shape):
+        self._stabilize_sub_names()
         rngs = jax.random.split(rng, len(self._subs))
         f = self.feature_size
         shapes = [(None, None)]

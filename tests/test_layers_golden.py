@@ -191,7 +191,7 @@ def test_timedistributed_dense():
     inner = zl.Dense(3)
     layer = zl.TimeDistributed(inner)
     out, _ = _forward(layer, x, weights=lambda p: {
-        inner.name: {"kernel": k, "bias": b}})
+        "layer": {"kernel": k, "bias": b}})
     np.testing.assert_allclose(out, ref_out, rtol=1e-5, atol=1e-5)
 
 
@@ -207,6 +207,6 @@ def test_bidirectional_lstm_matches_keras():
     inner = zl.LSTM(3, inner_activation="sigmoid", return_sequences=True)
     layer = zl.Bidirectional(inner)
     out, _ = _forward(layer, x, weights=lambda p: {
-        layer.forward.name: {"W": wf[0], "U": wf[1], "b": wf[2]},
-        layer.backward.name: {"W": wf[3], "U": wf[4], "b": wf[5]}})
+        "forward": {"W": wf[0], "U": wf[1], "b": wf[2]},
+        "backward": {"W": wf[3], "U": wf[4], "b": wf[5]}})
     np.testing.assert_allclose(out, ref_out, rtol=1e-4, atol=1e-4)

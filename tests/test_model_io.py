@@ -1,0 +1,113 @@
+"""Definition-based persistence (model_io) + saveToTf export
+(VERDICT r2 weak #5 / missing #7; parity: Topology.scala:109,557-568)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.pipeline.api.keras.layers import (
+    Dense, Dropout, Embedding, Input, Select, merge)
+from analytics_zoo_tpu.pipeline.api.keras.models import Model, Sequential
+from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+
+
+def _ncf_like(users=20, items=10):
+    x = Input(shape=(2,))
+    u = Select(1, 0)(x)
+    i = Select(1, 1)(x)
+    ue = Embedding(users + 1, 8)(u)
+    ie = Embedding(items + 1, 8)(i)
+    h = merge([ue, ie], mode="concat")
+    h = Dense(16, activation="relu")(h)
+    out = Dense(2, activation="softmax")(h)
+    return Model(x, out)
+
+
+def test_save_is_definition_not_pickle(tmp_path):
+    model = _ncf_like()
+    model.compile(optimizer=Adam(lr=0.01),
+                  loss="sparse_categorical_crossentropy")
+    rng = np.random.default_rng(0)
+    x = np.stack([rng.integers(1, 21, 128),
+                  rng.integers(1, 11, 128)], 1).astype(np.float32)
+    y = rng.integers(0, 2, 128).astype(np.int32)
+    model.fit(x, y, batch_size=32, nb_epoch=2)
+    preds = model.predict(x, batch_size=32)
+
+    path = str(tmp_path / "model")
+    model.save_model(path)
+    assert os.path.exists(os.path.join(path, "architecture.json"))
+    assert not os.path.exists(os.path.join(path, "architecture.pkl"))
+    with open(os.path.join(path, "architecture.json")) as f:
+        spec = json.load(f)
+    assert spec["format"] == "zoo-tpu-graph-v1"
+    assert all(s["class"].startswith("analytics_zoo_tpu.")
+               for s in spec["layers"])
+
+    again = Model.load_model(path)
+    preds2 = again.predict(x, batch_size=32)
+    np.testing.assert_array_equal(preds, preds2)
+
+
+def test_sequential_roundtrip_and_continued_training(tmp_path):
+    model = Sequential()
+    model.add(Dense(16, activation="relu", input_shape=(8,)))
+    model.add(Dropout(0.1))
+    model.add(Dense(1, activation="sigmoid"))
+    model.compile(optimizer=Adam(lr=0.01), loss="binary_crossentropy")
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((128, 8)).astype(np.float32)
+    y = (x[:, :1] > 0).astype(np.float32)
+    model.fit(x, y, batch_size=32, nb_epoch=2)
+    path = str(tmp_path / "seq")
+    model.save_model(path)
+
+    again = Model.load_model(path)
+    np.testing.assert_array_equal(model.predict(x, batch_size=32),
+                                  again.predict(x, batch_size=32))
+    # the loaded model keeps training
+    again.compile(optimizer=Adam(lr=0.01), loss="binary_crossentropy")
+    again.fit(x, y, batch_size=32, nb_epoch=1)
+
+
+def test_composite_text_model_roundtrip(tmp_path):
+    """Composite layers (sub-layers created in __init__) must rebuild with
+    stable param keys — the bug class found when NER.load_model keyed
+    params by regenerated auto names."""
+    from analytics_zoo_tpu.tfpark.text.keras import NER
+
+    rng = np.random.default_rng(2)
+    model = NER(num_entities=3, word_vocab_size=20, char_vocab_size=8,
+                word_length=3, word_emb_dim=8, char_emb_dim=4,
+                tagger_lstm_dim=8, seq_len=5)
+    words = rng.integers(0, 20, (4, 5)).astype(np.int32)
+    chars = rng.integers(0, 8, (4, 5, 3)).astype(np.int32)
+    tags = rng.integers(0, 3, (4, 5)).astype(np.int32)
+    model.fit([words, chars], tags, batch_size=4, epochs=1)
+    t1 = model.predict_tags([words, chars])
+    path = str(tmp_path / "ner")
+    model.save_model(path)
+    again = NER.load_model(path)
+    np.testing.assert_array_equal(t1, again.predict_tags([words, chars]))
+
+
+def test_export_tf_savedmodel(tmp_path):
+    tf = pytest.importorskip("tensorflow")
+
+    model = Sequential()
+    model.add(Dense(8, activation="relu", input_shape=(4,)))
+    model.add(Dense(2, activation="softmax"))
+    model.compile(optimizer=Adam(lr=0.01),
+                  loss="sparse_categorical_crossentropy")
+    x = np.random.default_rng(3).standard_normal((16, 4)).astype(np.float32)
+    preds = model.predict(x, batch_size=16)
+
+    path = str(tmp_path / "saved_model")
+    model.export_tf(path)
+    loaded = tf.saved_model.load(path)
+    tf_out = loaded.signatures["serving_default"](
+        tf.constant(x))
+    tf_preds = list(tf_out.values())[0].numpy()
+    np.testing.assert_allclose(preds, tf_preds, rtol=1e-5, atol=1e-5)
