@@ -8,18 +8,12 @@ constructor adapter over the keras layer library; models/training are shared.
 
 from .layers import (Activation, Add, Average, AveragePooling1D,
                      AveragePooling2D, BatchNormalization, Concatenate,
-                     Conv1D, Conv2D, Dense, Dropout, Embedding, Flatten,
-                     GlobalAveragePooling1D, GlobalAveragePooling2D,
-                     GlobalMaxPooling1D, GlobalMaxPooling2D, Input,
-                     MaxPooling1D, MaxPooling2D, Maximum, Multiply,
-                     SeparableConv2D)
+                     Conv1D, Conv2D, Cropping1D, Dense, Dropout, Embedding,
+                     Flatten, GlobalAveragePooling1D, GlobalAveragePooling2D,
+                     GlobalAveragePooling3D, GlobalMaxPooling1D,
+                     GlobalMaxPooling2D, GlobalMaxPooling3D, Input,
+                     LocallyConnected1D, MaxPooling1D, MaxPooling2D,
+                     Maximum, Minimum, Multiply, SeparableConv2D, Softmax)
 from .models import Model, Sequential
 
-__all__ = [
-    "Input", "Dense", "Conv1D", "Conv2D", "SeparableConv2D", "Activation",
-    "Dropout", "Flatten", "Embedding", "BatchNormalization", "MaxPooling1D",
-    "MaxPooling2D", "AveragePooling1D", "AveragePooling2D",
-    "GlobalMaxPooling1D", "GlobalMaxPooling2D", "GlobalAveragePooling1D",
-    "GlobalAveragePooling2D", "Add", "Multiply", "Average", "Maximum",
-    "Concatenate", "Model", "Sequential",
-]
+__all__ = ['Input', 'Dense', 'Conv1D', 'Conv2D', 'SeparableConv2D', 'Activation', 'Dropout', 'Flatten', 'Embedding', 'BatchNormalization', 'MaxPooling1D', 'MaxPooling2D', 'AveragePooling1D', 'AveragePooling2D', 'GlobalMaxPooling1D', 'GlobalMaxPooling2D', 'GlobalAveragePooling1D', 'GlobalAveragePooling2D', 'Add', 'Multiply', 'Average', 'Maximum', 'Concatenate', 'Model', 'Sequential', 'Cropping1D', 'GlobalAveragePooling3D', 'GlobalMaxPooling3D', 'LocallyConnected1D', 'Minimum', 'Softmax']

@@ -165,3 +165,34 @@ def Maximum(name=None, **kw):
 
 def Concatenate(axis: int = -1, name=None, **kw):
     return _Concatenate(axis=axis, name=name)
+
+
+def GlobalMaxPooling3D(input_shape=None, name=None, **kw):
+    return k1.GlobalMaxPooling3D(input_shape=input_shape, name=name)
+
+
+def GlobalAveragePooling3D(input_shape=None, name=None, **kw):
+    return k1.GlobalAveragePooling3D(input_shape=input_shape, name=name)
+
+
+def Cropping1D(cropping=(1, 1), input_shape=None, name=None, **kw):
+    return k1.Cropping1D(cropping=cropping, input_shape=input_shape,
+                         name=name)
+
+
+def LocallyConnected1D(filters: int, kernel_size: int, strides: int = 1,
+                       padding: str = "valid", activation=None,
+                       use_bias: bool = True, input_shape=None, name=None,
+                       **kw):
+    return k1.LocallyConnected1D(
+        filters, kernel_size, activation=activation,
+        border_mode=_PADDING[padding], subsample_length=strides,
+        bias=use_bias, input_shape=input_shape, name=name)
+
+
+def Minimum(name=None, **kw):
+    return k1.Merge(mode="min", name=name)
+
+
+def Softmax(axis: int = -1, input_shape=None, name=None, **kw):
+    return k1.Softmax(axis=axis, input_shape=input_shape, name=name)

@@ -61,3 +61,35 @@ class TestKeras2:
         x = np.random.default_rng(1).integers(0, 50, (4, 12))
         out = np.asarray(model.predict(x, batch_size=4))
         assert out.shape == (4, 2)
+
+    def test_round3_layer_set(self):
+        """Full reference keras2 layer-file set (21 files) is covered:
+        Cropping1D, LocallyConnected1D, Minimum, Softmax, Global*3D."""
+        rng = np.random.default_rng(2)
+        model = keras2.Sequential()
+        model.add(keras2.Cropping1D((1, 2), input_shape=(12, 5)))
+        model.add(keras2.LocallyConnected1D(4, 3, activation="relu"))
+        model.add(keras2.GlobalMaxPooling1D())
+        model.add(keras2.Dense(3))
+        model.add(keras2.Softmax())
+        x = rng.standard_normal((2, 12, 5)).astype(np.float32)
+        out = np.asarray(model.predict(x, batch_size=2))
+        assert out.shape == (2, 3)
+        np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-4)
+
+        a = keras2.Input(shape=(4,))
+        b = keras2.Input(shape=(4,))
+        lo = keras2.Minimum()([a, b])
+        m = keras2.Model([a, b], lo)
+        xa = rng.standard_normal((3, 4)).astype(np.float32)
+        xb = rng.standard_normal((3, 4)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(m.predict([xa, xb], batch_size=3)),
+            np.minimum(xa, xb), rtol=1e-6)
+
+        g3 = keras2.Sequential()
+        g3.add(keras2.GlobalAveragePooling3D(input_shape=(2, 3, 4, 5)))
+        xg = rng.standard_normal((2, 2, 3, 4, 5)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(g3.predict(xg, batch_size=2)),
+            xg.mean(axis=(2, 3, 4)), rtol=1e-5)
