@@ -1,5 +1,7 @@
-from .raycontext import RayContext, RemoteFunction, get_ray_context
+from .raycontext import (ActorClass, ActorHandle, ObjectRef, RayContext,
+                         RemoteFunction, RemoteTaskError, get_ray_context)
 from .process import ProcessMonitor, ProcessGuard
 
-__all__ = ["RayContext", "RemoteFunction", "get_ray_context",
+__all__ = ["RayContext", "RemoteFunction", "ActorClass", "ActorHandle",
+           "ObjectRef", "RemoteTaskError", "get_ray_context",
            "ProcessMonitor", "ProcessGuard"]

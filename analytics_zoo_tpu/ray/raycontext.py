@@ -80,6 +80,88 @@ class RemoteFunction:
         raise TypeError("Remote functions must be invoked with .remote()")
 
 
+class ActorMethod:
+    """Bound remote method: ``handle.incr.remote(1) -> ObjectRef``."""
+
+    __slots__ = ("_handle", "_name")
+
+    def __init__(self, handle: "ActorHandle", name: str):
+        self._handle = handle
+        self._name = name
+
+    def remote(self, *args, **kwargs) -> ObjectRef:
+        return self._handle._ctx._submit_actor(
+            self._handle._actor_id, self._name, args, kwargs)
+
+
+class ActorHandle:
+    """Stateful remote object (ray actor parity). Method calls execute
+    serially in the actor's dedicated process, preserving state."""
+
+    def __init__(self, ctx: "RayContext", actor_id: str):
+        self._ctx = ctx
+        self._actor_id = actor_id
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def __reduce__(self):  # handles are not transferable between hosts
+        raise TypeError("ActorHandle cannot be serialized")
+
+
+class ActorClass:
+    """``ctx.remote(SomeClass)`` wrapper: ``SomeClass.remote(*args)``
+    constructs the actor in its own worker process."""
+
+    def __init__(self, ctx: "RayContext", cls: type):
+        self._ctx = ctx
+        self._cls = cls
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        return self._ctx._create_actor(self._cls, args, kwargs)
+
+
+def _actor_main(parent_pid, cls_blob, init_blob, ready_id, task_q,
+                result_q, platform, env):
+    ProcessGuard(parent_pid).start()
+    if env:
+        os.environ.update(env)
+    if platform:
+        os.environ["JAX_PLATFORMS"] = platform
+        try:
+            import jax
+            jax.config.update("jax_platforms", platform)
+        except Exception:  # noqa: BLE001
+            pass
+    import cloudpickle
+
+    try:
+        cls = cloudpickle.loads(cls_blob)
+        args, kwargs = cloudpickle.loads(init_blob)
+        instance = cls(*args, **kwargs)
+        result_q.put((ready_id, True, cloudpickle.dumps(None)))
+    except BaseException as e:  # noqa: BLE001
+        result_q.put((ready_id, False,
+                      f"{type(e).__name__}: {e}\n"
+                      f"{traceback.format_exc()}"))
+        return
+    while True:
+        item = task_q.get()
+        if item is None:
+            break
+        task_id, method, args_blob = item
+        try:
+            args, kwargs = cloudpickle.loads(args_blob)
+            result = getattr(instance, method)(*args, **kwargs)
+            result_q.put((task_id, True, cloudpickle.dumps(result)))
+        except BaseException as e:  # noqa: BLE001
+            result_q.put((task_id, False,
+                          f"{type(e).__name__}: {e}\n"
+                          f"{traceback.format_exc()}"))
+
+
 class RemoteTaskError(RuntimeError):
     """A task raised in the worker; carries the remote traceback."""
 
@@ -140,6 +222,8 @@ class RayContext:
         self._results: Dict[str, Any] = {}
         self._results_lock = threading.Lock()
         self._pending: set = set()
+        self._actors: Dict[str, Any] = {}   # actor_id -> (proc, task_q)
+        self._actor_tasks: Dict[str, set] = {}   # actor_id -> open task_ids
 
     # ------------------------------------------------------------------
     def init(self) -> "RayContext":
@@ -168,6 +252,8 @@ class RayContext:
         global _global_ray_context
         if self.stopped:
             return
+        for actor_id in list(self._actors):
+            self.kill(ActorHandle(self, actor_id))
         for _ in self._procs:
             try:
                 self._task_q.put(None)
@@ -180,11 +266,72 @@ class RayContext:
             _global_ray_context = None
 
     # ------------------------------------------------------------------
-    def remote(self, fn: Callable = None, **opts) -> RemoteFunction:
-        """Decorator/wrapper: ``sq = ctx.remote(lambda x: x*x)``."""
+    def remote(self, fn: Callable = None, **opts):
+        """Decorator/wrapper. Functions become :class:`RemoteFunction`s;
+        classes become :class:`ActorClass`es (ray.remote parity)."""
         if fn is None:
-            return lambda f: RemoteFunction(self, f, **opts)
+            return lambda f: self.remote(f, **opts)
+        if isinstance(fn, type):
+            return ActorClass(self, fn)
         return RemoteFunction(self, fn)
+
+    def _create_actor(self, cls, args, kwargs) -> ActorHandle:
+        if self.stopped:
+            raise RuntimeError("RayContext not initialized; call init()")
+        import cloudpickle
+
+        ctx = mp.get_context("spawn")
+        actor_id = uuid.uuid4().hex
+        ready_id = f"actor-init-{actor_id}"
+        task_q = ctx.Queue()
+        p = ctx.Process(
+            target=_actor_main,
+            args=(os.getpid(), cloudpickle.dumps(cls),
+                  cloudpickle.dumps((args, kwargs)), ready_id, task_q,
+                  self._result_q, self.platform, self.env),
+            daemon=True, name=f"zoo-ray-actor-{actor_id[:8]}")
+        p.start()
+        self._procs.append(p)
+        self._monitor.register(p)
+        self._actors[actor_id] = (p, task_q)
+        # surface constructor errors eagerly (ray raises on first use;
+        # eager is strictly more debuggable)
+        self._wait_one(ready_id, None)
+        return ActorHandle(self, actor_id)
+
+    def _submit_actor(self, actor_id, method, args, kwargs) -> ObjectRef:
+        import cloudpickle
+
+        if actor_id not in self._actors:
+            raise RuntimeError(f"unknown or killed actor {actor_id[:8]}")
+        task_id = uuid.uuid4().hex
+        self._pending.add(task_id)
+        self._actor_tasks.setdefault(actor_id, set()).add(task_id)
+        self._actors[actor_id][1].put(
+            (task_id, method, cloudpickle.dumps((args, kwargs))))
+        return ObjectRef(task_id)
+
+    def kill(self, handle: ActorHandle):
+        """Terminate an actor (ray.kill parity). Unresolved calls on the
+        actor resolve to RemoteTaskError instead of hanging their
+        ObjectRefs forever (ray raises RayActorError likewise)."""
+        entry = self._actors.pop(handle._actor_id, None)
+        if entry is None:
+            return
+        proc, task_q = entry
+        try:
+            task_q.put(None)
+            proc.join(timeout=2)
+        finally:
+            if proc.is_alive():
+                proc.terminate()
+        with self._results_lock:
+            for task_id in self._actor_tasks.pop(handle._actor_id, ()):
+                if task_id not in self._results and \
+                        task_id in self._pending:
+                    self._results[task_id] = (
+                        False, f"actor {handle._actor_id[:8]} was killed "
+                               "before this call completed")
 
     def _submit(self, fn, args, kwargs) -> ObjectRef:
         if self.stopped:

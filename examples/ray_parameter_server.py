@@ -1,0 +1,72 @@
+"""Asynchronous parameter server on the Ray-equivalent runtime.
+
+Reference example: ``pyzoo/zoo/examples/ray/parameter_server/
+async_parameter_server.py`` (+ ``apps/ray/parameter_server``) — a ray actor
+holds the parameters; data workers pull weights, compute gradients on their
+shard, and push updates asynchronously. Proves arbitrary stateful actor
+programs run on the runtime (SURVEY §2.8).
+"""
+
+import numpy as np
+
+from common import example_args
+
+from analytics_zoo_tpu.ray import RayContext
+
+
+class ParameterServer:
+    """Holds a linear-model weight vector; applies pushed gradients."""
+
+    def __init__(self, dim, lr=0.1):
+        self.w = np.zeros(dim, np.float32)
+        self.lr = lr
+        self.updates = 0
+
+    def get_weights(self):
+        return self.w
+
+    def push_gradients(self, grad):
+        self.w -= self.lr * grad
+        self.updates += 1
+        return self.updates
+
+
+def worker_step(weights, x_shard, y_shard):
+    """One logistic-regression gradient on a data shard (runs remotely)."""
+    z = x_shard @ weights
+    p = 1.0 / (1.0 + np.exp(-z))
+    return x_shard.T @ (p - y_shard) / len(y_shard)
+
+
+def main():
+    args = example_args("async parameter server / Ray actors",
+                        samples=2048, epochs=20)
+    rng = np.random.default_rng(args.seed)
+    dim, n_workers = 16, 4
+    w_true = rng.standard_normal(dim).astype(np.float32)
+    x = rng.standard_normal((args.samples, dim)).astype(np.float32)
+    y = (x @ w_true > 0).astype(np.float32)
+    shards = np.array_split(np.arange(args.samples), n_workers)
+
+    with RayContext(num_ray_nodes=n_workers, ray_node_cpu_cores=1,
+                    platform="cpu") as ctx:
+        ps = ctx.remote(ParameterServer).remote(dim, lr=0.5)
+        grad_fn = ctx.remote(worker_step)
+
+        for it in range(args.epochs):
+            weights = ctx.get(ps.get_weights.remote())
+            refs = [grad_fn.remote(weights, x[s], y[s]) for s in shards]
+            for g in ctx.get(refs):          # async pushes
+                ps.push_gradients.remote(g / n_workers)
+        updates = ctx.get(ps.push_gradients.remote(np.zeros(dim,
+                                                            np.float32)))
+        w = ctx.get(ps.get_weights.remote())
+
+    acc = float(((x @ w > 0) == (y > 0.5)).mean())
+    print(f"{updates} updates applied; train accuracy {acc:.3f}")
+    assert acc > 0.9, acc
+    print("parameter-server example OK")
+
+
+if __name__ == "__main__":
+    main()

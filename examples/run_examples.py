@@ -19,6 +19,8 @@ EXAMPLES = [
     "anomaly_detection.py",
     "object_detection_ssd.py",
     "tfpark_bert_finetune.py",
+    "ray_parameter_server.py",
+    "streaming_inference.py",
 ]
 
 

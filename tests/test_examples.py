@@ -17,7 +17,8 @@ EXAMPLES_DIR = os.path.join(os.path.dirname(os.path.dirname(
 
 FAST = ["recommendation_wide_and_deep.py", "anomaly_detection.py"]
 ALL = FAST + ["recommendation_ncf.py", "text_classification.py",
-              "object_detection_ssd.py", "tfpark_bert_finetune.py"]
+              "object_detection_ssd.py", "tfpark_bert_finetune.py",
+              "ray_parameter_server.py", "streaming_inference.py"]
 
 
 def _run(name):
