@@ -133,6 +133,16 @@ class FeatureSet:
     def disk(paths: Sequence[str], num_slice: int = 1) -> "DiskFeatureSet":
         return DiskFeatureSet(list(paths), num_slice=num_slice)
 
+    @staticmethod
+    def files(paths: Sequence[str], num_slice: int = 1,
+              columns: Optional[Sequence[str]] = None,
+              label_col: Optional[str] = None,
+              shard_per_host: bool = True) -> "ShardedFileFeatureSet":
+        """Sharded npz/csv/parquet files, striped one stripe per host."""
+        return ShardedFileFeatureSet(
+            list(paths), num_slice=num_slice, columns=columns,
+            label_col=label_col, shard_per_host=shard_per_host)
+
 
 class ArrayFeatureSet(FeatureSet):
     """In-memory (host-RAM tier) dataset of numpy arrays."""
@@ -226,10 +236,25 @@ class DiskFeatureSet(FeatureSet):
     def __init__(self, paths: Sequence[str], num_slice: int = 1):
         self.paths = list(paths)
         self.num_slice = max(1, num_slice)
-        self._sizes = []
-        for p in self.paths:
-            with np.load(p) as z:
-                self._sizes.append(z["x0"].shape[0])
+        self._size_cache: Optional[List[int]] = None
+
+    def _load_shard(self, path: str) -> Dict[str, np.ndarray]:
+        """path -> {'x0'..: features, 'y0'..: labels}; overridable for
+        other on-disk formats (ShardedFileFeatureSet). Paths go through
+        utils.file_io, so hdfs://-style URIs work once a filesystem is
+        registered (Utils/File parity)."""
+        from ..utils import file_io
+        import io as _io
+
+        with np.load(_io.BytesIO(file_io.read_bytes(path))) as z:
+            return {k: z[k] for k in z.files}
+
+    @property
+    def _sizes(self) -> List[int]:
+        if self._size_cache is None:
+            self._size_cache = [self._load_shard(p)["x0"].shape[0]
+                                for p in self.paths]
+        return self._size_cache
 
     @staticmethod
     def write_shard(path: str, features, labels=None):
@@ -257,12 +282,20 @@ class DiskFeatureSet(FeatureSet):
         carry: Optional[List[List[np.ndarray]]] = None  # [xs, ys]
         groups = [order[s:s + self.num_slice]
                   for s in range(0, len(order), self.num_slice)]
+        sizes_seen: Dict[int, int] = {}
         for gi, group in enumerate(groups):
             feats_acc: Dict[str, List[np.ndarray]] = {}
             for pi in group:
-                with np.load(self.paths[pi]) as z:
-                    for k in z.files:
-                        feats_acc.setdefault(k, []).append(z[k])
+                shard = self._load_shard(self.paths[pi])
+                sizes_seen[int(pi)] = int(shard["x0"].shape[0])
+                for k, v in shard.items():
+                    feats_acc.setdefault(k, []).append(v)
+            if self._size_cache is None and \
+                    len(sizes_seen) == len(self.paths):
+                # size() after one epoch costs nothing: sizes were
+                # collected while streaming (no second full read)
+                self._size_cache = [sizes_seen[i]
+                                    for i in range(len(self.paths))]
             merged = {k: np.concatenate(v) for k, v in feats_acc.items()}
             xs = [merged[k] for k in sorted(merged, key=numkey)
                   if k.startswith("x")]
@@ -392,6 +425,72 @@ class TransformedFeatureSet(FeatureSet):
     def batches(self, *args, **kw):
         for batch in self.base.batches(*args, **kw):
             yield self.preprocessing(batch)
+
+
+class ShardedFileFeatureSet(DiskFeatureSet):
+    """Sharded files -> per-host streaming infeed.
+
+    The SURVEY's hardest data-layer problem ((a): Spark-partition ->
+    infeed streaming without host OOM): the reference hides it inside
+    JVM-local MiniBatch iterators over cached RDD partitions
+    (NNEstimator.scala:382 getDataSet + FeatureSet memory tiers). Here
+    file shards play the role of partitions: each HOST keeps only the
+    shards striped to it (``paths[i]`` with ``i % num_processes ==
+    process_index``), an epoch streams ``num_slice`` shards at a time
+    through the DiskFeatureSet machinery, and the engine's
+    ``make_array_from_process_local_data`` path assembles the global batch
+    — so no host ever materializes the dataset (contrast: the round-1/2
+    ``df[col].tolist()`` NNFrames ingest).
+
+    Formats: ``.npz`` (DiskFeatureSet layout), ``.csv`` / ``.parquet``
+    (pandas; ``columns`` selects feature columns, ``label_col`` the label).
+    """
+
+    def __init__(self, paths: Sequence[str], num_slice: int = 1,
+                 columns: Optional[Sequence[str]] = None,
+                 label_col: Optional[str] = None,
+                 shard_per_host: bool = True,
+                 process_index: Optional[int] = None,
+                 num_processes: Optional[int] = None):
+        if shard_per_host:
+            if process_index is None or num_processes is None:
+                import jax
+                process_index = jax.process_index()
+                num_processes = jax.process_count()
+            if num_processes > 1:
+                paths = [p for i, p in enumerate(paths)
+                         if i % num_processes == process_index]
+                if not paths:
+                    raise ValueError(
+                        f"no shards for process {process_index}: provide "
+                        f">= {num_processes} files (one per host)")
+        super().__init__(paths, num_slice=num_slice)
+        self.columns = list(columns) if columns else None
+        self.label_col = label_col
+
+    def _load_shard(self, path: str) -> Dict[str, np.ndarray]:
+        lower = path.lower()
+        if lower.endswith(".npz"):
+            return super()._load_shard(path)
+        import io as _io
+
+        import pandas as pd
+
+        from ..utils import file_io
+
+        buf = _io.BytesIO(file_io.read_bytes(path))
+        if lower.endswith(".parquet") or lower.endswith(".pq"):
+            df = pd.read_parquet(buf)
+        elif lower.endswith(".csv"):
+            df = pd.read_csv(buf)
+        else:
+            raise ValueError(f"unsupported shard format: {path}")
+        cols = self.columns or [c for c in df.columns
+                                if c != self.label_col]
+        out = {"x0": df[cols].to_numpy(np.float32)}
+        if self.label_col is not None and self.label_col in df.columns:
+            out["y0"] = df[self.label_col].to_numpy()
+        return out
 
 
 class PrefetchIterator:

@@ -251,6 +251,14 @@ class NNEstimator(_Params):
         return samples
 
     def _get_dataset(self, df, with_label=True) -> FeatureSet:
+        # scalable ingest (SURVEY hard part (a)): a FeatureSet — notably
+        # FeatureSet.files() over per-host-striped shards — streams
+        # directly into the engine instead of materializing columns
+        if isinstance(df, FeatureSet):
+            return df
+        if isinstance(df, (list, tuple)) and df and \
+                all(isinstance(p, str) for p in df):
+            return FeatureSet.files(list(df), label_col=self.label_col)
         return FeatureSet.samples(self._extract_samples(df, with_label))
 
     # -- fit (internalFit parity, NNEstimator.scala:414-479) ------------
