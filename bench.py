@@ -236,25 +236,28 @@ def _bench_bert_mfu_at(peak_flops, bert_batch):
     fs = ArrayFeatureSet([toks, poss, segs, msk], ys)
     trainer = model._ensure_trainer()
     trainer.ensure_initialized()
-    step_fn = trainer.build_train_step()
     host_batch = next(iter(fs.batches(bert_batch)))
-    batch = trainer._put_batch(host_batch)
 
+    # fused k-step dispatch (lax.scan): one dispatch per k steps, so the
+    # measurement is device time, not tunnel round-trips. A host transfer
+    # is the only true barrier on tunneled backends (block_until_ready
+    # returns at dispatch).
+    k = 5
+    multi = trainer.build_multi_step(k)
+    stacked = trainer._put_stacked([host_batch] * k)
     params, opt_state, net_state = (trainer.params, trainer.opt_state,
                                     trainer.net_state)
-    # warmup: compile + 1 steady-state step. A host transfer is the only
-    # true barrier on tunneled backends (block_until_ready returns early).
-    for i in range(2):
-        params, opt_state, net_state, logs = step_fn(
-            params, opt_state, net_state, batch, i)
+    params, opt_state, net_state, logs = multi(
+        params, opt_state, net_state, stacked, 0)   # compile + warmup
     device_sync(logs["loss"])
 
-    n_steps = 20
+    n_dispatch = 4
     t0 = time.perf_counter()
-    for i in range(n_steps):
-        params, opt_state, net_state, logs = step_fn(
-            params, opt_state, net_state, batch, i + 2)
+    for i in range(n_dispatch):
+        params, opt_state, net_state, logs = multi(
+            params, opt_state, net_state, stacked, (i + 1) * k)
     device_sync(logs["loss"])
+    n_steps = n_dispatch * k
     dt = (time.perf_counter() - t0) / n_steps
 
     flops = _bert_flops_per_step(bert_batch, BERT_SEQ, BERT_H, BERT_BLOCKS,
