@@ -1,0 +1,190 @@
+"""Cross-host extension of the Ray-equivalent runtime.
+
+The reference's RayContext spans the whole Spark cluster — partition 0 runs
+``ray start --head`` and every executor host joins as a raylet
+(``pyzoo/zoo/ray/util/raycontext.py:155-189``). The TPU-native equivalent
+has no Spark barrier to rendezvous through, so the transport is a plain
+authenticated socket channel (``multiprocessing.connection``): the driver
+host listens, every worker HOST connects with
+``python -m analytics_zoo_tpu.ray.worker_host --connect head:port`` and
+contributes its local worker pool. Tasks round-robin across the head's own
+pool and the joined hosts; results stream back over the same channel.
+
+Wire protocol (cloudpickle blobs, one tuple per message):
+  worker->head  ("register", num_workers)
+  head->worker  ("task", task_id, fn_blob, args_blob)
+  worker->head  ("result", task_id, ok, payload)
+  head->worker  ("shutdown",)
+
+Actors stay host-local (a dedicated process on the head); distributed
+tasks cover the parameter-server/AutoML fan-out the reference's examples
+exercise.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import traceback
+from multiprocessing.connection import Client, Listener
+from typing import Dict, List, Optional, Tuple
+
+logger = logging.getLogger("analytics_zoo_tpu.ray.cluster")
+
+DEFAULT_AUTHKEY = b"zoo-ray-cluster"
+
+
+class RemoteHost:
+    """Head-side handle for one joined worker host."""
+
+    def __init__(self, conn, num_workers: int, name: str):
+        self.conn = conn
+        self.num_workers = num_workers
+        self.name = name
+        self.in_flight = 0
+        self.lock = threading.Lock()
+        self.alive = True
+
+    def send_task(self, task_id: str, fn_blob: bytes, args_blob: bytes):
+        with self.lock:
+            self.conn.send(("task", task_id, fn_blob, args_blob))
+            self.in_flight += 1
+
+
+class ClusterListener:
+    """Accepts worker-host connections and feeds their results into the
+    driver's result queue (same queue the local pool uses)."""
+
+    def __init__(self, address: Tuple[str, int], result_q,
+                 authkey: bytes = DEFAULT_AUTHKEY):
+        self.listener = Listener(address, authkey=authkey)
+        self.address = self.listener.address
+        self.result_q = result_q
+        self.hosts: List[RemoteHost] = []
+        self.hosts_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn = self.listener.accept()
+            except (OSError, EOFError):
+                return
+            try:
+                msg = conn.recv()
+            except (OSError, EOFError):
+                continue
+            if not (isinstance(msg, tuple) and msg[0] == "register"):
+                conn.close()
+                continue
+            host = RemoteHost(conn, int(msg[1]),
+                              str(self.listener.last_accepted))
+            with self.hosts_lock:
+                self.hosts.append(host)
+            threading.Thread(target=self._reader_loop, args=(host,),
+                             daemon=True).start()
+            logger.info("worker host joined: %s (%d workers)", host.name,
+                        host.num_workers)
+
+    def _reader_loop(self, host: RemoteHost):
+        while not self._stop.is_set():
+            try:
+                msg = host.conn.recv()
+            except (OSError, EOFError):
+                break
+            if isinstance(msg, tuple) and msg[0] == "result":
+                _, task_id, ok, payload = msg
+                with host.lock:
+                    host.in_flight -= 1
+                self.result_q.put((task_id, ok, payload))
+        host.alive = False
+        with self.hosts_lock:
+            if host in self.hosts:
+                self.hosts.remove(host)
+        logger.warning("worker host left: %s", host.name)
+
+    def pick_host(self) -> Optional[RemoteHost]:
+        """Least-loaded joined host that still has spare workers."""
+        with self.hosts_lock:
+            candidates = [h for h in self.hosts
+                          if h.alive and h.in_flight < h.num_workers]
+            if not candidates:
+                return None
+            return min(candidates, key=lambda h: h.in_flight /
+                       max(h.num_workers, 1))
+
+    def close(self):
+        self._stop.set()
+        with self.hosts_lock:
+            for host in self.hosts:
+                try:
+                    host.conn.send(("shutdown",))
+                    host.conn.close()
+                except (OSError, EOFError):
+                    pass
+            self.hosts = []
+        try:
+            self.listener.close()
+        except OSError:
+            pass
+
+
+def worker_host_main(address: Tuple[str, int], num_workers: int = 2,
+                     authkey: bytes = DEFAULT_AUTHKEY,
+                     platform: Optional[str] = "cpu",
+                     max_tasks: Optional[int] = None):
+    """Join a head as a worker host: run tasks from the channel on a local
+    pool (the raylet role). Blocks until the head shuts the channel."""
+    from .raycontext import RayContext
+
+    conn = Client(address, authkey=authkey)
+    conn.send(("register", num_workers))
+    done = 0
+    with RayContext(num_ray_nodes=num_workers, ray_node_cpu_cores=1,
+                    platform=platform) as ctx:
+        pending: Dict[str, object] = {}
+        lock = threading.Lock()
+
+        def wait_and_reply(task_id, ref):
+            import cloudpickle
+            try:
+                result = ctx.get(ref)
+                payload, ok = cloudpickle.dumps(result), True
+            except BaseException as e:  # noqa: BLE001
+                payload, ok = (f"{type(e).__name__}: {e}\n"
+                               f"{traceback.format_exc()}"), False
+            with lock:
+                pending.pop(task_id, None)
+                try:
+                    conn.send(("result", task_id, ok, payload))
+                except (OSError, EOFError):
+                    pass
+
+        while True:
+            try:
+                msg = conn.recv()
+            except (OSError, EOFError):
+                break
+            if not isinstance(msg, tuple) or msg[0] == "shutdown":
+                break
+            if msg[0] != "task":
+                continue
+            import cloudpickle
+            _, task_id, fn_blob, args_blob = msg
+            fn = cloudpickle.loads(fn_blob)
+            args, kwargs = cloudpickle.loads(args_blob)
+            ref = ctx._submit(fn, args, kwargs)
+            with lock:
+                pending[task_id] = ref
+            threading.Thread(target=wait_and_reply, args=(task_id, ref),
+                             daemon=True).start()
+            done += 1
+            if max_tasks is not None and done >= max_tasks:
+                break
+    try:
+        conn.close()
+    except OSError:
+        pass

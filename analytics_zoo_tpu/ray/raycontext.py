@@ -210,10 +210,17 @@ class RayContext:
     def __init__(self, num_ray_nodes: int = 2, ray_node_cpu_cores: int = 1,
                  platform: Optional[str] = None,
                  env: Optional[Dict[str, str]] = None,
-                 object_store_memory: Optional[int] = None, **_compat):
+                 object_store_memory: Optional[int] = None,
+                 listen: Optional[tuple] = None,
+                 authkey: Optional[bytes] = None, **_compat):
         self.num_workers = max(1, num_ray_nodes * ray_node_cpu_cores)
         self.platform = platform
         self.env = dict(env or {})
+        # cross-host: listen=("0.0.0.0", port) accepts worker hosts
+        # (ray/cluster.py; reference raylets joining the head)
+        self._listen = listen
+        self._authkey = authkey
+        self._cluster = None
         self.stopped = True
         self._monitor = ProcessMonitor()
         self._procs: List[mp.Process] = []
@@ -244,6 +251,11 @@ class RayContext:
             self._procs.append(p)
             self._monitor.register(p)
         self.stopped = False
+        if self._listen is not None:
+            from .cluster import DEFAULT_AUTHKEY, ClusterListener
+            self._cluster = ClusterListener(
+                tuple(self._listen), self._result_q,
+                authkey=self._authkey or DEFAULT_AUTHKEY)
         _global_ray_context = self
         logger.info("RayContext: %d workers up", self.num_workers)
         return self
@@ -252,6 +264,9 @@ class RayContext:
         global _global_ray_context
         if self.stopped:
             return
+        if self._cluster is not None:
+            self._cluster.close()
+            self._cluster = None
         for actor_id in list(self._actors):
             self.kill(ActorHandle(self, actor_id))
         for _ in self._procs:
@@ -340,8 +355,18 @@ class RayContext:
 
         task_id = uuid.uuid4().hex
         self._pending.add(task_id)
-        self._task_q.put((task_id, cloudpickle.dumps(fn),
-                          cloudpickle.dumps((args, kwargs))))
+        fn_blob = cloudpickle.dumps(fn)
+        args_blob = cloudpickle.dumps((args, kwargs))
+        # cross-host: prefer an idle joined host over queueing locally
+        if self._cluster is not None:
+            host = self._cluster.pick_host()
+            if host is not None:
+                try:
+                    host.send_task(task_id, fn_blob, args_blob)
+                    return ObjectRef(task_id)
+                except (OSError, EOFError):
+                    pass  # host just died: fall through to the local pool
+        self._task_q.put((task_id, fn_blob, args_blob))
         return ObjectRef(task_id)
 
     def get(self, refs, timeout: Optional[float] = None):
