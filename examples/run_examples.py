@@ -21,6 +21,8 @@ EXAMPLES = [
     "tfpark_bert_finetune.py",
     "ray_parameter_server.py",
     "streaming_inference.py",
+    "automl_forecast.py",
+    "seq2seq_copy.py",
 ]
 
 

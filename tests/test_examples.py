@@ -18,7 +18,8 @@ EXAMPLES_DIR = os.path.join(os.path.dirname(os.path.dirname(
 FAST = ["recommendation_wide_and_deep.py", "anomaly_detection.py"]
 ALL = FAST + ["recommendation_ncf.py", "text_classification.py",
               "object_detection_ssd.py", "tfpark_bert_finetune.py",
-              "ray_parameter_server.py", "streaming_inference.py"]
+              "ray_parameter_server.py", "streaming_inference.py",
+              "automl_forecast.py", "seq2seq_copy.py"]
 
 
 def _run(name):
