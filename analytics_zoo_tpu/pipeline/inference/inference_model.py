@@ -225,6 +225,27 @@ class InferenceModel:
 
     do_load_pytorch = load_torch
 
+    def load_caffe(self, def_path: str, model_path: str,
+                   quantize: bool = False):
+        """Caffe prototxt + caffemodel (doLoadCaffe parity,
+        InferenceModel.scala) via pipeline.api.caffe."""
+        from ..api.caffe import load_caffe
+
+        net = load_caffe(def_path, model_path)
+        self._install(QuantizedModel(net) if quantize else FloatModel(net))
+        return self
+
+    do_load_caffe = load_caffe
+
+    def load_onnx(self, model_path: str, quantize: bool = False):
+        """ONNX file via pipeline.api.onnx (the reference reaches ONNX
+        through OpenVINO model-optimizer conversion)."""
+        from ..api.onnx import load_onnx
+
+        net = load_onnx(model_path)
+        self._install(QuantizedModel(net) if quantize else FloatModel(net))
+        return self
+
     def load_quantized(self, model_path: str):
         """int8 weight-only PTQ of a native model directory — the XLA
         stand-in for doLoadOpenVINO int8 IRs."""

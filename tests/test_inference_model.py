@@ -93,3 +93,36 @@ def test_autoscale_and_summary(tmp_path):
     scalars = read_scalars(os.path.join(str(tmp_path), "app", "inference"))
     tags = {s[2] for s in scalars}
     assert "Throughput" in tags and "LatencyMs" in tags
+
+
+def test_inference_model_load_caffe(tmp_path):
+    """doLoadCaffe parity: a caffe net behind the permit queue."""
+    from analytics_zoo_tpu.pipeline.api.caffe import proto as cproto
+    from analytics_zoo_tpu.pipeline.inference.inference_model import \
+        InferenceModel
+
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((2, 3, 1, 1)).astype(np.float32)
+    prototxt = """
+name: "tiny"
+input: "data"
+input_shape { dim: 1 dim: 3 dim: 4 dim: 4 }
+layer { name: "c" type: "Convolution" bottom: "data" top: "c"
+        convolution_param { num_output: 2 kernel_size: 1 bias_term: false } }
+layer { name: "sm" type: "Softmax" bottom: "c" top: "sm" }
+"""
+    (tmp_path / "net.prototxt").write_text(prototxt)
+    blob = {"shape": {"dim": list(w.shape)},
+            "data": [float(v) for v in w.ravel()]}
+    (tmp_path / "net.caffemodel").write_bytes(cproto.encode(
+        {"name": "tiny", "layer": [
+            {"name": "c", "type": "Convolution", "blobs": [blob]}]},
+        "NetParameter"))
+
+    model = InferenceModel()
+    model.load_caffe(str(tmp_path / "net.prototxt"),
+                     str(tmp_path / "net.caffemodel"))
+    x = rng.standard_normal((2, 3, 4, 4)).astype(np.float32)
+    out = np.asarray(model.predict(x))
+    assert out.shape == (2, 2, 4, 4)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-5)
