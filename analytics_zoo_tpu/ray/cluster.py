@@ -3,12 +3,14 @@
 The reference's RayContext spans the whole Spark cluster — partition 0 runs
 ``ray start --head`` and every executor host joins as a raylet
 (``pyzoo/zoo/ray/util/raycontext.py:155-189``). The TPU-native equivalent
-has no Spark barrier to rendezvous through, so the transport is a plain
+has no Spark barrier to rendezvous through, so the transport is an
 authenticated socket channel (``multiprocessing.connection``): the driver
-host listens, every worker HOST connects with
-``python -m analytics_zoo_tpu.ray.worker_host --connect head:port`` and
-contributes its local worker pool. Tasks round-robin across the head's own
-pool and the joined hosts; results stream back over the same channel.
+host listens with a per-cluster random authkey, every worker HOST connects
+with ``python -m analytics_zoo_tpu.ray.worker_host --connect head:port
+--authkey <key>`` and contributes its local worker pool. Tasks round-robin
+across the head's own pool and the joined hosts; results stream back over
+the same channel; a dying host's in-flight tasks are requeued onto the
+local pool so no ObjectRef ever hangs.
 
 Wire protocol (cloudpickle blobs, one tuple per message):
   worker->head  ("register", num_workers)
@@ -24,14 +26,20 @@ exercise.
 from __future__ import annotations
 
 import logging
+import secrets
 import threading
 import traceback
+from multiprocessing import AuthenticationError
 from multiprocessing.connection import Client, Listener
 from typing import Dict, List, Optional, Tuple
 
 logger = logging.getLogger("analytics_zoo_tpu.ray.cluster")
 
-DEFAULT_AUTHKEY = b"zoo-ray-cluster"
+
+def generate_authkey() -> bytes:
+    """Per-cluster random key — the channel executes pickled closures, so
+    a well-known constant key would be no authentication at all."""
+    return secrets.token_hex(16).encode()
 
 
 class RemoteHost:
@@ -41,25 +49,38 @@ class RemoteHost:
         self.conn = conn
         self.num_workers = num_workers
         self.name = name
-        self.in_flight = 0
+        # task_id -> (fn_blob, args_blob), kept so a dying host's work can
+        # be requeued instead of hanging its ObjectRefs
+        self.in_flight: Dict[str, Tuple[bytes, bytes]] = {}
         self.lock = threading.Lock()
         self.alive = True
 
     def send_task(self, task_id: str, fn_blob: bytes, args_blob: bytes):
         with self.lock:
             self.conn.send(("task", task_id, fn_blob, args_blob))
-            self.in_flight += 1
+            self.in_flight[task_id] = (fn_blob, args_blob)
+
+    def load(self) -> float:
+        with self.lock:
+            return len(self.in_flight) / max(self.num_workers, 1)
+
+    def has_capacity(self) -> bool:
+        with self.lock:
+            return len(self.in_flight) < self.num_workers
 
 
 class ClusterListener:
     """Accepts worker-host connections and feeds their results into the
     driver's result queue (same queue the local pool uses)."""
 
+    REGISTER_TIMEOUT_S = 10.0
+
     def __init__(self, address: Tuple[str, int], result_q,
-                 authkey: bytes = DEFAULT_AUTHKEY):
+                 authkey: bytes, requeue=None):
         self.listener = Listener(address, authkey=authkey)
         self.address = self.listener.address
         self.result_q = result_q
+        self.requeue = requeue          # callable((task_id, fn, args)) | None
         self.hosts: List[RemoteHost] = []
         self.hosts_lock = threading.Lock()
         self._stop = threading.Event()
@@ -71,23 +92,36 @@ class ClusterListener:
         while not self._stop.is_set():
             try:
                 conn = self.listener.accept()
-            except (OSError, EOFError):
-                return
-            try:
-                msg = conn.recv()
-            except (OSError, EOFError):
+            except (AuthenticationError, EOFError, OSError) as e:
+                # a failed/aborted/unauthenticated CONNECTION must not end
+                # the loop (port scans and wrong keys land here); only a
+                # closed listener does
+                if self._stop.is_set():
+                    return
+                logger.warning("rejected connection: %s", e)
                 continue
-            if not (isinstance(msg, tuple) and msg[0] == "register"):
-                conn.close()
-                continue
-            host = RemoteHost(conn, int(msg[1]),
-                              str(self.listener.last_accepted))
-            with self.hosts_lock:
-                self.hosts.append(host)
-            threading.Thread(target=self._reader_loop, args=(host,),
+            # registration handshake off-thread: a connected-but-silent
+            # client must not stall later joins
+            threading.Thread(target=self._register, args=(conn,),
                              daemon=True).start()
-            logger.info("worker host joined: %s (%d workers)", host.name,
-                        host.num_workers)
+
+    def _register(self, conn):
+        try:
+            if not conn.poll(self.REGISTER_TIMEOUT_S):
+                conn.close()
+                return
+            msg = conn.recv()
+        except (OSError, EOFError):
+            return
+        if not (isinstance(msg, tuple) and msg and msg[0] == "register"):
+            conn.close()
+            return
+        host = RemoteHost(conn, int(msg[1]), "worker-host")
+        with self.hosts_lock:
+            self.hosts.append(host)
+        threading.Thread(target=self._reader_loop, args=(host,),
+                         daemon=True).start()
+        logger.info("worker host joined (%d workers)", host.num_workers)
 
     def _reader_loop(self, host: RemoteHost):
         while not self._stop.is_set():
@@ -98,23 +132,37 @@ class ClusterListener:
             if isinstance(msg, tuple) and msg[0] == "result":
                 _, task_id, ok, payload = msg
                 with host.lock:
-                    host.in_flight -= 1
+                    host.in_flight.pop(task_id, None)
                 self.result_q.put((task_id, ok, payload))
         host.alive = False
         with self.hosts_lock:
             if host in self.hosts:
                 self.hosts.remove(host)
-        logger.warning("worker host left: %s", host.name)
+        # the host died with work outstanding: requeue onto the local pool
+        # (or fail loudly) so no ObjectRef hangs forever
+        with host.lock:
+            orphans = list(host.in_flight.items())
+            host.in_flight.clear()
+        for task_id, (fn_blob, args_blob) in orphans:
+            if self.requeue is not None:
+                self.requeue((task_id, fn_blob, args_blob))
+            else:
+                self.result_q.put((task_id, False,
+                                   "worker host died mid-task"))
+        if orphans:
+            logger.warning("worker host left; %d tasks requeued",
+                           len(orphans))
+        else:
+            logger.info("worker host left")
 
     def pick_host(self) -> Optional[RemoteHost]:
         """Least-loaded joined host that still has spare workers."""
         with self.hosts_lock:
             candidates = [h for h in self.hosts
-                          if h.alive and h.in_flight < h.num_workers]
+                          if h.alive and h.has_capacity()]
             if not candidates:
                 return None
-            return min(candidates, key=lambda h: h.in_flight /
-                       max(h.num_workers, 1))
+            return min(candidates, key=RemoteHost.load)
 
     def close(self):
         self._stop.set()
@@ -133,8 +181,7 @@ class ClusterListener:
 
 
 def worker_host_main(address: Tuple[str, int], num_workers: int = 2,
-                     authkey: bytes = DEFAULT_AUTHKEY,
-                     platform: Optional[str] = "cpu",
+                     authkey: bytes = b"", platform: Optional[str] = "cpu",
                      max_tasks: Optional[int] = None):
     """Join a head as a worker host: run tasks from the channel on a local
     pool (the raylet role). Blocks until the head shuts the channel."""
@@ -145,7 +192,6 @@ def worker_host_main(address: Tuple[str, int], num_workers: int = 2,
     done = 0
     with RayContext(num_ray_nodes=num_workers, ray_node_cpu_cores=1,
                     platform=platform) as ctx:
-        pending: Dict[str, object] = {}
         lock = threading.Lock()
 
         def wait_and_reply(task_id, ref):
@@ -157,7 +203,6 @@ def worker_host_main(address: Tuple[str, int], num_workers: int = 2,
                 payload, ok = (f"{type(e).__name__}: {e}\n"
                                f"{traceback.format_exc()}"), False
             with lock:
-                pending.pop(task_id, None)
                 try:
                     conn.send(("result", task_id, ok, payload))
                 except (OSError, EOFError):
@@ -177,8 +222,6 @@ def worker_host_main(address: Tuple[str, int], num_workers: int = 2,
             fn = cloudpickle.loads(fn_blob)
             args, kwargs = cloudpickle.loads(args_blob)
             ref = ctx._submit(fn, args, kwargs)
-            with lock:
-                pending[task_id] = ref
             threading.Thread(target=wait_and_reply, args=(task_id, ref),
                              daemon=True).start()
             done += 1

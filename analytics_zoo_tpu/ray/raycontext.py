@@ -217,9 +217,11 @@ class RayContext:
         self.platform = platform
         self.env = dict(env or {})
         # cross-host: listen=("0.0.0.0", port) accepts worker hosts
-        # (ray/cluster.py; reference raylets joining the head)
+        # (ray/cluster.py; reference raylets joining the head). The
+        # authkey is generated per cluster when not supplied — read it
+        # from .cluster_authkey and pass it to worker hosts.
         self._listen = listen
-        self._authkey = authkey
+        self.cluster_authkey = authkey
         self._cluster = None
         self.stopped = True
         self._monitor = ProcessMonitor()
@@ -252,10 +254,13 @@ class RayContext:
             self._monitor.register(p)
         self.stopped = False
         if self._listen is not None:
-            from .cluster import DEFAULT_AUTHKEY, ClusterListener
+            from .cluster import ClusterListener, generate_authkey
+            if self.cluster_authkey is None:
+                self.cluster_authkey = generate_authkey()
             self._cluster = ClusterListener(
                 tuple(self._listen), self._result_q,
-                authkey=self._authkey or DEFAULT_AUTHKEY)
+                authkey=self.cluster_authkey,
+                requeue=self._task_q.put)
         _global_ray_context = self
         logger.info("RayContext: %d workers up", self.num_workers)
         return self

@@ -7,7 +7,7 @@ the non-zero barrier partitions running ``ray start`` in
 
 import argparse
 
-from .cluster import DEFAULT_AUTHKEY, worker_host_main
+from .cluster import worker_host_main
 
 
 def main():
@@ -15,7 +15,8 @@ def main():
     p.add_argument("--connect", required=True, help="head HOST:PORT")
     p.add_argument("--workers", type=int, default=2)
     p.add_argument("--platform", default="cpu")
-    p.add_argument("--authkey", default=DEFAULT_AUTHKEY.decode())
+    p.add_argument("--authkey", required=True,
+                   help="the head's RayContext.cluster_authkey")
     args = p.parse_args()
     host, port = args.connect.rsplit(":", 1)
     worker_host_main((host, int(port)), num_workers=args.workers,

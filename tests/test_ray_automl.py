@@ -207,7 +207,8 @@ def test_cross_host_task_dispatch():
         env.pop("XLA_FLAGS", None)
         joiner = subprocess.Popen(
             [sys.executable, "-m", "analytics_zoo_tpu.ray.worker_host",
-             "--connect", f"127.0.0.1:{port}", "--workers", "2"],
+             "--connect", f"127.0.0.1:{port}", "--workers", "2",
+             "--authkey", ctx.cluster_authkey.decode()],
             env=env, cwd=os.path.dirname(os.path.dirname(
                 os.path.abspath(__file__))))
         try:
@@ -228,3 +229,43 @@ def test_cross_host_task_dispatch():
         finally:
             joiner.terminate()
             joiner.wait(timeout=10)
+
+
+def test_cluster_listener_survives_bad_connections():
+    """Port scans, wrong authkeys and silent clients must not kill or
+    stall the accept loop (code-review r3: empirically confirmed bug)."""
+    import queue as queue_mod
+    import socket
+    import time
+
+    from analytics_zoo_tpu.ray.cluster import (ClusterListener,
+                                               generate_authkey)
+    from multiprocessing.connection import Client
+
+    result_q = queue_mod.Queue()
+    key = generate_authkey()
+    listener = ClusterListener(("127.0.0.1", 0), result_q, authkey=key)
+    try:
+        addr = listener.address
+        # 1) plain TCP connect-and-close (port scan)
+        s = socket.create_connection(addr)
+        s.close()
+        time.sleep(0.3)
+        assert listener._accept_thread.is_alive()
+        # 2) wrong authkey
+        try:
+            Client(addr, authkey=b"wrong-key")
+        except Exception:
+            pass
+        time.sleep(0.3)
+        assert listener._accept_thread.is_alive()
+        # 3) a legitimate host still joins afterwards
+        conn = Client(addr, authkey=key)
+        conn.send(("register", 2))
+        deadline = time.time() + 10
+        while not listener.hosts and time.time() < deadline:
+            time.sleep(0.1)
+        assert listener.hosts and listener.hosts[0].num_workers == 2
+        conn.close()
+    finally:
+        listener.close()
