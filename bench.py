@@ -273,6 +273,77 @@ def _bench_bert_mfu_at(peak_flops, bert_batch):
     }
 
 
+# ---------------------------------------------------------------------------
+# Part C: ResNet-50 train-step MFU (the BASELINE.md north-star model)
+# ---------------------------------------------------------------------------
+
+RESNET_FWD_FLOPS_PER_IMAGE = 2 * 4.09e9   # 4.09 GMACs @ 224x224 (public)
+
+
+def bench_resnet_mfu(peak_flops, batch_candidates=(64, 32)):
+    from analytics_zoo_tpu.utils.profiling import device_sync  # noqa: F401
+
+    last_err = None
+    for bb in batch_candidates:
+        try:
+            return _bench_resnet_mfu_at(peak_flops, bb)
+        except Exception as e:  # noqa: BLE001 - e.g. OOM at the big batch
+            last_err = e
+            print(f"# resnet batch={bb} failed: "
+                  f"{str(e).splitlines()[0] if str(e) else repr(e)}",
+                  file=sys.stderr)
+    raise last_err
+
+
+def _bench_resnet_mfu_at(peak_flops, batch):
+    from analytics_zoo_tpu.common.nncontext import (ZooConfig, ZooContext,
+                                                    set_nncontext)
+    from analytics_zoo_tpu.feature.feature_set import ArrayFeatureSet
+    from analytics_zoo_tpu.models.image.imageclassification import \
+        ImageClassifier
+    from analytics_zoo_tpu.utils.profiling import device_sync
+
+    set_nncontext(None)
+    set_nncontext(ZooContext(ZooConfig(compute_dtype="bfloat16")))
+
+    clf = ImageClassifier(class_num=1000, model_name="resnet-50")
+    clf.compile(optimizer="sgd", loss="sparse_categorical_crossentropy")
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((batch, 3, 224, 224)).astype(np.float32)
+    y = rng.integers(0, 1000, (batch,)).astype(np.int32)
+
+    trainer = clf.model._ensure_trainer()
+    trainer.ensure_initialized()
+    fs = ArrayFeatureSet([x], y)
+    host_batch = next(iter(fs.batches(batch)))
+    k = 4
+    multi = trainer.build_multi_step(k)
+    stacked = trainer._put_stacked([host_batch] * k)
+    params, opt_state, net_state = (trainer.params, trainer.opt_state,
+                                    trainer.net_state)
+    params, opt_state, net_state, logs = multi(
+        params, opt_state, net_state, stacked, 0)
+    device_sync(logs["loss"])
+
+    n_dispatch = 3
+    t0 = time.perf_counter()
+    for i in range(n_dispatch):
+        params, opt_state, net_state, logs = multi(
+            params, opt_state, net_state, stacked, (i + 1) * k)
+    device_sync(logs["loss"])
+    n_steps = n_dispatch * k
+    dt = (time.perf_counter() - t0) / n_steps
+
+    achieved = 3 * RESNET_FWD_FLOPS_PER_IMAGE * batch / dt
+    return {
+        "resnet_batch": batch,
+        "resnet_step_time_ms": round(dt * 1e3, 2),
+        "resnet_images_per_sec": round(batch / dt, 1),
+        "resnet_mfu": (round(achieved / peak_flops, 4)
+                       if peak_flops else None),
+    }
+
+
 def main():
     extra = {}
     info, err = probe_backend()
@@ -310,10 +381,10 @@ def main():
         except Exception as e:  # torch missing/broken: report raw number
             print(f"# torch baseline failed: {e}", file=sys.stderr)
 
+    peak = _peak_flops(info["device_kind"]) \
+        if info["platform"] == "tpu" else None
     if time.time() - T_START < TOTAL_BUDGET_S * 0.85:
         try:
-            peak = _peak_flops(info["device_kind"]) \
-                if info["platform"] == "tpu" else None
             extra.update(bench_bert_mfu(peak))
         except Exception as e:  # noqa: BLE001
             import traceback
@@ -323,6 +394,16 @@ def main():
                                    if str(e) else repr(e)[:500])
     else:
         extra["bert_skipped"] = "time budget exhausted"
+
+    # ResNet-50 MFU (BASELINE.md north-star) only with budget to spare —
+    # and only on real hardware (it is meaningless on the CPU fallback)
+    if info["platform"] == "tpu" and \
+            time.time() - T_START < TOTAL_BUDGET_S * 0.6:
+        try:
+            extra.update(bench_resnet_mfu(peak))
+        except Exception as e:  # noqa: BLE001
+            extra["resnet_error"] = (str(e).splitlines()[0][:500]
+                                     if str(e) else repr(e)[:500])
 
     result = {"metric": "ncf_movielens_train_steps_per_sec",
               "value": round(tpu_sps, 2) if tpu_sps is not None else None,
