@@ -170,3 +170,76 @@ def test_transformer_layer_grad_finite_difference():
             fd = (hi - lo) / (2 * eps)
             assert abs(fd - g[idx]) < 5e-2 * max(1.0, abs(fd)), \
                 (name, idx, fd, g[idx])
+
+
+def test_embedding_grad_parity():
+    idx = np.random.default_rng(7).integers(0, 10, (4, 6))
+    ref = tf.keras.layers.Embedding(10, 3)
+    ref(idx)
+    table = ref.get_weights()[0]
+    layer = zl.Embedding(10, 3)
+
+    def loss_fn(params, x):
+        out = layer.call(params, x)
+        return (out.astype(jnp.float32) ** 2).sum()
+
+    gp = jax.grad(loss_fn)({"table": jnp.asarray(table)},
+                           jnp.asarray(idx))
+    xt = tf.convert_to_tensor(idx)
+    with tf.GradientTape() as tape:
+        out = ref(xt)
+        loss = tf.reduce_sum(tf.square(out))
+    kg = tape.gradient(loss, ref.trainable_weights)[0]
+    kg_dense = tf.convert_to_tensor(kg).numpy() if not hasattr(
+        kg, "numpy") else (tf.IndexedSlices(kg.values, kg.indices,
+                                            kg.dense_shape)
+                           if hasattr(kg, "values") else kg)
+    if hasattr(kg, "values"):  # IndexedSlices -> dense
+        kg_dense = np.zeros_like(table)
+        np.add.at(kg_dense, kg.indices.numpy(), kg.values.numpy())
+    else:
+        kg_dense = kg.numpy()
+    np.testing.assert_allclose(np.asarray(gp["table"]), kg_dense,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_conv1d_grad_parity():
+    x = np.random.default_rng(8).standard_normal((2, 12, 5)) \
+        .astype(np.float32)
+    ref = tf.keras.layers.Conv1D(6, 4, strides=2, padding="valid")
+    ref(x)
+    k, b = ref.get_weights()
+    layer = zl.Convolution1D(6, 4, subsample_length=2)
+    _check(_zoo_grads(layer, {"kernel": k, "bias": b}, x,
+                      ["kernel", "bias"]),
+           _keras_grads(ref, x))
+
+
+def test_bidirectional_lstm_grad_parity():
+    x = np.random.default_rng(9).standard_normal((2, 5, 4)) \
+        .astype(np.float32)
+    ref = tf.keras.layers.Bidirectional(
+        tf.keras.layers.LSTM(3, activation="tanh",
+                             recurrent_activation="sigmoid",
+                             return_sequences=True))
+    ref(x)
+    wf = ref.get_weights()
+    inner = zl.LSTM(3, inner_activation="sigmoid", return_sequences=True)
+    layer = zl.Bidirectional(inner)
+    params = {"forward": {"W": wf[0], "U": wf[1], "b": wf[2]},
+              "backward": {"W": wf[3], "U": wf[4], "b": wf[5]}}
+    zoo = _zoo_grads(layer, params, x, [])
+    keras = _keras_grads(ref, x)
+    # input grads + flatten weight grads in matching order
+    def flat_zoo(params, x):
+        def loss_fn(p, xx):
+            out = layer.call(p, xx)
+            return (out.astype(jnp.float32) ** 2).sum()
+        gp, gx = jax.grad(loss_fn, argnums=(0, 1))(
+            jax.tree.map(jnp.asarray, params), jnp.asarray(x))
+        order = [gp["forward"]["W"], gp["forward"]["U"],
+                 gp["forward"]["b"], gp["backward"]["W"],
+                 gp["backward"]["U"], gp["backward"]["b"]]
+        return [np.asarray(gx)] + [np.asarray(g) for g in order]
+
+    _check(flat_zoo(params, x), keras, rtol=2e-3, atol=2e-3)
