@@ -575,6 +575,27 @@ class Sequential(KerasNet):
             x = layer(x)
         return GraphFunction([inp], [x])
 
+    def to_model(self) -> "Model":
+        """Sequential -> functional Model over the same layer objects
+        (parity: ``Sequential.toModel``, Topology.scala:914). Weights are
+        carried across; graph surgery (new_graph/freeze_up_to) then
+        applies."""
+        graph = self.graph_function()
+        m = Model(graph.inputs, graph.outputs
+                  if len(graph.outputs) > 1 else graph.outputs[0],
+                  name=self.name + "_model")
+        if getattr(self, "_built_params", None) is not None or \
+                self.trainer is not None:
+            m._built_params = self._params_tuple()
+        m.optimizer, m.loss, m.metrics = (self.optimizer, self.loss,
+                                          self.metrics)
+        return m
+
+    toModel = to_model
+
+    def new_graph(self, outputs: Sequence[str]) -> "Model":
+        return self.to_model().new_graph(outputs)
+
     # used as a nested layer -------------------------------------------
     def build(self, rng, input_shape):
         params = {}

@@ -23,6 +23,8 @@ EXAMPLES = [
     "streaming_inference.py",
     "automl_forecast.py",
     "seq2seq_copy.py",
+    "image_finetune.py",
+    "text_matching_knrm.py",
 ]
 
 

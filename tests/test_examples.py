@@ -19,7 +19,8 @@ FAST = ["recommendation_wide_and_deep.py", "anomaly_detection.py"]
 ALL = FAST + ["recommendation_ncf.py", "text_classification.py",
               "object_detection_ssd.py", "tfpark_bert_finetune.py",
               "ray_parameter_server.py", "streaming_inference.py",
-              "automl_forecast.py", "seq2seq_copy.py"]
+              "automl_forecast.py", "seq2seq_copy.py",
+              "image_finetune.py", "text_matching_knrm.py"]
 
 
 def _run(name):
