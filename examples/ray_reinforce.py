@@ -1,0 +1,84 @@
+"""Distributed REINFORCE on the Ray-equivalent runtime.
+
+Reference example family: ``pyzoo/zoo/examples/ray/rl_pong`` — parallel
+rollout workers collect episodes while a central learner updates the
+policy. No gym offline, so the environment is a windy gridworld (reach the
+goal against stochastic drift); rollouts fan out as tasks, the policy
+gradient is applied centrally.
+"""
+
+import numpy as np
+
+from common import example_args
+
+from analytics_zoo_tpu.ray import RayContext
+
+GRID, MAX_STEPS, ACTIONS = 5, 20, 4          # up/down/left/right
+
+
+def rollout(theta, seed):
+    """One episode with a linear softmax policy; returns per-step
+    (state_onehot, action, discounted_return) arrays (runs remotely)."""
+    rng = np.random.default_rng(seed)
+    pos = np.array([0, 0])
+    goal = np.array([GRID - 1, GRID - 1])
+    states, actions, rewards = [], [], []
+    for _ in range(MAX_STEPS):
+        s = np.zeros(GRID * GRID, np.float32)
+        s[pos[0] * GRID + pos[1]] = 1.0
+        logits = s @ theta
+        p = np.exp(logits - logits.max())
+        p /= p.sum()
+        a = rng.choice(ACTIONS, p=p)
+        states.append(s)
+        actions.append(a)
+        delta = [(-1, 0), (1, 0), (0, -1), (0, 1)][a]
+        pos = np.clip(pos + delta, 0, GRID - 1)
+        if rng.random() < 0.1:                    # wind
+            pos = np.clip(pos + rng.integers(-1, 2, 2), 0, GRID - 1)
+        done = bool((pos == goal).all())
+        rewards.append(1.0 if done else -0.02)
+        if done:
+            break
+    returns, g = [], 0.0
+    for r in reversed(rewards):
+        g = r + 0.97 * g
+        returns.append(g)
+    returns.reverse()
+    return (np.stack(states), np.array(actions, np.int64),
+            np.array(returns, np.float32))
+
+
+def main():
+    args = example_args("distributed REINFORCE / windy gridworld",
+                        epochs=120)
+    theta = np.zeros((GRID * GRID, ACTIONS), np.float32)
+    n_workers, episodes_per_iter, lr = 4, 8, 0.5
+
+    with RayContext(num_ray_nodes=n_workers, ray_node_cpu_cores=1,
+                    platform="cpu") as ctx:
+        roll = ctx.remote(rollout)
+        returns_log = []
+        for it in range(args.epochs):
+            refs = [roll.remote(theta, args.seed + it * 1000 + e)
+                    for e in range(episodes_per_iter)]
+            grad = np.zeros_like(theta)
+            total_return = 0.0
+            for states, actions, returns in ctx.get(refs):
+                logits = states @ theta
+                p = np.exp(logits - logits.max(axis=1, keepdims=True))
+                p /= p.sum(axis=1, keepdims=True)
+                onehot = np.eye(ACTIONS, dtype=np.float32)[actions]
+                grad += states.T @ ((onehot - p) * returns[:, None])
+                total_return += returns[0]
+            theta += lr * grad / episodes_per_iter
+            returns_log.append(total_return / episodes_per_iter)
+    early = float(np.mean(returns_log[:5]))
+    late = float(np.mean(returns_log[-5:]))
+    print(f"mean episode return: first-5 {early:.3f} -> last-5 {late:.3f}")
+    assert late > early + 0.2, (early, late)   # the policy must improve
+    print("REINFORCE example OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -25,6 +25,7 @@ EXAMPLES = [
     "seq2seq_copy.py",
     "image_finetune.py",
     "text_matching_knrm.py",
+    "ray_reinforce.py",
 ]
 
 

@@ -20,7 +20,8 @@ ALL = FAST + ["recommendation_ncf.py", "text_classification.py",
               "object_detection_ssd.py", "tfpark_bert_finetune.py",
               "ray_parameter_server.py", "streaming_inference.py",
               "automl_forecast.py", "seq2seq_copy.py",
-              "image_finetune.py", "text_matching_knrm.py"]
+              "image_finetune.py", "text_matching_knrm.py",
+              "ray_reinforce.py"]
 
 
 def _run(name):
