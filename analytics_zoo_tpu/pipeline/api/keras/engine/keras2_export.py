@@ -1,0 +1,160 @@
+"""Emit a Keras-2 (tf.keras) python definition of a Sequential model.
+
+Parity: ``saveToKeras2`` (``Topology.scala:557`` via the keras2
+serializer) — the reference writes a runnable Keras-2 definition so zoo
+models can be rebuilt in stock Keras. Scope here: Sequential stacks over
+the common layer set; functional graphs export via ``export_tf`` (exact,
+jax2tf) or ``export_onnx`` instead. :func:`keras2_weights` returns the
+weights in tf.keras ``set_weights`` order (kernel before bias, Conv HWIO,
+LSTM/GRU W/U/b) — the generated file documents the transplant recipe.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+
+class Keras2ExportError(Exception):
+    pass
+
+
+def _args(**kw) -> str:
+    parts = []
+    for k, v in kw.items():
+        if v is None:
+            continue
+        parts.append(f"{k}={v!r}")
+    return ", ".join(parts)
+
+
+def _data_format(layer) -> str:
+    return ("channels_first"
+            if getattr(layer, "dim_ordering", "tf") == "th"
+            else "channels_last")
+
+
+def _emit_layer(layer, is_first: bool) -> str:
+    from .. import layers as zl
+
+    kind = type(layer).__name__
+    input_shape = None
+    if is_first and layer.input_shape is not None:
+        input_shape = tuple(layer.input_shape[1:])
+
+    if isinstance(layer, zl.Dense):
+        return (f"keras.layers.Dense({layer.output_dim}, "
+                f"{_args(activation=_act_name(layer), use_bias=layer.bias, input_shape=input_shape, name=layer.name)})")
+    if isinstance(layer, zl.Convolution2D):
+        return (f"keras.layers.Conv2D({layer.nb_filter}, "
+                f"{layer.kernel_size}, "
+                f"{_args(strides=tuple(layer.subsample), padding=layer.border_mode, activation=_act_name(layer), use_bias=layer.bias, data_format=_data_format(layer), input_shape=input_shape, name=layer.name)})")
+    if isinstance(layer, zl.Convolution1D):
+        return (f"keras.layers.Conv1D({layer.nb_filter}, "
+                f"{layer.filter_length}, "
+                f"{_args(strides=layer.subsample, padding=layer.border_mode, activation=_act_name(layer), use_bias=layer.bias, input_shape=input_shape, name=layer.name)})")
+    if isinstance(layer, zl.MaxPooling2D):
+        return (f"keras.layers.MaxPooling2D({tuple(layer.pool_size)}, "
+                f"{_args(strides=tuple(layer.strides) if layer.strides else None, data_format=_data_format(layer), input_shape=input_shape, name=layer.name)})")
+    if isinstance(layer, zl.AveragePooling2D):
+        return (f"keras.layers.AveragePooling2D({tuple(layer.pool_size)}, "
+                f"{_args(strides=tuple(layer.strides) if layer.strides else None, data_format=_data_format(layer), input_shape=input_shape, name=layer.name)})")
+    if isinstance(layer, zl.GlobalMaxPooling2D):
+        return (f"keras.layers.GlobalMaxPooling2D("
+                f"{_args(data_format=_data_format(layer), input_shape=input_shape, name=layer.name)})")
+    if isinstance(layer, zl.GlobalAveragePooling2D):
+        return (f"keras.layers.GlobalAveragePooling2D("
+                f"{_args(data_format=_data_format(layer), input_shape=input_shape, name=layer.name)})")
+    if isinstance(layer, zl.GlobalMaxPooling1D):
+        return (f"keras.layers.GlobalMaxPooling1D("
+                f"{_args(input_shape=input_shape, name=layer.name)})")
+    if isinstance(layer, zl.GlobalAveragePooling1D):
+        return (f"keras.layers.GlobalAveragePooling1D("
+                f"{_args(input_shape=input_shape, name=layer.name)})")
+    if isinstance(layer, zl.Flatten):
+        return (f"keras.layers.Flatten("
+                f"{_args(input_shape=input_shape, name=layer.name)})")
+    if isinstance(layer, zl.Dropout):
+        return (f"keras.layers.Dropout({layer.p}, "
+                f"{_args(input_shape=input_shape, name=layer.name)})")
+    if isinstance(layer, zl.Activation):
+        return (f"keras.layers.Activation({_act_name(layer)!r}, "
+                f"{_args(input_shape=input_shape, name=layer.name)})")
+    if isinstance(layer, zl.Embedding):
+        return (f"keras.layers.Embedding({layer.input_dim}, "
+                f"{layer.output_dim}, "
+                f"{_args(input_shape=input_shape, name=layer.name)})")
+    if isinstance(layer, zl.LSTM):
+        return (f"keras.layers.LSTM({layer.output_dim}, "
+                f"{_args(activation='tanh', recurrent_activation='sigmoid', return_sequences=layer.return_sequences, input_shape=input_shape, name=layer.name)})")
+    if isinstance(layer, zl.GRU):
+        return (f"keras.layers.GRU({layer.output_dim}, "
+                f"{_args(activation='tanh', recurrent_activation='sigmoid', return_sequences=layer.return_sequences, reset_after=False, input_shape=input_shape, name=layer.name)})")
+    raise Keras2ExportError(
+        f"layer {layer.name!r} ({kind}) has no Keras-2 emission rule; use "
+        "export_tf (exact, via jax2tf) or export_onnx for this model")
+
+
+def _act_name(layer):
+    fn = getattr(layer, "activation", None)
+    if fn is None:
+        return None
+    # NamedActivation stores the string; fall back to __name__
+    return getattr(fn, "name", None) or getattr(fn, "__name__", None)
+
+
+# tf.keras set_weights order per emitted layer type
+_WEIGHT_ORDER = {
+    "Dense": ("kernel", "bias"),
+    "Convolution2D": ("kernel", "bias"),
+    "Convolution1D": ("kernel", "bias"),
+    "Embedding": ("table",),
+    "LSTM": ("W", "U", "b"),
+    "GRU": ("W", "U", "b"),
+}
+
+
+def keras2_weights(model):
+    """Weights in the order ``build_model().set_weights`` expects (the
+    zoo's ``get_weights`` flattens param dicts alphabetically, which puts
+    bias before kernel)."""
+    import numpy as np
+
+    params, _ = model._params_tuple()
+    out = []
+    for layer in model.layers:
+        p = params.get(layer.name, {})
+        for name in _WEIGHT_ORDER.get(type(layer).__name__, ()):
+            if name in p:
+                out.append(np.asarray(p[name]))
+    return out
+
+
+def sequential_to_keras2_source(model) -> str:
+    """Generate a runnable Keras-2 python definition for a Sequential."""
+    from .topology import Sequential
+
+    if not isinstance(model, Sequential):
+        raise Keras2ExportError(
+            "saveToKeras2 emits Sequential stacks; functional graphs "
+            "export via export_tf/export_onnx")
+    lines: List[str] = [
+        '"""Keras-2 definition generated by analytics_zoo_tpu '
+        "saveToKeras2.",
+        "",
+        "Weight transplant:",
+        "    from analytics_zoo_tpu.pipeline.api.keras.engine import \\",
+        "        keras2_export",
+        "    tf_model = build_model()",
+        "    tf_model.build((None,) + input_shape)",
+        "    tf_model.set_weights(keras2_export.keras2_weights(zoo_model))",
+        '"""',
+        "from tensorflow import keras",
+        "",
+        "",
+        "def build_model():",
+        f"    model = keras.Sequential(name={model.name!r})",
+    ]
+    for i, layer in enumerate(model.layers):
+        lines.append(f"    model.add({_emit_layer(layer, i == 0)})")
+    lines += ["    return model", ""]
+    return "\n".join(lines)

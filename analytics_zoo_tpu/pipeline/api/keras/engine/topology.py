@@ -596,6 +596,18 @@ class Sequential(KerasNet):
     def new_graph(self, outputs: Sequence[str]) -> "Model":
         return self.to_model().new_graph(outputs)
 
+    def save_keras2(self, path: str) -> str:
+        """Write a runnable Keras-2 python definition of this stack
+        (parity: ``saveToKeras2``, Topology.scala:557)."""
+        from .keras2_export import sequential_to_keras2_source
+
+        src = sequential_to_keras2_source(self)
+        with open(path, "w") as f:
+            f.write(src)
+        return path
+
+    saveToKeras2 = save_keras2
+
     # used as a nested layer -------------------------------------------
     def build(self, rng, input_shape):
         params = {}

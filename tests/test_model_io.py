@@ -111,3 +111,51 @@ def test_export_tf_savedmodel(tmp_path):
         tf.constant(x))
     tf_preds = list(tf_out.values())[0].numpy()
     np.testing.assert_allclose(preds, tf_preds, rtol=1e-5, atol=1e-5)
+
+
+def test_save_keras2_definition_roundtrip(tmp_path):
+    """saveToKeras2 parity (Topology.scala:557): the emitted Keras-2
+    python rebuilds in tf.keras, weights transplant in order, outputs
+    match."""
+    tf = pytest.importorskip("tensorflow")
+
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        Convolution2D, Flatten as ZFlatten, MaxPooling2D)
+
+    model = Sequential()
+    model.add(Convolution2D(4, 3, 3, activation="relu",
+                            dim_ordering="tf", input_shape=(8, 8, 3)))
+    model.add(MaxPooling2D((2, 2), dim_ordering="tf"))
+    model.add(ZFlatten())
+    model.add(Dense(5, activation="softmax"))
+    model.compile(optimizer=Adam(lr=0.01),
+                  loss="sparse_categorical_crossentropy")
+    x = np.random.default_rng(4).standard_normal((2, 8, 8, 3)) \
+        .astype(np.float32)
+    zoo_out = model.predict(x, batch_size=2)
+
+    path = str(tmp_path / "model_keras2.py")
+    model.save_keras2(path)
+    scope = {}
+    with open(path) as f:
+        exec(compile(f.read(), path, "exec"), scope)
+    from analytics_zoo_tpu.pipeline.api.keras.engine.keras2_export import \
+        keras2_weights
+
+    tf_model = scope["build_model"]()
+    tf_model(x)                      # build variables before transplanting
+    tf_model.set_weights(keras2_weights(model))
+    tf_out = tf_model(x).numpy()
+    np.testing.assert_allclose(zoo_out, tf_out, rtol=1e-4, atol=1e-5)
+
+
+def test_save_keras2_rejects_unsupported():
+    from analytics_zoo_tpu.pipeline.api.keras.engine.keras2_export import \
+        Keras2ExportError
+    from analytics_zoo_tpu.pipeline.api.keras.layers import SReLU
+
+    model = Sequential()
+    model.add(Dense(4, input_shape=(8,)))
+    model.add(SReLU())
+    with pytest.raises(Keras2ExportError, match="no Keras-2 emission"):
+        model.save_keras2("/tmp/nope.py")
