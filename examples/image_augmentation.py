@@ -1,0 +1,55 @@
+"""Image augmentation pipeline on an ImageSet.
+
+Reference app: ``apps/image-augmentation`` (and ``image-augmentation-3d``)
+— load images into an ImageSet, chain the ``->``-style preprocessing ops
+(brightness/contrast/hue jitter, flip, resize, crop, normalize, to-tensor)
+and inspect the transformed tensors. Same chain here over synthetic
+images (the ``->`` Scala operator is ``>>`` in this API), plus the 3D
+variant on a synthetic volume.
+"""
+
+import numpy as np
+
+from common import example_args
+
+from analytics_zoo_tpu.feature.image import (ImageCenterCrop,
+                                             ImageChannelNormalize,
+                                             ImageColorJitter, ImageHFlip,
+                                             ImageMatToTensor,
+                                             ImageRandomPreprocessing,
+                                             ImageResize, ImageSet)
+from analytics_zoo_tpu.feature.image.image_feature import ImageFeature
+from analytics_zoo_tpu.feature.image3d import CenterCrop3D, Rotate3D
+
+
+def main():
+    args = example_args("ImageSet augmentation chain", samples=16)
+    rng = np.random.default_rng(args.seed)
+    imgs = [rng.integers(0, 256, (48, 64, 3)).astype(np.float32)
+            for _ in range(args.samples)]
+
+    image_set = ImageSet.array(imgs)
+    transformer = (ImageResize(40, 40)
+                   >> ImageColorJitter()
+                   >> ImageRandomPreprocessing(ImageHFlip(), 0.5)
+                   >> ImageCenterCrop(32, 32)
+                   >> ImageChannelNormalize(123.0, 117.0, 104.0)
+                   >> ImageMatToTensor(format="NCHW"))
+    out = image_set.transform(transformer)
+    tensors = out.get_image(key="floats")
+    assert len(tensors) == args.samples
+    assert all(t.shape == (3, 32, 32) for t in tensors)
+    print(f"augmented {len(tensors)} images -> {tensors[0].shape} tensors, "
+          f"mean {float(np.mean([t.mean() for t in tensors])):.2f}")
+
+    # 3D variant (apps/image-augmentation-3d): rotate + center-crop a volume
+    vol = rng.standard_normal((32, 32, 32)).astype(np.float32)
+    rotated = Rotate3D([0.0, 0.0, np.pi / 6]).apply(ImageFeature(vol))
+    cropped = CenterCrop3D(24, 24, 24).apply(rotated).get_image()
+    assert cropped.shape == (24, 24, 24)
+    print(f"3d: rotated+cropped volume -> {cropped.shape}")
+    print("Image-augmentation example OK")
+
+
+if __name__ == "__main__":
+    main()

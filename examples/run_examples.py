@@ -26,6 +26,10 @@ EXAMPLES = [
     "image_finetune.py",
     "text_matching_knrm.py",
     "ray_reinforce.py",
+    "variational_autoencoder.py",
+    "fraud_detection.py",
+    "image_augmentation.py",
+    "image_similarity.py",
 ]
 
 

@@ -21,7 +21,9 @@ ALL = FAST + ["recommendation_ncf.py", "text_classification.py",
               "ray_parameter_server.py", "streaming_inference.py",
               "automl_forecast.py", "seq2seq_copy.py",
               "image_finetune.py", "text_matching_knrm.py",
-              "ray_reinforce.py"]
+              "ray_reinforce.py", "variational_autoencoder.py",
+              "fraud_detection.py", "image_augmentation.py",
+              "image_similarity.py"]
 
 
 def _run(name):
