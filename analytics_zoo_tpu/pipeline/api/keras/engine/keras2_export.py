@@ -45,30 +45,41 @@ def _emit_layer(layer, is_first: bool) -> str:
         return (f"keras.layers.Dense({layer.output_dim}, "
                 f"{_args(activation=_act_name(layer), use_bias=layer.bias, input_shape=input_shape, name=layer.name)})")
     if isinstance(layer, zl.Convolution2D):
+        dil = tuple(getattr(layer, "dilation", (1, 1)))
+        if dil != (1, 1) and tuple(layer.subsample) != (1, 1):
+            raise Keras2ExportError(
+                f"layer {layer.name!r}: tf.keras Conv2D rejects strides > 1 "
+                "combined with dilation_rate > 1; export via export_tf")
         return (f"keras.layers.Conv2D({layer.nb_filter}, "
                 f"{layer.kernel_size}, "
-                f"{_args(strides=tuple(layer.subsample), padding=layer.border_mode, activation=_act_name(layer), use_bias=layer.bias, data_format=_data_format(layer), input_shape=input_shape, name=layer.name)})")
+                f"{_args(strides=tuple(layer.subsample), padding=layer.border_mode, dilation_rate=dil if dil != (1, 1) else None, activation=_act_name(layer), use_bias=layer.bias, data_format=_data_format(layer), input_shape=input_shape, name=layer.name)})")
     if isinstance(layer, zl.Convolution1D):
+        dil = int(getattr(layer, "dilation", 1))
+        if dil != 1 and int(layer.subsample) != 1:
+            raise Keras2ExportError(
+                f"layer {layer.name!r}: tf.keras Conv1D rejects strides > 1 "
+                "combined with dilation_rate > 1; export via export_tf")
         return (f"keras.layers.Conv1D({layer.nb_filter}, "
                 f"{layer.filter_length}, "
-                f"{_args(strides=layer.subsample, padding=layer.border_mode, activation=_act_name(layer), use_bias=layer.bias, input_shape=input_shape, name=layer.name)})")
-    if isinstance(layer, zl.MaxPooling2D):
-        return (f"keras.layers.MaxPooling2D({tuple(layer.pool_size)}, "
-                f"{_args(strides=tuple(layer.strides) if layer.strides else None, data_format=_data_format(layer), input_shape=input_shape, name=layer.name)})")
+                f"{_args(strides=layer.subsample, padding=layer.border_mode, dilation_rate=dil if dil != 1 else None, activation=_act_name(layer), use_bias=layer.bias, input_shape=input_shape, name=layer.name)})")
+    # Average* subclasses of the Max* classes: check the subclass first
     if isinstance(layer, zl.AveragePooling2D):
         return (f"keras.layers.AveragePooling2D({tuple(layer.pool_size)}, "
-                f"{_args(strides=tuple(layer.strides) if layer.strides else None, data_format=_data_format(layer), input_shape=input_shape, name=layer.name)})")
-    if isinstance(layer, zl.GlobalMaxPooling2D):
-        return (f"keras.layers.GlobalMaxPooling2D("
-                f"{_args(data_format=_data_format(layer), input_shape=input_shape, name=layer.name)})")
+                f"{_args(strides=tuple(layer.strides) if layer.strides else None, padding=layer.border_mode, data_format=_data_format(layer), input_shape=input_shape, name=layer.name)})")
+    if isinstance(layer, zl.MaxPooling2D):
+        return (f"keras.layers.MaxPooling2D({tuple(layer.pool_size)}, "
+                f"{_args(strides=tuple(layer.strides) if layer.strides else None, padding=layer.border_mode, data_format=_data_format(layer), input_shape=input_shape, name=layer.name)})")
     if isinstance(layer, zl.GlobalAveragePooling2D):
         return (f"keras.layers.GlobalAveragePooling2D("
                 f"{_args(data_format=_data_format(layer), input_shape=input_shape, name=layer.name)})")
-    if isinstance(layer, zl.GlobalMaxPooling1D):
-        return (f"keras.layers.GlobalMaxPooling1D("
-                f"{_args(input_shape=input_shape, name=layer.name)})")
+    if isinstance(layer, zl.GlobalMaxPooling2D):
+        return (f"keras.layers.GlobalMaxPooling2D("
+                f"{_args(data_format=_data_format(layer), input_shape=input_shape, name=layer.name)})")
     if isinstance(layer, zl.GlobalAveragePooling1D):
         return (f"keras.layers.GlobalAveragePooling1D("
+                f"{_args(input_shape=input_shape, name=layer.name)})")
+    if isinstance(layer, zl.GlobalMaxPooling1D):
+        return (f"keras.layers.GlobalMaxPooling1D("
                 f"{_args(input_shape=input_shape, name=layer.name)})")
     if isinstance(layer, zl.Flatten):
         return (f"keras.layers.Flatten("
@@ -85,21 +96,34 @@ def _emit_layer(layer, is_first: bool) -> str:
                 f"{_args(input_shape=input_shape, name=layer.name)})")
     if isinstance(layer, zl.LSTM):
         return (f"keras.layers.LSTM({layer.output_dim}, "
-                f"{_args(activation='tanh', recurrent_activation='sigmoid', return_sequences=layer.return_sequences, input_shape=input_shape, name=layer.name)})")
+                f"{_args(activation=_fn_name(layer.activation) or 'linear', recurrent_activation=_fn_name(layer.inner_activation) or 'linear', return_sequences=layer.return_sequences, go_backwards=layer.go_backwards or None, input_shape=input_shape, name=layer.name)})")
     if isinstance(layer, zl.GRU):
         return (f"keras.layers.GRU({layer.output_dim}, "
-                f"{_args(activation='tanh', recurrent_activation='sigmoid', return_sequences=layer.return_sequences, reset_after=False, input_shape=input_shape, name=layer.name)})")
+                f"{_args(activation=_fn_name(layer.activation) or 'linear', recurrent_activation=_fn_name(layer.inner_activation) or 'linear', return_sequences=layer.return_sequences, go_backwards=layer.go_backwards or None, reset_after=False, input_shape=input_shape, name=layer.name)})")
     raise Keras2ExportError(
         f"layer {layer.name!r} ({kind}) has no Keras-2 emission rule; use "
         "export_tf (exact, via jax2tf) or export_onnx for this model")
 
 
-def _act_name(layer):
-    fn = getattr(layer, "activation", None)
+def _fn_name(fn):
+    """Name of an activation function object. NamedActivation stores the
+    registry string; raw jax fns fall back to ``__name__``. Emitting
+    ``None`` for an unknown callable would silently linearize the layer,
+    so unknown callables raise instead."""
     if fn is None:
         return None
-    # NamedActivation stores the string; fall back to __name__
-    return getattr(fn, "name", None) or getattr(fn, "__name__", None)
+    name = getattr(fn, "name", None) or getattr(fn, "__name__", None)
+    if name is None:
+        raise Keras2ExportError(
+            f"activation {fn!r} has no resolvable name for Keras-2 export")
+    return None if name == "linear" else name
+
+
+def _act_name(layer):
+    # Dense/Conv store the fn under .activation; the Activation layer
+    # under .fn
+    return _fn_name(getattr(layer, "activation", None) or
+                    getattr(layer, "fn", None))
 
 
 # tf.keras set_weights order per emitted layer type
@@ -123,7 +147,14 @@ def keras2_weights(model):
     out = []
     for layer in model.layers:
         p = params.get(layer.name, {})
-        for name in _WEIGHT_ORDER.get(type(layer).__name__, ()):
+        # walk the MRO so subclasses (AtrousConvolution2D -> Convolution2D)
+        # inherit their base's weight order
+        order = ()
+        for klass in type(layer).__mro__:
+            if klass.__name__ in _WEIGHT_ORDER:
+                order = _WEIGHT_ORDER[klass.__name__]
+                break
+        for name in order:
             if name in p:
                 out.append(np.asarray(p[name]))
     return out

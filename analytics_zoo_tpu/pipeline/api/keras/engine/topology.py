@@ -639,13 +639,3 @@ class Sequential(KerasNet):
         for layer in self.layers:
             shape = layer.compute_output_shape(shape)
         return shape
-
-    def to_model(self) -> Model:
-        """Topology.scala:914."""
-        in_shape = self._input_shape()
-        from .base import Input
-        inp = Input(shape=in_shape[1:], name=self.name + "_input")
-        x = inp
-        for layer in self.layers:
-            x = layer(x)
-        return Model(inp, x, name=self.name)

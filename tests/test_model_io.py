@@ -159,3 +159,63 @@ def test_save_keras2_rejects_unsupported():
     model.add(SReLU())
     with pytest.raises(Keras2ExportError, match="no Keras-2 emission"):
         model.save_keras2("/tmp/nope.py")
+
+
+def test_save_keras2_avg_pool_activation_and_padding(tmp_path):
+    """Regression (r3 review): AveragePooling2D must not emit as Max
+    (it subclasses MaxPooling2D), Activation layers must carry their
+    function name (stored under .fn, not .activation), and same-padded
+    pools must emit padding='same'."""
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        Activation, AveragePooling2D, Convolution2D, Flatten as ZFlatten)
+
+    model = Sequential()
+    model.add(Convolution2D(4, 3, 3, dim_ordering="tf",
+                            input_shape=(7, 7, 3)))
+    model.add(Activation("relu"))
+    model.add(AveragePooling2D((2, 2), border_mode="same",
+                               dim_ordering="tf"))
+    model.add(ZFlatten())
+    src = None
+    path = str(tmp_path / "m.py")
+    model.save_keras2(path)
+    with open(path) as f:
+        src = f.read()
+    assert "AveragePooling2D" in src
+    assert "MaxPooling2D" not in src
+    assert "Activation('relu'" in src or 'Activation("relu"' in src
+    assert "padding='same'" in src
+
+
+def test_save_keras2_lstm_real_activations(tmp_path):
+    """Regression (r3 review): LSTM/GRU emission must carry the zoo
+    defaults (hard_sigmoid gates), not hardcoded sigmoid/tanh."""
+    from analytics_zoo_tpu.pipeline.api.keras.layers import LSTM
+
+    model = Sequential()
+    model.add(LSTM(4, input_shape=(5, 3)))
+    path = str(tmp_path / "m.py")
+    model.save_keras2(path)
+    with open(path) as f:
+        src = f.read()
+    assert "recurrent_activation='hard_sigmoid'" in src
+    assert "activation='tanh'" in src
+
+
+def test_sequential_to_model_carries_weights():
+    """Regression (r3 review): a stale duplicate ``to_model`` shadowed
+    the weight-carrying version, so new_graph/to_model silently dropped
+    trained weights."""
+    model = Sequential()
+    model.add(Dense(8, activation="relu", input_shape=(4,)))
+    model.add(Dense(1))
+    model.compile(optimizer=Adam(lr=0.05), loss="mse")
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((64, 4)).astype(np.float32)
+    y = (x @ rng.standard_normal((4, 1))).astype(np.float32)
+    model.fit(x, y, batch_size=16, nb_epoch=5)
+    before = model.predict(x, batch_size=32)
+
+    as_model = model.to_model()
+    after = as_model.predict(x, batch_size=32)
+    np.testing.assert_allclose(before, after, rtol=1e-5, atol=1e-6)
