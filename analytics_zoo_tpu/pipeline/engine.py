@@ -34,7 +34,7 @@ from ..feature.feature_set import (ArrayFeatureSet, FeatureSet, MiniBatch,
                                    minibatch_len, pad_minibatch,
                                    PrefetchIterator)
 from ..utils import serialization
-from ..utils.profiling import ProfilerHook, device_sync, peak_flops
+from ..utils.profiling import ProfilerHook, peak_flops
 
 logger = logging.getLogger("analytics_zoo_tpu.engine")
 
@@ -131,6 +131,9 @@ class SPMDTrainer:
         self.opt_state = None
         self.step = 0
         self.epoch = 0
+        # summary-log cursor; lives on the trainer so short epochs still
+        # accumulate toward log_every_n_steps instead of resetting
+        self._last_log_step = 0
         self._train_step = None
         self._multi_steps: Dict[int, Callable] = {}   # scan length -> fn
         self._auto_k = None      # measured steps-per-dispatch decision
@@ -435,18 +438,26 @@ class SPMDTrainer:
         finally:
             it.close()
 
-    # how many steps one fused dispatch covers, and when auto mode fuses:
-    # if a measured steady-state step (incl. dispatch+RTT share) is faster
-    # than the threshold, per-step dispatch overhead dominates -> scan.
+    # how many steps one fused dispatch covers in auto mode. On accelerator
+    # backends fused dispatch always wins: every dispatch pays transfer /
+    # RTT overhead (measured ~80 ms tunnel RTT on axon, and pathological
+    # per-dispatch costs for non-donated programs — BENCH_NOTES.md), while
+    # the scan program is bit-identical to k single steps. On CPU (tests)
+    # dispatch is cheap and the scan's extra compile time dominates, so
+    # stay per-step.
     MULTI_STEP_K = 16
-    AUTO_MEASURE_STEPS = 8
-    AUTO_SCAN_THRESHOLD_S = 0.040
 
     def _steps_per_dispatch_target(self):
         cfg_k = self.ctx.config.steps_per_dispatch
         if cfg_k > 0:
             return cfg_k
-        return self._auto_k if self._auto_k is not None else 1
+        if self._auto_k is None:
+            platform = getattr(self.ctx.devices[0], "platform", "cpu")
+            self._auto_k = self.MULTI_STEP_K if platform != "cpu" else 1
+            if self._auto_k > 1:
+                logger.info("auto steps_per_dispatch: %s backend -> k=%d",
+                            platform, self._auto_k)
+        return self._auto_k
 
     def _epoch_loop(self, it, step_fn, record, batch_size, t0,
                     checkpoint_trigger, validation_set, validation_trigger,
@@ -457,13 +468,11 @@ class SPMDTrainer:
         infeed_wait = 0.0
         window_t0 = time.perf_counter()
         window_steps = 0
-        last_log_step = self.step
+        self._last_log_step = min(self._last_log_step, self.step)
         host_iter = iter(it)
         profiler = ProfilerHook(cfg.profile_dir, cfg.profile_start_step,
                                 cfg.profile_num_steps) \
             if cfg.profile_dir else None
-        measure_start = None
-        measure_steps = 0
 
         def fetch():
             nonlocal infeed_wait
@@ -518,27 +527,6 @@ class SPMDTrainer:
                     self.params, self.opt_state, self.net_state, batch,
                     self.step)
                 done = 1
-                # auto steps_per_dispatch: measure steady-state step wall
-                # time (first step absorbs compilation, so sync there and
-                # time the next AUTO_MEASURE_STEPS dispatches)
-                if cfg.steps_per_dispatch == 0 and self._auto_k is None:
-                    if measure_start is None:
-                        device_sync(logs["loss"])
-                        measure_start = time.perf_counter()
-                        measure_steps = 0
-                    else:
-                        measure_steps += 1
-                        if measure_steps >= self.AUTO_MEASURE_STEPS:
-                            device_sync(logs["loss"])
-                            per = ((time.perf_counter() - measure_start)
-                                   / measure_steps)
-                            self._auto_k = (self.MULTI_STEP_K
-                                            if per <
-                                            self.AUTO_SCAN_THRESHOLD_S
-                                            else 1)
-                            logger.info(
-                                "auto steps_per_dispatch: %.1f ms/step "
-                                "-> k=%d", per * 1e3, self._auto_k)
             self.step += done
             n_batches += done
             window_steps += done
@@ -547,8 +535,8 @@ class SPMDTrainer:
             last_loss = logs["loss"]
             if profiler is not None:
                 profiler.step(self.step)
-            if self.step - last_log_step >= log_every:
-                last_log_step = self.step
+            if self.step - self._last_log_step >= log_every:
+                self._last_log_step = self.step
                 loss_v = float(np.asarray(last_loss))
                 record.loss = loss_v
                 lr = float(self.lr_schedule(self.step))
