@@ -73,6 +73,14 @@ class ZooConfig:
                         val = int(env)
                     elif f.type in ("float", float):
                         val = float(env)
+                    elif f.type in ("bool", bool):
+                        low = env.strip().lower()
+                        if low in ("1", "true", "yes", "on"):
+                            val = True
+                        elif low in ("0", "false", "no", "off"):
+                            val = False
+                        else:
+                            raise ValueError(f"not a boolean: {env!r}")
                     else:
                         val = env
                 except ValueError as e:

@@ -203,6 +203,8 @@ def _kernel_available() -> bool:
     on any probe failure we log and permanently fall back to the XLA
     reference path for the process."""
     global _KERNEL_OK
+    if os.environ.get("ZOO_TPU_DISABLE_PALLAS", "0") == "1":
+        return False
     if _interpret_mode():
         return True
     if _KERNEL_OK is None:

@@ -586,7 +586,10 @@ class Sequential(KerasNet):
                   name=self.name + "_model")
         if getattr(self, "_built_params", None) is not None or \
                 self.trainer is not None:
-            m._built_params = self._params_tuple()
+            # host-materialize: the live device arrays are donated into the
+            # source model's next train step (deleted), which would leave
+            # the derived model aliasing dead buffers
+            m._built_params = jax.tree.map(np.asarray, self._params_tuple())
         m.optimizer, m.loss, m.metrics = (self.optimizer, self.loss,
                                           self.metrics)
         return m

@@ -302,8 +302,14 @@ class SPMDTrainer:
             return params, opt_state, net_state, {"loss": losses[-1]}
 
         # donate the carried state: amortized over k steps, and the caller
-        # always rebinds self.params/... to the returned arrays
-        self._multi_steps[k] = jax.jit(multi_fn, donate_argnums=(0, 1, 2))
+        # always rebinds self.params/... to the returned arrays. Honors
+        # donate_buffers=False for callers that must keep param aliases
+        # alive across steps.
+        if self.ctx.config.donate_buffers:
+            self._multi_steps[k] = jax.jit(multi_fn,
+                                           donate_argnums=(0, 1, 2))
+        else:
+            self._multi_steps[k] = jax.jit(multi_fn)
         return self._multi_steps[k]
 
     def build_eval_step(self):
