@@ -196,33 +196,73 @@ class SPMDTrainer:
             return
         rng = jax.random.PRNGKey(self.seed)
         params, state = self.init_fn(rng)
-        repl = self.ctx.replicated_sharding()
+        self._place_state(params, state)
+        self.opt_state = self._place_opt_state(self.tx.init(self.params))
+
+    # Explicit placement is load-bearing, not hygiene: every input of the
+    # compiled step must carry the mesh NamedSharding. One leaf left on a
+    # jit-default/single-device sharding — even a scalar schedule count —
+    # makes EVERY dispatch of the program implicitly reshard, measured at
+    # ~100x per-dispatch cost on the tunneled axon backend
+    # (BENCH_NOTES.md). The host round-trip (np.asarray -> device_put)
+    # also gives canonical layouts that alias cleanly under donation;
+    # non-fully-addressable (multi-host) arrays are left in place — they
+    # are already mesh-placed and cannot be gathered to one host.
+    @staticmethod
+    def _to_host(leaf):
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            return leaf
+        return np.asarray(leaf)
+
+    def _param_shardings(self, params):
         if self.param_sharding_fn is not None:
-            shardings = self.param_sharding_fn(params)
-        else:
-            shardings = jax.tree.map(lambda _: repl, params)
-        self._validate_parallel_config(shardings)
-        self.params = jax.device_put(params, shardings)
-        self.net_state = jax.device_put(state, jax.tree.map(lambda _: repl,
-                                                            state))
-        self.opt_state = jax.jit(
-            self.tx.init,
-            out_shardings=None)(self.params)
+            return self.param_sharding_fn(params)
+        repl = self.ctx.replicated_sharding()
+        return jax.tree.map(lambda _: repl, params)
+
+    def _place_state(self, params, state, validate=True):
+        params = jax.tree.map(self._to_host, params)
+        shardings = self._param_shardings(params)
+        if validate:
+            self._validate_parallel_config(shardings)
+        repl = self.ctx.replicated_sharding()
+        place = lambda leaf, sh: leaf if isinstance(leaf, jax.Array) and \
+            not leaf.is_fully_addressable else jax.device_put(leaf, sh)
+        self.params = jax.tree.map(place, params, shardings)
+        if state is not None:
+            self.net_state = jax.tree.map(
+                lambda leaf: place(self._to_host(leaf), repl), state)
+
+    def _place_opt_state(self, opt_state):
+        """Place optimizer state: leaves that mirror a parameter (adam
+        mu/nu, momentum traces — their tree paths END with the param's
+        path) take that parameter's sharding so model-parallel layouts
+        keep sharded optimizer memory; everything else (counts, scalars)
+        replicates."""
+        shardings = self._param_shardings(self.params)
+        by_path = {path: sh for path, sh in
+                   jax.tree_util.tree_flatten_with_path(shardings)[0]}
+        repl = self.ctx.replicated_sharding()
+
+        def sh_for(path):
+            for start in range(len(path)):
+                if tuple(path[start:]) in by_path:
+                    return by_path[tuple(path[start:])]
+            return repl
+
+        flat, treedef = jax.tree_util.tree_flatten_with_path(opt_state)
+        placed = [leaf if isinstance(leaf, jax.Array) and
+                  not leaf.is_fully_addressable else
+                  jax.device_put(np.asarray(leaf), sh_for(tuple(path)))
+                  for path, leaf in flat]
+        return jax.tree_util.tree_unflatten(treedef, placed)
 
     def set_params(self, params, state=None):
         self.ensure_initialized() if self.params is None and params is None \
             else None
-        repl = self.ctx.replicated_sharding()
-        if self.param_sharding_fn is not None:
-            shardings = self.param_sharding_fn(params)
-        else:
-            shardings = jax.tree.map(lambda _: repl, params)
-        self.params = jax.device_put(params, shardings)
-        if state is not None:
-            self.net_state = jax.device_put(
-                state, jax.tree.map(lambda _: repl, state))
+        self._place_state(params, state, validate=False)
         if self.opt_state is None:
-            self.opt_state = self.tx.init(self.params)
+            self.opt_state = self._place_opt_state(self.tx.init(self.params))
 
     # ------------------------------------------------------------------
     # compiled steps
@@ -709,7 +749,8 @@ class SPMDTrainer:
         opt_path = os.path.join(directory, "optim.npz")
         if os.path.exists(opt_path):
             template = self.tx.init(self.params)
-            self.opt_state = serialization.load_leaves(opt_path, template)
+            self.opt_state = self._place_opt_state(
+                serialization.load_leaves(opt_path, template))
         meta = serialization.load_pytree(os.path.join(directory, "meta.npz"))
         self.step = int(meta["step"])
         self.epoch = int(meta["epoch"])

@@ -280,7 +280,7 @@ def _bench_bert_mfu_at(peak_flops, bert_batch):
 RESNET_FWD_FLOPS_PER_IMAGE = 2 * 4.09e9   # 4.09 GMACs @ 224x224 (public)
 
 
-def bench_resnet_mfu(peak_flops, batch_candidates=(64, 32)):
+def bench_resnet_mfu(peak_flops, batch_candidates=(128, 64, 32)):
     from analytics_zoo_tpu.utils.profiling import device_sync  # noqa: F401
 
     last_err = None

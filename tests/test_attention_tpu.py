@@ -19,6 +19,8 @@ _PROBE = ("import jax; d = jax.devices()[0]; "
           "print('PLATFORM=' + d.platform)")
 
 _PARITY = r"""
+import os
+os.environ["ZOO_TPU_FORCE_PALLAS"] = "1"   # L=512 < KERNEL_MIN_SEQ routing
 import numpy as np, jax, jax.numpy as jnp
 from analytics_zoo_tpu.ops.attention import (flash_attention,
                                              attention_reference,

@@ -51,6 +51,10 @@ def test_flash_attention_kernel_interpret_parity(monkeypatch):
     """Run the actual Pallas kernel body (interpreter mode) against the
     reference, fwd + bwd, with the BERT-style key-padding bias."""
     monkeypatch.setenv("ZOO_TPU_PALLAS_INTERPRET", "1")
+    # the wrapper routes short sequences to the XLA path by default
+    # (KERNEL_MIN_SEQ); force the kernel so this parity test actually
+    # exercises the Pallas body
+    monkeypatch.setenv("ZOO_TPU_FORCE_PALLAS", "1")
     q, k, v = _qkv(b=1, h=2, l=256, d=64, seed=4)
     bias = jnp.zeros((1, 1, 1, 256)).at[:, :, :, 200:].set(-10000.0)
 
@@ -78,6 +82,8 @@ def test_flash_attention_kernel_interpret_parity(monkeypatch):
 def test_flash_attention_kernel_tpu_parity():
     """Hardware proof: the compiled kernel matches reference fwd+bwd at
     bf16-realistic shapes (VERDICT r1 item 2)."""
+    import os
+    os.environ["ZOO_TPU_FORCE_PALLAS"] = "1"   # below KERNEL_MIN_SEQ
     rng = np.random.default_rng(5)
     b, h, l, d = 2, 8, 512, 64
     mk = lambda: jnp.asarray(
