@@ -18,6 +18,22 @@ class Keras2ExportError(Exception):
     pass
 
 
+class _Raw(str):
+    """Identifier emitted verbatim (not repr-quoted) into the source."""
+
+    def __repr__(self):
+        return str(self)
+
+
+def _maybe_k1_act(name):
+    """Modern keras redefined hard_sigmoid as relu6(x+3)/6; the zoo keeps
+    the Keras-1 clip(0.2x+0.5, 0, 1). Route to the parity helper emitted
+    in the generated file's preamble."""
+    if name == "hard_sigmoid":
+        return _Raw("hard_sigmoid_k1")
+    return name
+
+
 def _args(**kw) -> str:
     parts = []
     for k, v in kw.items():
@@ -43,7 +59,7 @@ def _emit_layer(layer, is_first: bool) -> str:
 
     if isinstance(layer, zl.Dense):
         return (f"keras.layers.Dense({layer.output_dim}, "
-                f"{_args(activation=_act_name(layer), use_bias=layer.bias, input_shape=input_shape, name=layer.name)})")
+                f"{_args(activation=_maybe_k1_act(_act_name(layer)), use_bias=layer.bias, input_shape=input_shape, name=layer.name)})")
     if isinstance(layer, zl.Convolution2D):
         dil = tuple(getattr(layer, "dilation", (1, 1)))
         if dil != (1, 1) and tuple(layer.subsample) != (1, 1):
@@ -52,7 +68,7 @@ def _emit_layer(layer, is_first: bool) -> str:
                 "combined with dilation_rate > 1; export via export_tf")
         return (f"keras.layers.Conv2D({layer.nb_filter}, "
                 f"{layer.kernel_size}, "
-                f"{_args(strides=tuple(layer.subsample), padding=layer.border_mode, dilation_rate=dil if dil != (1, 1) else None, activation=_act_name(layer), use_bias=layer.bias, data_format=_data_format(layer), input_shape=input_shape, name=layer.name)})")
+                f"{_args(strides=tuple(layer.subsample), padding=layer.border_mode, dilation_rate=dil if dil != (1, 1) else None, activation=_maybe_k1_act(_act_name(layer)), use_bias=layer.bias, data_format=_data_format(layer), input_shape=input_shape, name=layer.name)})")
     if isinstance(layer, zl.Convolution1D):
         dil = int(getattr(layer, "dilation", 1))
         if dil != 1 and int(layer.subsample) != 1:
@@ -61,7 +77,7 @@ def _emit_layer(layer, is_first: bool) -> str:
                 "combined with dilation_rate > 1; export via export_tf")
         return (f"keras.layers.Conv1D({layer.nb_filter}, "
                 f"{layer.filter_length}, "
-                f"{_args(strides=layer.subsample, padding=layer.border_mode, dilation_rate=dil if dil != 1 else None, activation=_act_name(layer), use_bias=layer.bias, input_shape=input_shape, name=layer.name)})")
+                f"{_args(strides=layer.subsample, padding=layer.border_mode, dilation_rate=dil if dil != 1 else None, activation=_maybe_k1_act(_act_name(layer)), use_bias=layer.bias, input_shape=input_shape, name=layer.name)})")
     # Average* subclasses of the Max* classes: check the subclass first
     if isinstance(layer, zl.AveragePooling2D):
         return (f"keras.layers.AveragePooling2D({tuple(layer.pool_size)}, "
@@ -88,7 +104,7 @@ def _emit_layer(layer, is_first: bool) -> str:
         return (f"keras.layers.Dropout({layer.p}, "
                 f"{_args(input_shape=input_shape, name=layer.name)})")
     if isinstance(layer, zl.Activation):
-        return (f"keras.layers.Activation({_act_name(layer)!r}, "
+        return (f"keras.layers.Activation({_maybe_k1_act(_act_name(layer))!r}, "
                 f"{_args(input_shape=input_shape, name=layer.name)})")
     if isinstance(layer, zl.Embedding):
         return (f"keras.layers.Embedding({layer.input_dim}, "
@@ -96,10 +112,10 @@ def _emit_layer(layer, is_first: bool) -> str:
                 f"{_args(input_shape=input_shape, name=layer.name)})")
     if isinstance(layer, zl.LSTM):
         return (f"keras.layers.LSTM({layer.output_dim}, "
-                f"{_args(activation=_fn_name(layer.activation) or 'linear', recurrent_activation=_fn_name(layer.inner_activation) or 'linear', return_sequences=layer.return_sequences, go_backwards=layer.go_backwards or None, input_shape=input_shape, name=layer.name)})")
+                f"{_args(activation=_maybe_k1_act(_fn_name(layer.activation) or 'linear'), recurrent_activation=_maybe_k1_act(_fn_name(layer.inner_activation) or 'linear'), return_sequences=layer.return_sequences, go_backwards=layer.go_backwards or None, input_shape=input_shape, name=layer.name)})")
     if isinstance(layer, zl.GRU):
         return (f"keras.layers.GRU({layer.output_dim}, "
-                f"{_args(activation=_fn_name(layer.activation) or 'linear', recurrent_activation=_fn_name(layer.inner_activation) or 'linear', return_sequences=layer.return_sequences, go_backwards=layer.go_backwards or None, reset_after=False, input_shape=input_shape, name=layer.name)})")
+                f"{_args(activation=_maybe_k1_act(_fn_name(layer.activation) or 'linear'), recurrent_activation=_maybe_k1_act(_fn_name(layer.inner_activation) or 'linear'), return_sequences=layer.return_sequences, go_backwards=layer.go_backwards or None, reset_after=False, input_shape=input_shape, name=layer.name)})")
     raise Keras2ExportError(
         f"layer {layer.name!r} ({kind}) has no Keras-2 emission rule; use "
         "export_tf (exact, via jax2tf) or export_onnx for this model")
@@ -168,6 +184,8 @@ def sequential_to_keras2_source(model) -> str:
         raise Keras2ExportError(
             "saveToKeras2 emits Sequential stacks; functional graphs "
             "export via export_tf/export_onnx")
+    body = [f"    model.add({_emit_layer(layer, i == 0)})"
+            for i, layer in enumerate(model.layers)]
     lines: List[str] = [
         '"""Keras-2 definition generated by analytics_zoo_tpu '
         "saveToKeras2.",
@@ -180,12 +198,30 @@ def sequential_to_keras2_source(model) -> str:
         "    tf_model.set_weights(keras2_export.keras2_weights(zoo_model))",
         '"""',
         "from tensorflow import keras",
+    ]
+    if any("hard_sigmoid_k1" in line for line in body):
+        # registered so a built model survives save()/load_model()
+        lines += [
+            "import tensorflow as tf",
+            "",
+            "try:",
+            "    _register = keras.saving.register_keras_serializable",
+            "except AttributeError:      # tf.keras 2.x",
+            "    _register = keras.utils.register_keras_serializable",
+            "",
+            "",
+            "@_register(package='analytics_zoo_tpu')",
+            "def hard_sigmoid_k1(x):",
+            "    # Keras-1/BigDL hard_sigmoid (the zoo parity definition);",
+            "    # modern keras redefined hard_sigmoid as relu6(x+3)/6",
+            "    return tf.clip_by_value(0.2 * x + 0.5, 0.0, 1.0)",
+        ]
+    lines += [
         "",
         "",
         "def build_model():",
         f"    model = keras.Sequential(name={model.name!r})",
     ]
-    for i, layer in enumerate(model.layers):
-        lines.append(f"    model.add({_emit_layer(layer, i == 0)})")
+    lines += body
     lines += ["    return model", ""]
     return "\n".join(lines)
