@@ -185,11 +185,23 @@ class InferenceModel:
             self._permits.put(object())
         self._granted = n
 
+    @staticmethod
+    def _resolve_model_dir(model_path: str) -> str:
+        """Zoo-model wrapper dirs (``ZooModel.save_model``: zoo_model.pkl
+        meta + ``keras/`` subdir) resolve to their inner KerasNet save."""
+        if os.path.exists(os.path.join(model_path, "zoo_model.pkl")):
+            return os.path.join(model_path, "keras")
+        return model_path
+
     def load(self, model_path: str, weight_path: Optional[str] = None):
-        """Load a native zoo model directory (doLoad parity: BigDL path)."""
+        """Load a native zoo model directory (doLoad parity: BigDL path).
+
+        Accepts either a raw KerasNet save or a zoo-model wrapper
+        directory."""
         from ..api.keras.models import KerasNet
 
-        self._install(FloatModel(KerasNet.load_model(model_path)))
+        self._install(FloatModel(
+            KerasNet.load_model(self._resolve_model_dir(model_path))))
         return self
 
     load_bigdl = load
@@ -251,7 +263,8 @@ class InferenceModel:
         stand-in for doLoadOpenVINO int8 IRs."""
         from ..api.keras.models import KerasNet
 
-        self._install(QuantizedModel(KerasNet.load_model(model_path)))
+        self._install(QuantizedModel(
+            KerasNet.load_model(self._resolve_model_dir(model_path))))
         return self
 
     do_load_openvino = load_quantized

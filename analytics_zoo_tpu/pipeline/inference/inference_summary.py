@@ -8,6 +8,7 @@ in ``utils.tensorboard``.
 from __future__ import annotations
 
 import os
+import threading
 import time
 
 from ...utils import tensorboard
@@ -18,18 +19,25 @@ class InferenceSummary:
         self.writer = tensorboard.FileWriter(
             os.path.join(log_dir, app_name, "inference"))
         self._step = 0
+        self._lock = threading.Lock()
+
+    def _next_step(self) -> int:
+        # serving predicts run concurrently (permits > 1); the step
+        # counter must not interleave
+        with self._lock:
+            self._step += 1
+            return self._step
 
     def add_scalar(self, tag: str, value: float, step: int = None):
         if step is None:
-            self._step += 1
-            step = self._step
+            step = self._next_step()
         self.writer.add_scalar(tag, value, step)
 
     def record_batch(self, batch_size: int, latency_s: float):
-        self._step += 1
+        step = self._next_step()
         self.writer.add_scalar("Throughput",
-                               batch_size / max(latency_s, 1e-9), self._step)
-        self.writer.add_scalar("LatencyMs", latency_s * 1e3, self._step)
+                               batch_size / max(latency_s, 1e-9), step)
+        self.writer.add_scalar("LatencyMs", latency_s * 1e3, step)
 
     def close(self):
         self.writer.close()

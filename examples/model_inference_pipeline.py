@@ -1,0 +1,106 @@
+"""Model-inference pipeline: trained zoo models behind InferenceModel.
+
+Reference app: ``apps/model-inference-examples`` — the library-style
+sub-apps (``recommendation-inference``, ``text-classification-inference``)
+load trained zoo artifacts into ``InferenceModel`` and serve concurrent
+requests; the Flink streaming variant is ``streaming_inference.py``. Same
+pipeline here, end to end offline: train NCF + TextClassifier briefly,
+save the artifacts, reload them through ``InferenceModel`` (permit-guarded
+AOT path), serve a multi-threaded burst, and record per-batch latency via
+``InferenceSummary``.
+"""
+
+import os
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+from common import example_args, movielens_like, news_like
+
+from analytics_zoo_tpu.models.recommendation import NeuralCF
+from analytics_zoo_tpu.models.textclassification import TextClassifier
+from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+from analytics_zoo_tpu.pipeline.inference.inference_model import \
+    InferenceModel
+from analytics_zoo_tpu.pipeline.inference.inference_summary import \
+    InferenceSummary
+from analytics_zoo_tpu.utils.tensorboard import read_scalars
+
+VOCAB, SEQ_LEN, TEXT_CLASSES = 200, 32, 3
+
+
+def train_artifacts(args, workdir):
+    """The 'training' half of the reference app pair."""
+    x, y, n_users, n_items = movielens_like(args.samples, seed=args.seed)
+    ncf = NeuralCF(n_users, n_items, 5, hidden_layers=(16, 8),
+                   mf_embed=8)
+    ncf.compile(optimizer=Adam(lr=2e-3),
+                loss="sparse_categorical_crossentropy")
+    ncf.fit(x, y, batch_size=args.batch_size, nb_epoch=args.epochs)
+    ncf_path = os.path.join(workdir, "ncf.zoo")
+    ncf.save_model(ncf_path)
+
+    docs, labels = news_like(args.samples, vocab=VOCAB, seq_len=SEQ_LEN,
+                             n_classes=TEXT_CLASSES, seed=args.seed)
+    emb = np.random.default_rng(args.seed).standard_normal(
+        (VOCAB, 16)).astype(np.float32)
+    clf = TextClassifier(TEXT_CLASSES, emb, sequence_length=SEQ_LEN,
+                         encoder="cnn", encoder_output_dim=16)
+    clf.compile(optimizer=Adam(lr=2e-3),
+                loss="sparse_categorical_crossentropy")
+    clf.fit(docs, labels, batch_size=args.batch_size, nb_epoch=args.epochs)
+    text_path = os.path.join(workdir, "text.zoo")
+    clf.save_model(text_path)
+    return ncf_path, text_path, x, docs, labels
+
+
+def main():
+    args = example_args("model-inference pipeline (InferenceModel apps)",
+                        epochs=4, samples=2048, batch_size=128)
+    with tempfile.TemporaryDirectory() as workdir:
+        run(args, workdir)
+
+
+def run(args, workdir):
+    ncf_path, text_path, ncf_x, docs, labels = train_artifacts(args, workdir)
+
+    # --- recommendation-inference: load artifact, concurrent predicts ---
+    rec = InferenceModel(supported_concurrent_num=4)
+    rec.load(ncf_path)
+    summary = InferenceSummary(workdir, "rec_app")
+
+    results = {}
+    def worker(tid, batch):
+        t0 = time.perf_counter()
+        out = rec.predict(batch)
+        summary.add_scalar("LatencyMs",
+                           (time.perf_counter() - t0) * 1e3)
+        results[tid] = out
+
+    threads = [threading.Thread(target=worker,
+                                args=(t, ncf_x[t * 64:(t + 1) * 64]))
+               for t in range(4)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    assert all(results[t].shape == (64, 5) for t in range(4))
+    summary.close()
+    scalars = read_scalars(os.path.join(workdir, "rec_app", "inference"))
+    assert len(scalars) == 4, scalars
+    print(f"recommendation-inference: 4 concurrent batches, "
+          f"mean latency {np.mean([v for *_, v in scalars]):.1f} ms")
+
+    # --- text-classification-inference ---
+    txt = InferenceModel(supported_concurrent_num=2)
+    txt.load(text_path)
+    probs = txt.predict(docs[:256])
+    acc = float(np.mean(np.argmax(probs, axis=1) == labels[:256]))
+    print(f"text-classification-inference: acc {acc:.2f} "
+          f"(chance {1 / TEXT_CLASSES:.2f})")
+    assert acc > 1.5 / TEXT_CLASSES, acc
+    print("Model-inference pipeline example OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -30,6 +30,7 @@ EXAMPLES = [
     "fraud_detection.py",
     "image_augmentation.py",
     "image_similarity.py",
+    "model_inference_pipeline.py",
 ]
 
 

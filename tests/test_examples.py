@@ -23,7 +23,8 @@ ALL = FAST + ["recommendation_ncf.py", "text_classification.py",
               "image_finetune.py", "text_matching_knrm.py",
               "ray_reinforce.py", "variational_autoencoder.py",
               "fraud_detection.py", "image_augmentation.py",
-              "image_similarity.py"]
+              "image_similarity.py",
+              "model_inference_pipeline.py"]
 
 
 def _run(name):

@@ -126,3 +126,32 @@ layer { name: "sm" type: "Softmax" bottom: "c" top: "sm" }
     out = np.asarray(model.predict(x))
     assert out.shape == (2, 2, 4, 4)
     np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_inference_model_load_zoo_wrapper_dir(tmp_path):
+    """InferenceModel.load / load_quantized accept a ZooModel.save_model
+    wrapper directory (zoo_model.pkl + keras/) and resolve to the inner
+    KerasNet save (r3 review: previously only the raw save loaded)."""
+    import numpy as np
+
+    from analytics_zoo_tpu.models.recommendation import NeuralCF
+
+    rng = np.random.default_rng(0)
+    x = np.stack([rng.integers(1, 20, 64),
+                  rng.integers(1, 10, 64)], axis=1).astype(np.float32)
+    y = rng.integers(0, 5, 64).astype(np.int32)
+    ncf = NeuralCF(20, 10, 5, hidden_layers=(8,), mf_embed=4)
+    ncf.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    ncf.fit(x, y, batch_size=32, nb_epoch=1)
+    path = str(tmp_path / "ncf.zoo")
+    ncf.save_model(path)
+
+    inf = InferenceModel()
+    inf.load(path)
+    out = inf.predict(x[:8])
+    ref = ncf.predict(x[:8], batch_size=8)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    q = InferenceModel()
+    q.load_quantized(path)           # wrapper resolution on the int8 path
+    assert q.predict(x[:8]).shape == (8, 5)
