@@ -57,6 +57,16 @@ def _emit_layer(layer, is_first: bool) -> str:
     if is_first and layer.input_shape is not None:
         input_shape = tuple(layer.input_shape[1:])
 
+    if getattr(layer, "go_backwards", False) and \
+            getattr(layer, "return_sequences", False):
+        # the zoo re-flips backward outputs to original time order
+        # (recurrent.py _scan); tf.keras returns them reversed — the
+        # combination is not representable without an extra reverse layer
+        raise Keras2ExportError(
+            f"layer {layer.name!r}: go_backwards with return_sequences "
+            "has different output ordering in tf.keras; export via "
+            "export_tf")
+
     if isinstance(layer, zl.Dense):
         return (f"keras.layers.Dense({layer.output_dim}, "
                 f"{_args(activation=_maybe_k1_act(_act_name(layer)), use_bias=layer.bias, input_shape=input_shape, name=layer.name)})")
@@ -97,6 +107,27 @@ def _emit_layer(layer, is_first: bool) -> str:
     if isinstance(layer, zl.GlobalMaxPooling1D):
         return (f"keras.layers.GlobalMaxPooling1D("
                 f"{_args(input_shape=input_shape, name=layer.name)})")
+    if isinstance(layer, zl.AveragePooling1D):
+        return (f"keras.layers.AveragePooling1D({layer.pool_length}, "
+                f"{_args(strides=layer.stride, padding=layer.border_mode, input_shape=input_shape, name=layer.name)})")
+    if isinstance(layer, zl.MaxPooling1D):
+        return (f"keras.layers.MaxPooling1D({layer.pool_length}, "
+                f"{_args(strides=layer.stride, padding=layer.border_mode, input_shape=input_shape, name=layer.name)})")
+    if isinstance(layer, zl.BatchNormalization):
+        return (f"keras.layers.BatchNormalization("
+                f"{_args(axis=layer.axis, momentum=layer.momentum, epsilon=layer.epsilon, input_shape=input_shape, name=layer.name)})")
+    if isinstance(layer, zl.ZeroPadding2D):
+        return (f"keras.layers.ZeroPadding2D({tuple(tuple(p) for p in layer.padding)}, "
+                f"{_args(data_format=_data_format(layer), input_shape=input_shape, name=layer.name)})")
+    if isinstance(layer, zl.Reshape):
+        return (f"keras.layers.Reshape({tuple(layer.target_shape)}, "
+                f"{_args(input_shape=input_shape, name=layer.name)})")
+    if isinstance(layer, zl.RepeatVector):
+        return (f"keras.layers.RepeatVector({layer.n}, "
+                f"{_args(input_shape=input_shape, name=layer.name)})")
+    if isinstance(layer, zl.SimpleRNN):
+        return (f"keras.layers.SimpleRNN({layer.output_dim}, "
+                f"{_args(activation=_maybe_k1_act(_fn_name(layer.activation) or 'linear'), return_sequences=layer.return_sequences, go_backwards=layer.go_backwards or None, input_shape=input_shape, name=layer.name)})")
     if isinstance(layer, zl.Flatten):
         return (f"keras.layers.Flatten("
                 f"{_args(input_shape=input_shape, name=layer.name)})")
@@ -142,7 +173,8 @@ def _act_name(layer):
                     getattr(layer, "fn", None))
 
 
-# tf.keras set_weights order per emitted layer type
+# tf.keras set_weights order per emitted layer type; "state:" prefixed
+# names read from the layer's non-trainable state tree (BN moving stats)
 _WEIGHT_ORDER = {
     "Dense": ("kernel", "bias"),
     "Convolution2D": ("kernel", "bias"),
@@ -150,6 +182,9 @@ _WEIGHT_ORDER = {
     "Embedding": ("table",),
     "LSTM": ("W", "U", "b"),
     "GRU": ("W", "U", "b"),
+    "SimpleRNN": ("W", "U", "b"),
+    "BatchNormalization": ("gamma", "beta", "state:moving_mean",
+                           "state:moving_var"),
 }
 
 
@@ -159,10 +194,12 @@ def keras2_weights(model):
     bias before kernel)."""
     import numpy as np
 
-    params, _ = model._params_tuple()
+    params, state = model._params_tuple()
+    state = state or {}
     out = []
     for layer in model.layers:
         p = params.get(layer.name, {})
+        s = state.get(layer.name, {})
         # walk the MRO so subclasses (AtrousConvolution2D -> Convolution2D)
         # inherit their base's weight order
         order = ()
@@ -171,7 +208,11 @@ def keras2_weights(model):
                 order = _WEIGHT_ORDER[klass.__name__]
                 break
         for name in order:
-            if name in p:
+            if name.startswith("state:"):
+                name = name[len("state:"):]
+                if name in s:
+                    out.append(np.asarray(s[name]))
+            elif name in p:
                 out.append(np.asarray(p[name]))
     return out
 

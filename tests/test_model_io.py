@@ -253,3 +253,41 @@ def test_save_keras2_lstm_numeric_roundtrip(tmp_path):
     tf_model.set_weights(keras2_weights(model))
     tf_out = tf_model(x).numpy()
     np.testing.assert_allclose(zoo_out, tf_out, rtol=1e-4, atol=1e-4)
+
+
+def test_save_keras2_bn_simplernn_numeric_roundtrip(tmp_path):
+    """BN (gamma/beta + moving stats from the state tree) and SimpleRNN
+    transplant numerically into the generated Keras-2 model."""
+    tf = pytest.importorskip("tensorflow")
+
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        BatchNormalization, Convolution2D, Reshape as ZReshape, SimpleRNN)
+
+    model = Sequential()
+    model.add(Convolution2D(4, 3, 3, dim_ordering="tf",
+                            input_shape=(6, 6, 2)))
+    model.add(BatchNormalization(axis=-1))
+    model.add(ZReshape((16, 4)))
+    model.add(SimpleRNN(5))
+    model.add(Dense(2))
+    model.compile(optimizer=Adam(lr=0.01),
+                  loss="mse")
+    x = np.random.default_rng(9).standard_normal((4, 6, 6, 2)) \
+        .astype(np.float32)
+    y = np.random.default_rng(10).standard_normal((4, 2)).astype(np.float32)
+    model.fit(x, y, batch_size=4, nb_epoch=2)   # move BN stats off init
+    zoo_out = model.predict(x, batch_size=4)
+
+    path = str(tmp_path / "m.py")
+    model.save_keras2(path)
+    scope = {}
+    with open(path) as f:
+        exec(compile(f.read(), path, "exec"), scope)
+    from analytics_zoo_tpu.pipeline.api.keras.engine.keras2_export import \
+        keras2_weights
+
+    tf_model = scope["build_model"]()
+    tf_model(x)
+    tf_model.set_weights(keras2_weights(model))
+    tf_out = tf_model(x, training=False).numpy()
+    np.testing.assert_allclose(zoo_out, tf_out, rtol=1e-3, atol=1e-4)
