@@ -243,3 +243,33 @@ def test_frozen_params_do_not_drift_under_adam():
     model.fit(x, y, batch_size=32, nb_epoch=3)
     after = np.asarray(trainer.params["frozen_dense"]["kernel"])
     np.testing.assert_array_equal(before, after)
+
+
+def test_zooconfig_env_overrides(monkeypatch):
+    """ZOO_TPU_* env parsing: ints, floats, and (r3 review) bools — the
+    donation off-switch must not become a truthy string."""
+    from analytics_zoo_tpu.common.nncontext import ZooConfig
+
+    monkeypatch.setenv("ZOO_TPU_DONATE_BUFFERS", "0")
+    monkeypatch.setenv("ZOO_TPU_STEPS_PER_DISPATCH", "4")
+    monkeypatch.setenv("ZOO_TPU_FAILURE_RETRY_TIMES", "2")
+    cfg = ZooConfig.from_env()
+    assert cfg.donate_buffers is False
+    assert cfg.steps_per_dispatch == 4
+    assert cfg.failure_retry_times == 2
+    monkeypatch.setenv("ZOO_TPU_DONATE_BUFFERS", "true")
+    assert ZooConfig.from_env().donate_buffers is True
+    monkeypatch.setenv("ZOO_TPU_DONATE_BUFFERS", "maybe")
+    with pytest.raises(ValueError, match="DONATE_BUFFERS"):
+        ZooConfig.from_env()
+
+
+def test_auto_steps_per_dispatch_stays_per_step_on_cpu():
+    """Auto fusion is an accelerator-dispatch amortization; on the CPU
+    backend (tests) it must stay per-step so scan compiles don't slow
+    the suite."""
+    model = Sequential()
+    model.add(Dense(4, input_shape=(8,)))
+    model.compile(optimizer="sgd", loss="mse")
+    trainer = model._ensure_trainer()
+    assert trainer._steps_per_dispatch_target() == 1
