@@ -238,3 +238,31 @@ def test_opt_state_inherits_param_shardings():
         assert matched >= 2, "adam mu/nu of sharded params not matched"
     finally:
         set_nncontext(None)
+
+
+def test_flash_attention_seq_routing(monkeypatch):
+    """Routing policy (r3): below KERNEL_MIN_SEQ the wrapper must take the
+    XLA reference path even when the kernel is available; at/above it the
+    kernel runs. Verified by counting kernel invocations in interpret
+    mode."""
+    from analytics_zoo_tpu.ops import attention as A
+
+    monkeypatch.setenv("ZOO_TPU_PALLAS_INTERPRET", "1")
+    calls = []
+    real = A._flash_attention_bhld
+
+    def spy(*args, **kw):
+        calls.append(1)
+        return real(*args, **kw)
+
+    monkeypatch.setattr(A, "_flash_attention_bhld", spy)
+
+    q, k, v = _qkv(b=1, h=1, l=256, d=64, seed=6)
+    bias = jnp.zeros((1, 1, 1, 256))
+    A.flash_attention(q, k, v, bias=bias)
+    assert not calls, "short sequence must use the XLA path"
+
+    q, k, v = _qkv(b=1, h=1, l=2048, d=64, seed=7)
+    bias = jnp.zeros((1, 1, 1, 2048))
+    A.flash_attention(q, k, v, bias=bias)
+    assert calls, "long sequence must route to the kernel"
