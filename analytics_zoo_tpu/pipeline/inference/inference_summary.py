@@ -31,6 +31,12 @@ class InferenceSummary:
     def add_scalar(self, tag: str, value: float, step: int = None):
         if step is None:
             step = self._next_step()
+        else:
+            # keep the shared auto-step counter monotonic past explicit
+            # steps, so mixing both never emits duplicate/out-of-order
+            # steps for one tag (ADVICE r3 #5)
+            with self._lock:
+                self._step = max(self._step, step)
         self.writer.add_scalar(tag, value, step)
 
     def record_batch(self, batch_size: int, latency_s: float):

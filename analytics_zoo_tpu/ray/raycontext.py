@@ -231,7 +231,9 @@ class RayContext:
         self._results: Dict[str, Any] = {}
         self._results_lock = threading.Lock()
         self._pending: set = set()
-        self._actors: Dict[str, Any] = {}   # actor_id -> (proc, task_q)
+        # actor_id -> ("local", proc, task_q) | ("remote", RemoteHost)
+        #            | ("lost", reason)
+        self._actors: Dict[str, Any] = {}
         self._actor_tasks: Dict[str, set] = {}   # actor_id -> open task_ids
 
     # ------------------------------------------------------------------
@@ -260,7 +262,8 @@ class RayContext:
             self._cluster = ClusterListener(
                 tuple(self._listen), self._result_q,
                 authkey=self.cluster_authkey,
-                requeue=self._task_q.put)
+                requeue=self._task_q.put,
+                on_host_lost=self._on_host_lost)
         _global_ray_context = self
         logger.info("RayContext: %d workers up", self.num_workers)
         return self
@@ -295,56 +298,121 @@ class RayContext:
             return ActorClass(self, fn)
         return RemoteFunction(self, fn)
 
+    def _pick_actor_host(self):
+        """Placement: balance actors across the head and the joined hosts
+        by actor count (reference: the sharded PS spreads its shard actors
+        cluster-wide, sharded_parameter_server.ipynb). Returns a
+        RemoteHost or None for local."""
+        if self._cluster is None:
+            return None
+        with self._cluster.hosts_lock:
+            hosts = [h for h in self._cluster.hosts if h.alive]
+        if not hosts:
+            return None
+        n_local = sum(1 for entry in self._actors.values()
+                      if entry[0] == "local")
+        best = min(hosts, key=lambda h: len(h.actors))
+        return best if len(best.actors) < n_local else None
+
     def _create_actor(self, cls, args, kwargs) -> ActorHandle:
         if self.stopped:
             raise RuntimeError("RayContext not initialized; call init()")
         import cloudpickle
 
-        ctx = mp.get_context("spawn")
         actor_id = uuid.uuid4().hex
         ready_id = f"actor-init-{actor_id}"
-        task_q = ctx.Queue()
-        p = ctx.Process(
-            target=_actor_main,
-            args=(os.getpid(), cloudpickle.dumps(cls),
-                  cloudpickle.dumps((args, kwargs)), ready_id, task_q,
-                  self._result_q, self.platform, self.env),
-            daemon=True, name=f"zoo-ray-actor-{actor_id[:8]}")
-        p.start()
-        self._procs.append(p)
-        self._monitor.register(p)
-        self._actors[actor_id] = (p, task_q)
+        target = self._pick_actor_host()
+        if target is not None:
+            try:
+                self._pending.add(ready_id)
+                target.send_actor_create(actor_id, ready_id,
+                                         cloudpickle.dumps(cls),
+                                         cloudpickle.dumps((args, kwargs)))
+            except (OSError, EOFError):
+                # host died under us: place locally instead
+                self._pending.discard(ready_id)
+                target = None
+            else:
+                self._actors[actor_id] = ("remote", target)
+        if target is None:
+            ctx = mp.get_context("spawn")
+            task_q = ctx.Queue()
+            p = ctx.Process(
+                target=_actor_main,
+                args=(os.getpid(), cloudpickle.dumps(cls),
+                      cloudpickle.dumps((args, kwargs)), ready_id, task_q,
+                      self._result_q, self.platform, self.env),
+                daemon=True, name=f"zoo-ray-actor-{actor_id[:8]}")
+            p.start()
+            self._procs.append(p)
+            self._monitor.register(p)
+            self._actors[actor_id] = ("local", p, task_q)
         # surface constructor errors eagerly (ray raises on first use;
         # eager is strictly more debuggable)
-        self._wait_one(ready_id, None)
+        try:
+            self._wait_one(ready_id, None)
+        except RemoteTaskError:
+            self._actors.pop(actor_id, None)
+            raise
         return ActorHandle(self, actor_id)
 
     def _submit_actor(self, actor_id, method, args, kwargs) -> ObjectRef:
         import cloudpickle
 
-        if actor_id not in self._actors:
+        entry = self._actors.get(actor_id)
+        if entry is None:
             raise RuntimeError(f"unknown or killed actor {actor_id[:8]}")
+        if entry[0] == "lost":
+            raise RemoteTaskError(
+                f"actor {actor_id[:8]} lost: {entry[1]}")
         task_id = uuid.uuid4().hex
         self._pending.add(task_id)
         self._actor_tasks.setdefault(actor_id, set()).add(task_id)
-        self._actors[actor_id][1].put(
-            (task_id, method, cloudpickle.dumps((args, kwargs))))
+        args_blob = cloudpickle.dumps((args, kwargs))
+        if entry[0] == "remote":
+            # sticky routing: the owning host holds the state
+            try:
+                entry[1].send_actor_task(task_id, actor_id, method,
+                                         args_blob)
+            except (OSError, EOFError) as e:
+                self._pending.discard(task_id)
+                self._actor_tasks.get(actor_id, set()).discard(task_id)
+                self._actors[actor_id] = ("lost", "its worker host died")
+                raise RemoteTaskError(
+                    f"actor {actor_id[:8]} lost: its worker host "
+                    f"died ({e})") from e
+        else:
+            entry[2].put((task_id, method, args_blob))
         return ObjectRef(task_id)
+
+    def _on_host_lost(self, host):
+        """A joined host died: every actor homed there is gone. Pending
+        refs were already resolved with errors by the listener; future
+        submits must raise instead of hanging."""
+        for actor_id, entry in list(self._actors.items()):
+            if entry[0] == "remote" and entry[1] is host:
+                self._actors[actor_id] = ("lost", "its worker host died")
 
     def kill(self, handle: ActorHandle):
         """Terminate an actor (ray.kill parity). Unresolved calls on the
         actor resolve to RemoteTaskError instead of hanging their
         ObjectRefs forever (ray raises RayActorError likewise)."""
         entry = self._actors.pop(handle._actor_id, None)
-        if entry is None:
+        if entry is None or entry[0] == "lost":
             return
-        proc, task_q = entry
-        try:
-            task_q.put(None)
-            proc.join(timeout=2)
-        finally:
-            if proc.is_alive():
-                proc.terminate()
+        if entry[0] == "remote":
+            try:
+                entry[1].send_actor_kill(handle._actor_id)
+            except (OSError, EOFError):
+                pass
+        else:
+            _, proc, task_q = entry
+            try:
+                task_q.put(None)
+                proc.join(timeout=2)
+            finally:
+                if proc.is_alive():
+                    proc.terminate()
         with self._results_lock:
             for task_id in self._actor_tasks.pop(handle._actor_id, ()):
                 if task_id not in self._results and \
@@ -370,7 +438,9 @@ class RayContext:
                     host.send_task(task_id, fn_blob, args_blob)
                     return ObjectRef(task_id)
                 except (OSError, EOFError):
-                    pass  # host just died: fall through to the local pool
+                    # host just died (incl. HostLostError from the race
+                    # guard): fall through to the local pool
+                    pass
         self._task_q.put((task_id, fn_blob, args_blob))
         return ObjectRef(task_id)
 

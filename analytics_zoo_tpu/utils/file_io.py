@@ -119,6 +119,26 @@ def glob(pattern: str) -> List[str]:
     return [prefix + p for p in fs.glob(path)]
 
 
+def rename(src: str, dst: str):
+    """Atomic (where the backing store allows) replace of ``dst`` with
+    ``src``; both must be on the same filesystem scheme."""
+    fs, src_path = get_filesystem(src)
+    fs2, dst_path = get_filesystem(dst)
+    if fs is not fs2:
+        raise ValueError(f"cross-scheme rename: {src} -> {dst}")
+    fs.rename(src_path, dst_path)
+
+
+def remove(uri: str):
+    fs, path = get_filesystem(uri)
+    fs.remove(path)
+
+
+def listdir(uri: str) -> List[str]:
+    fs, path = get_filesystem(uri)
+    return fs.listdir(path)
+
+
 def read_bytes(uri: str) -> bytes:
     with open_file(uri, "rb") as f:
         return f.read()

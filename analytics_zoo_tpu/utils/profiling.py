@@ -18,6 +18,7 @@ threaded into the train loop (``Topology.scala:1184``) and ad-hoc
 from __future__ import annotations
 
 import logging
+import os
 
 import numpy as np
 
@@ -32,6 +33,12 @@ PEAK_BF16 = [
 
 
 def peak_flops(device_kind: str):
+    """Peak bf16 matmul FLOPs for a device kind; ``ZOO_TPU_PEAK_FLOPS``
+    overrides (needed for MFU on backends without a table entry, and for
+    deterministic tests)."""
+    env = os.environ.get("ZOO_TPU_PEAK_FLOPS")
+    if env:
+        return float(env)
     dk = (device_kind or "").lower()
     for key, val in PEAK_BF16:
         if key in dk:

@@ -18,11 +18,25 @@ import jax
 import numpy as np
 
 
+def _to_host_array(leaf) -> np.ndarray:
+    """np.asarray works for local and fully-replicated multi-host arrays;
+    genuinely sharded multi-host leaves have no single-host view and must
+    use the per-process format in :mod:`sharded_checkpoint` — fail with
+    direction instead of a cryptic runtime error."""
+    if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable \
+            and not leaf.is_fully_replicated:
+        raise ValueError(
+            "leaf is sharded across processes and cannot be flattened to "
+            "one host; use utils.sharded_checkpoint (the engine picks it "
+            "automatically via SPMDTrainer._needs_sharded_ckpt)")
+    return np.asarray(leaf)
+
+
 def _flatten(tree) -> Dict[str, Any]:
     flat = {}
     for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
         key = "/".join(_path_str(p) for p in path)
-        flat[key] = np.asarray(leaf)
+        flat[key] = _to_host_array(leaf)
     return flat
 
 
@@ -93,7 +107,8 @@ def save_leaves(path: str, tree) -> None:
     leaves = jax.tree_util.tree_leaves(tree)
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     np.savez(path if path.endswith(".npz") else path + ".npz",
-             **{f"leaf{i}": np.asarray(l) for i, l in enumerate(leaves)})
+             **{f"leaf{i}": _to_host_array(l)
+                for i, l in enumerate(leaves)})
 
 
 def load_leaves(path: str, template):

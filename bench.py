@@ -23,6 +23,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -31,6 +32,47 @@ import numpy as np
 
 T_START = time.time()
 TOTAL_BUDGET_S = float(os.environ.get("ZOO_BENCH_BUDGET_S", "2100"))
+
+# Results accumulate here and are flushed to BENCH_partial.json after every
+# completed leg (plus printed on SIGTERM), so a mid-run tunnel death or
+# driver timeout still leaves the legs that DID finish on disk — round 3
+# ended rc=124 with parsed:null despite valid in-run measurements
+# (VERDICT r3 weak #1).
+RESULT = {"metric": "ncf_movielens_train_steps_per_sec", "value": None,
+          "unit": "steps/sec (batch=8192)", "vs_baseline": None}
+PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_partial.json")
+
+
+def emit():
+    """Flush the accumulated result dict to disk (atomic rename)."""
+    tmp = PARTIAL_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(RESULT, f)
+    os.replace(tmp, PARTIAL_PATH)
+
+
+def _sigterm(_sig, _frm):
+    # driver timeout: print what we have as the one JSON line and exit
+    # cleanly so the partial legs are recorded instead of parsed:null
+    RESULT["terminated_early"] = True
+    emit()
+    print(json.dumps(RESULT), flush=True)
+    os._exit(0)
+
+
+signal.signal(signal.SIGTERM, _sigterm)
+
+
+def _windows_stats(fn, n=3):
+    """Run ``fn`` (one timed measurement window -> value) n times; return
+    (median, {min, median, max}) so run-to-run tunnel noise is visible
+    (raw matmul legs measured 133->738 TF/s swings in round 3)."""
+    vals = sorted(fn() for _ in range(n))
+    med = vals[len(vals) // 2] if n % 2 else 0.5 * (
+        vals[n // 2 - 1] + vals[n // 2])
+    return med, {"min": round(vals[0], 4), "median": round(med, 4),
+                 "max": round(vals[-1], 4), "n": n}
 
 # MovieLens-1M shape (users/items from the dataset; reference example uses
 # explicit ratings 1-5 as 5 classes)
@@ -57,7 +99,7 @@ def _peak_flops(device_kind: str):
     return None
 
 
-def probe_backend(attempts=3, timeout_s=300):
+def probe_backend(attempts=2, timeout_s=240):
     """Probe jax backend init in a throwaway subprocess (it can hang or die
     without taking the driver with it). Returns (info_dict|None, err_tail)."""
     code = ("import jax, json; d = jax.devices()[0]; "
@@ -110,12 +152,16 @@ def bench_ncf(x, y):
     ncf.fit(x, y, batch_size=BATCH, nb_epoch=1)
     device_sync(ncf.model._ensure_trainer().params)
     steps_per_epoch = N_SAMPLES // BATCH
-    t0 = time.perf_counter()
-    ncf.fit(x, y, batch_size=BATCH, nb_epoch=TIMED_EPOCHS)
-    device_sync(ncf.model._ensure_trainer().params)
-    dt = time.perf_counter() - t0
-    steps = steps_per_epoch * TIMED_EPOCHS
-    return steps / dt
+
+    def window():
+        t0 = time.perf_counter()
+        ncf.fit(x, y, batch_size=BATCH, nb_epoch=TIMED_EPOCHS)
+        device_sync(ncf.model._ensure_trainer().params)
+        return steps_per_epoch * TIMED_EPOCHS / (time.perf_counter() - t0)
+
+    med, stats = _windows_stats(window)
+    RESULT["ncf_steps_per_sec_windows"] = stats
+    return med
 
 
 def bench_torch_cpu(x, y, n_steps=12):
@@ -252,13 +298,18 @@ def _bench_bert_mfu_at(peak_flops, bert_batch):
     device_sync(logs["loss"])
 
     n_dispatch = 4
-    t0 = time.perf_counter()
-    for i in range(n_dispatch):
-        params, opt_state, net_state, logs = multi(
-            params, opt_state, net_state, stacked, (i + 1) * k)
-    device_sync(logs["loss"])
-    n_steps = n_dispatch * k
-    dt = (time.perf_counter() - t0) / n_steps
+
+    def window():
+        nonlocal params, opt_state, net_state, logs
+        t0 = time.perf_counter()
+        for i in range(n_dispatch):
+            params, opt_state, net_state, logs = multi(
+                params, opt_state, net_state, stacked, (i + 1) * k)
+        device_sync(logs["loss"])
+        return n_dispatch * k / (time.perf_counter() - t0)   # steps/sec
+
+    sps, stats = _windows_stats(window)
+    dt = 1.0 / sps
 
     flops = _bert_flops_per_step(bert_batch, BERT_SEQ, BERT_H, BERT_BLOCKS,
                                  BERT_CLASSES)
@@ -266,6 +317,7 @@ def _bench_bert_mfu_at(peak_flops, bert_batch):
     return {
         "bert_batch": bert_batch,
         "bert_step_time_ms": round(dt * 1e3, 2),
+        "bert_steps_per_sec_windows": stats,
         "bert_tokens_per_sec": round(bert_batch * BERT_SEQ / dt, 1),
         "bert_model_tflops_per_sec": round(achieved / 1e12, 2),
         "bert_mfu": (round(achieved / peak_flops, 4)
@@ -326,18 +378,24 @@ def _bench_resnet_mfu_at(peak_flops, batch):
     device_sync(logs["loss"])
 
     n_dispatch = 3
-    t0 = time.perf_counter()
-    for i in range(n_dispatch):
-        params, opt_state, net_state, logs = multi(
-            params, opt_state, net_state, stacked, (i + 1) * k)
-    device_sync(logs["loss"])
-    n_steps = n_dispatch * k
-    dt = (time.perf_counter() - t0) / n_steps
+
+    def window():
+        nonlocal params, opt_state, net_state, logs
+        t0 = time.perf_counter()
+        for i in range(n_dispatch):
+            params, opt_state, net_state, logs = multi(
+                params, opt_state, net_state, stacked, (i + 1) * k)
+        device_sync(logs["loss"])
+        return n_dispatch * k / (time.perf_counter() - t0)   # steps/sec
+
+    sps, stats = _windows_stats(window)
+    dt = 1.0 / sps
 
     achieved = 3 * RESNET_FWD_FLOPS_PER_IMAGE * batch / dt
     return {
         "resnet_batch": batch,
         "resnet_step_time_ms": round(dt * 1e3, 2),
+        "resnet_steps_per_sec_windows": stats,
         "resnet_images_per_sec": round(batch / dt, 1),
         "resnet_mfu": (round(achieved / peak_flops, 4)
                        if peak_flops else None),
@@ -345,72 +403,72 @@ def _bench_resnet_mfu_at(peak_flops, batch):
 
 
 def main():
-    extra = {}
     info, err = probe_backend()
     if info is None:
         # TPU runtime unreachable: record the diagnosis, fall back to CPU so
         # the round still produces a number instead of a traceback. The env
         # var alone is ignored when a TPU plugin is registered; the config
         # update is authoritative (must land before backend init).
-        extra["init_error"] = err
+        RESULT["init_error"] = err
         os.environ["JAX_PLATFORMS"] = "cpu"
         import jax
         jax.config.update("jax_platforms", "cpu")
         info = {"platform": "cpu", "device_kind": "host-cpu-fallback",
                 "n": 1}
-    extra["platform"] = info["platform"]
-    extra["device_kind"] = info["device_kind"]
+    RESULT["platform"] = info["platform"]
+    RESULT["device_kind"] = info["device_kind"]
+    emit()
     print(f"# backend: {info}", file=sys.stderr)
 
     x, y = make_data()
     tpu_sps = None
     try:
         tpu_sps = bench_ncf(x, y)
+        RESULT["value"] = round(tpu_sps, 2)
     except Exception as e:  # noqa: BLE001
         import traceback
         traceback.print_exc()
-        extra["ncf_error"] = (str(e).splitlines()[0][:500]
-                              if str(e) else repr(e)[:500])
+        RESULT["ncf_error"] = (str(e).splitlines()[0][:500]
+                               if str(e) else repr(e)[:500])
+    emit()
 
-    vs = None
     if tpu_sps is not None:
         try:
             cpu_sps = bench_torch_cpu(x, y)
-            vs = tpu_sps / cpu_sps
-            extra["torch_cpu_steps_per_sec"] = round(cpu_sps, 2)
+            RESULT["vs_baseline"] = round(tpu_sps / cpu_sps, 2)
+            RESULT["torch_cpu_steps_per_sec"] = round(cpu_sps, 2)
         except Exception as e:  # torch missing/broken: report raw number
             print(f"# torch baseline failed: {e}", file=sys.stderr)
+        emit()
 
     peak = _peak_flops(info["device_kind"]) \
         if info["platform"] == "tpu" else None
     if time.time() - T_START < TOTAL_BUDGET_S * 0.85:
         try:
-            extra.update(bench_bert_mfu(peak))
+            RESULT.update(bench_bert_mfu(peak))
         except Exception as e:  # noqa: BLE001
             import traceback
             traceback.print_exc()
             # message head, not a traceback tail slice (ADVICE r2)
-            extra["bert_error"] = (str(e).splitlines()[0][:500]
-                                   if str(e) else repr(e)[:500])
+            RESULT["bert_error"] = (str(e).splitlines()[0][:500]
+                                    if str(e) else repr(e)[:500])
+        emit()
     else:
-        extra["bert_skipped"] = "time budget exhausted"
+        RESULT["bert_skipped"] = "time budget exhausted"
 
     # ResNet-50 MFU (BASELINE.md north-star) only with budget to spare —
     # and only on real hardware (it is meaningless on the CPU fallback)
     if info["platform"] == "tpu" and \
             time.time() - T_START < TOTAL_BUDGET_S * 0.6:
         try:
-            extra.update(bench_resnet_mfu(peak))
+            RESULT.update(bench_resnet_mfu(peak))
         except Exception as e:  # noqa: BLE001
-            extra["resnet_error"] = (str(e).splitlines()[0][:500]
-                                     if str(e) else repr(e)[:500])
+            RESULT["resnet_error"] = (str(e).splitlines()[0][:500]
+                                      if str(e) else repr(e)[:500])
+        emit()
 
-    result = {"metric": "ncf_movielens_train_steps_per_sec",
-              "value": round(tpu_sps, 2) if tpu_sps is not None else None,
-              "unit": "steps/sec (batch=8192)",
-              "vs_baseline": round(vs, 2) if vs is not None else None}
-    result.update(extra)
-    print(json.dumps(result))
+    emit()
+    print(json.dumps(RESULT))
 
 
 if __name__ == "__main__":

@@ -111,3 +111,113 @@ def test_two_process_train_and_checkpoint(tmp_path):
     for pid, (rc, out, err) in enumerate(outs):
         assert rc == 0, f"proc {pid} rc={rc}\n{out[-2000:]}\n{err[-3000:]}"
         assert f"WORKER_{pid}_OK" in out
+
+
+_TP_WORKER = r"""
+import os, sys
+import numpy as np
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+from analytics_zoo_tpu.common.nncontext import (ZooConfig, init_nncontext)
+
+ctx = init_nncontext(ZooConfig(model_parallel=2, log_every_n_steps=1000))
+assert jax.process_count() == 2
+pid = jax.process_index()
+
+from analytics_zoo_tpu.feature.feature_set import ArrayFeatureSet
+from analytics_zoo_tpu.common.zoo_trigger import MaxIteration
+from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+from analytics_zoo_tpu.pipeline.api.keras.models import Sequential
+from analytics_zoo_tpu.utils import sharded_checkpoint as sc
+
+rng = np.random.default_rng(100 + pid)
+x = rng.standard_normal((64, 8)).astype(np.float32)
+y = rng.standard_normal((64, 1)).astype(np.float32)
+
+model = Sequential()
+model.add(Dense(16, activation="relu", input_shape=(8,)))
+model.add(Dense(1))
+model.compile(optimizer="adam", loss="mse")
+
+mesh = ctx.mesh
+model.set_param_sharding(lambda params: jax.tree.map(
+    lambda leaf: NamedSharding(
+        mesh, P(None, "model")
+        if np.ndim(leaf) == 2 and np.shape(leaf)[1] % 2 == 0 else P()),
+    params))
+trainer = model._ensure_trainer()
+ckpt = os.environ["ZOO_TEST_CKPT"]
+
+trainer.train(ArrayFeatureSet([x], y), batch_size=32,
+              end_trigger=MaxIteration(2))
+
+# the TP kernel is genuinely sharded across processes: NOT fully
+# addressable, NOT fully replicated -> the flat .npz format is impossible
+kern = trainer.params[model.layers[0].name]["kernel"]
+assert not kern.is_fully_addressable
+assert not kern.is_fully_replicated
+saved_shard = np.asarray(kern.addressable_data(0))
+
+# save must auto-route to the sharded format (no gather anywhere)
+trainer.save_checkpoint(ckpt)
+assert sc.exists(ckpt, "params"), "sharded manifest missing"
+assert sc.exists(ckpt, "optim")
+assert not os.path.exists(os.path.join(ckpt, "model.npz")), \
+    "flat format written for sharded state"
+
+# diverge, restore, verify the local shard is bit-identical
+trainer.train(ArrayFeatureSet([x], y), batch_size=32,
+              end_trigger=MaxIteration(4))
+assert not np.array_equal(
+    np.asarray(trainer.params[model.layers[0].name]["kernel"]
+               .addressable_data(0)), saved_shard)
+trainer.load_checkpoint(ckpt)
+assert trainer.step == 2, trainer.step
+kern2 = trainer.params[model.layers[0].name]["kernel"]
+assert kern2.sharding.spec == P(None, "model"), kern2.sharding.spec
+np.testing.assert_array_equal(np.asarray(kern2.addressable_data(0)),
+                              saved_shard)
+
+# training continues from the restored sharded state
+trainer.train(ArrayFeatureSet([x], y), batch_size=32,
+              end_trigger=MaxIteration(3))
+assert trainer.step == 3
+print(f"WORKER_{pid}_OK")
+"""
+
+
+def test_two_process_tp_sharded_checkpoint(tmp_path):
+    """TP-sharded (non-addressable, non-replicated) params checkpoint and
+    restore across 2 processes via the per-process shard format — no
+    gather (VERDICT r3 next #4)."""
+    port = _free_port()
+    env_base = {k: v for k, v in os.environ.items()
+                if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    procs = []
+    for pid in (0, 1):
+        env = dict(env_base,
+                   ZOO_TPU_COORDINATOR=f"127.0.0.1:{port}",
+                   ZOO_TPU_NUM_PROCESSES="2",
+                   ZOO_TPU_PROCESS_ID=str(pid),
+                   ZOO_TEST_CKPT=str(tmp_path / "ckpt"))
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _TP_WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+    outs = []
+    for pid, p in enumerate(procs):
+        try:
+            out, err = p.communicate(timeout=480)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append((p.returncode, out, err))
+    for pid, (rc, out, err) in enumerate(outs):
+        assert rc == 0, f"proc {pid} rc={rc}\n{out[-2000:]}\n{err[-3000:]}"
+        assert f"WORKER_{pid}_OK" in out

@@ -269,3 +269,78 @@ def test_cluster_listener_survives_bad_connections():
         conn.close()
     finally:
         listener.close()
+
+
+def test_cross_host_sharded_ps_actors():
+    """Sharded-parameter-server actors place across the head AND a joined
+    worker host, with sticky routing (state lives where the actor lives)
+    and actor-lost errors when the host dies (VERDICT r3 next #6;
+    reference: apps/ray/parameter_server/sharded_parameter_server.ipynb)."""
+    import socket
+    import subprocess
+    import sys
+
+    s = socket.socket(); s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]; s.close()
+
+    class PSShard:
+        def __init__(self, dim):
+            self.w = np.zeros(dim, np.float32)
+
+        def push(self, grad):
+            self.w -= 0.5 * np.asarray(grad, np.float32)
+            return True
+
+        def pull(self):
+            return self.w
+
+    with RayContext(num_ray_nodes=1, ray_node_cpu_cores=1, platform="cpu",
+                    listen=("127.0.0.1", port)) as ctx:
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        joiner = subprocess.Popen(
+            [sys.executable, "-m", "analytics_zoo_tpu.ray.worker_host",
+             "--connect", f"127.0.0.1:{port}", "--workers", "2",
+             "--authkey", ctx.cluster_authkey.decode()],
+            env=env, cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+        try:
+            deadline = time.time() + 60
+            while not ctx._cluster.hosts and time.time() < deadline:
+                time.sleep(0.2)
+            assert ctx._cluster.hosts, "worker host never joined"
+
+            PS = ctx.remote(PSShard)
+            shards = [PS.remote(4) for _ in range(2)]
+            kinds = sorted(ctx._actors[h._actor_id][0] for h in shards)
+            assert kinds == ["local", "remote"], kinds
+
+            # sticky routing: repeated pushes accumulate in the SAME state
+            for i, h in enumerate(shards):
+                ctx.get(h.push.remote(np.full(4, float(i + 1))))
+                ctx.get(h.push.remote(np.full(4, float(i + 1))))
+            w0 = ctx.get(shards[0].pull.remote())
+            w1 = ctx.get(shards[1].pull.remote())
+            np.testing.assert_allclose(w0, np.full(4, -1.0))
+            np.testing.assert_allclose(w1, np.full(4, -2.0))
+
+            # host death: pending/new calls on its actor must error, the
+            # surviving local actor keeps working
+            remote_h = next(h for h in shards
+                            if ctx._actors[h._actor_id][0] == "remote")
+            local_h = next(h for h in shards
+                           if ctx._actors[h._actor_id][0] == "local")
+            joiner.terminate()
+            joiner.wait(timeout=10)
+            deadline = time.time() + 30
+            while ctx._actors[remote_h._actor_id][0] != "lost" and \
+                    time.time() < deadline:
+                time.sleep(0.2)
+            assert ctx._actors[remote_h._actor_id][0] == "lost"
+            with pytest.raises(RemoteTaskError, match="lost"):
+                ctx.get(remote_h.pull.remote())
+            np.testing.assert_allclose(ctx.get(local_h.pull.remote()), w0)
+        finally:
+            if joiner.poll() is None:
+                joiner.terminate()
+                joiner.wait(timeout=10)
