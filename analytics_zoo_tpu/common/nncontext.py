@@ -61,6 +61,12 @@ class ZooConfig:
     profile_dir: Optional[str] = None
     profile_start_step: int = 10
     profile_num_steps: int = 5
+    # NNFrames ingest: when the processed samples of a DataFrame would
+    # exceed this many bytes, NNEstimator.fit spills them to sharded .npz
+    # files and streams (ShardedFileFeatureSet) instead of holding the
+    # whole dataset resident (reference: NNEstimator.scala:382 getDataSet
+    # caching tiers)
+    nnframes_spill_bytes: int = 2_000_000_000
 
     @classmethod
     def from_env(cls, **overrides):

@@ -794,29 +794,40 @@ class SPMDTrainer:
             "state": jax.tree_util.tree_leaves(self.net_state or {}),
             "optim": jax.tree_util.tree_leaves(self.opt_state),
         }
-        # tag shard files with the step so an in-place overwrite writes NEW
-        # files: a crash mid-save leaves the old manifest pointing at the
-        # old (complete) file set, never a silent old/new mix
+        # tag every file of this save with the step: the save only becomes
+        # visible at the single write_commit rename below, so a crash at
+        # ANY earlier point (between group manifests included) leaves the
+        # previous commit pointing at its own complete, mutually-consistent
+        # params/state/optim/meta set — never a new-params/old-optim mix
         tag = f"s{self.step}"
         for name, leaves in groups.items():
             sharded_checkpoint.save_shards(directory, name, leaves,
                                            tag=tag)
-        # all shard files must exist before the manifests mark them valid
+        # all shard files must exist before the manifests reference them
         self._barrier("zoo_ckpt_shards")
         if jax.process_index() == 0:
             for name, leaves in groups.items():
                 sharded_checkpoint.write_manifest(directory, name, leaves,
                                                   tag=tag)
             serialization.save_pytree(
-                os.path.join(directory, "meta.npz"),
+                os.path.join(directory, f"meta.{tag}.npz"),
                 {"step": np.asarray(self.step),
                  "epoch": np.asarray(self.epoch)})
-            # a stale flat checkpoint in the same directory would shadow
-            # the sharded one on load — remove it
-            for fname in ("model.npz", "model.npz.treedef", "optim.npz"):
-                path = os.path.join(directory, fname)
-                if os.path.exists(path):
-                    os.remove(path)
+            sharded_checkpoint.write_commit(directory, tag)
+            # post-commit cleanup: earlier tags and any stale flat
+            # checkpoint that would shadow this one on load
+            sharded_checkpoint.gc_stale(directory, list(groups), tag)
+            for fname in os.listdir(directory):
+                stale_meta = fname.startswith("meta.s") and \
+                    not fname.startswith(f"meta.{tag}.")
+                if stale_meta or fname in ("model.npz",
+                                           "model.npz.treedef",
+                                           "optim.npz", "meta.npz",
+                                           "meta.npz.treedef"):
+                    try:
+                        os.remove(os.path.join(directory, fname))
+                    except OSError:
+                        pass
             logger.info("sharded checkpoint saved to %s @step %d",
                         directory, self.step)
         self._barrier("zoo_ckpt_save")
@@ -825,15 +836,17 @@ class SPMDTrainer:
         """Resharding restore: templates come from the current trainer
         (structure + target shardings); the saved layout may differ — each
         device's region is assembled from overlapping saved pieces, no
-        full-array gather anywhere."""
+        full-array gather anywhere. The committed tag selects ONE
+        mutually-consistent params/state/optim/meta set."""
+        tag = sharded_checkpoint.read_commit(directory)
         self.ensure_initialized()
         p_leaves, p_def = jax.tree_util.tree_flatten(self.params)
         p_sh = jax.tree_util.tree_leaves(self._param_shardings(self.params))
         self.params = jax.tree_util.tree_unflatten(
             p_def, sharded_checkpoint.load_shards(
                 directory, "params", p_sh,
-                dtypes=[leaf.dtype for leaf in p_leaves]))
-        if sharded_checkpoint.exists(directory, "state"):
+                dtypes=[leaf.dtype for leaf in p_leaves], tag=tag))
+        if sharded_checkpoint.exists(directory, "state", tag):
             s_leaves, s_def = jax.tree_util.tree_flatten(
                 self.net_state or {})
             if s_leaves:
@@ -841,21 +854,28 @@ class SPMDTrainer:
                 self.net_state = jax.tree_util.tree_unflatten(
                     s_def, sharded_checkpoint.load_shards(
                         directory, "state", [repl] * len(s_leaves),
-                        dtypes=[leaf.dtype for leaf in s_leaves]))
+                        dtypes=[leaf.dtype for leaf in s_leaves], tag=tag))
         template = self.tx.init(self.params)
         o_leaves, o_def = jax.tree_util.tree_flatten(template)
         self.opt_state = jax.tree_util.tree_unflatten(
             o_def, sharded_checkpoint.load_shards(
                 directory, "optim", self._opt_leaf_shardings(template),
-                dtypes=[np.asarray(leaf).dtype for leaf in o_leaves]))
-        meta = serialization.load_pytree(os.path.join(directory, "meta.npz"))
+                dtypes=[np.asarray(leaf).dtype for leaf in o_leaves],
+                tag=tag))
+        meta_name = "meta.npz" if tag is None else f"meta.{tag}.npz"
+        meta = serialization.load_pytree(os.path.join(directory, meta_name))
         self.step = int(meta["step"])
         self.epoch = int(meta["epoch"])
         self._last_log_step = self.step
 
+    @staticmethod
+    def _sharded_available(directory: str) -> bool:
+        tag = sharded_checkpoint.read_commit(directory)
+        return sharded_checkpoint.exists(directory, "params", tag)
+
     def has_checkpoint(self, directory: str) -> bool:
         return os.path.exists(os.path.join(directory, "model.npz")) or \
-            sharded_checkpoint.exists(directory, "params")
+            self._sharded_available(directory)
 
     def save_checkpoint(self, directory: Optional[str] = None):
         directory = directory or self.checkpoint_dir
@@ -895,7 +915,7 @@ class SPMDTrainer:
     def load_checkpoint(self, directory: str):
         # writer (process 0) must have finished before anyone reads
         self._barrier("zoo_ckpt_load")
-        if sharded_checkpoint.exists(directory, "params") and \
+        if self._sharded_available(directory) and \
                 not os.path.exists(os.path.join(directory, "model.npz")):
             self._load_checkpoint_sharded(directory)
             return

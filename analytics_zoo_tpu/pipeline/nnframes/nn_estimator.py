@@ -228,27 +228,93 @@ class NNEstimator(_Params):
     set_gradient_clipping_by_l2_norm = setGradientClippingByL2Norm
 
     # -- dataset extraction (getDataSet parity, NNEstimator.scala:382) --
-    def _extract_samples(self, df, with_label=True):
+    def _row_to_sample(self, f, lbl) -> Sample:
+        if self.sample_preprocessing is not None:
+            return self.sample_preprocessing.apply((f, lbl))
+        fv = self.feature_preprocessing.apply(f) \
+            if self.feature_preprocessing else np.asarray(f, np.float32)
+        lv = None
+        if lbl is not None:
+            lv = self.label_preprocessing.apply(lbl) \
+                if self.label_preprocessing else np.asarray(lbl, np.float32)
+        return Sample(fv, lv)
+
+    def _raw_columns(self, df, with_label=True):
         feats = _col_values(df, self.features_col)
         labels = None
         if with_label and self.label_col is not None and \
                 self.label_col in getattr(df, "columns", df):
             labels = _col_values(df, self.label_col)
-        samples = []
-        for i, f in enumerate(feats):
-            lbl = labels[i] if labels is not None else None
-            if self.sample_preprocessing is not None:
-                samples.append(self.sample_preprocessing.apply((f, lbl)))
-                continue
-            fv = self.feature_preprocessing.apply(f) \
-                if self.feature_preprocessing else np.asarray(f, np.float32)
-            lv = None
-            if lbl is not None:
-                lv = self.label_preprocessing.apply(lbl) \
-                    if self.label_preprocessing else \
-                    np.asarray(lbl, np.float32)
-            samples.append(Sample(fv, lv))
-        return samples
+        return feats, labels
+
+    def _samples_from_columns(self, feats, labels):
+        return [self._row_to_sample(
+            f, labels[i] if labels is not None else None)
+            for i, f in enumerate(feats)]
+
+    def _extract_samples(self, df, with_label=True):
+        return self._samples_from_columns(*self._raw_columns(df, with_label))
+
+    @staticmethod
+    def _sample_nbytes(sample: Sample) -> int:
+        total = 0
+        for part in (sample.features, sample.labels):
+            for a in (part or ()):
+                total += np.asarray(a).nbytes
+        return total
+
+    def _maybe_spill(self, feats, labels) -> Optional[FeatureSet]:
+        """Auto-spill (VERDICT r3 next #8): when the PROCESSED samples of
+        the DataFrame would exceed ``config.nnframes_spill_bytes``
+        (preprocessing can expand rows by orders of magnitude — an image
+        path becomes a 224x224x3 tensor), write ~64 MB ``.npz`` shards and
+        stream them via ShardedFileFeatureSet instead of keeping every
+        sample resident. The estimate processes one row; the spill then
+        processes chunk-by-chunk, so peak memory is one shard, not the
+        dataset. The spill directory lives as long as the returned
+        FeatureSet (weakref finalizer removes it)."""
+        from ...common.nncontext import get_nncontext
+        from ...feature.feature_set import (DiskFeatureSet,
+                                            ShardedFileFeatureSet,
+                                            stack_samples)
+
+        threshold = get_nncontext().config.nnframes_spill_bytes
+        n = len(feats)
+        if n == 0:
+            return None
+        probe = self._row_to_sample(
+            feats[0], labels[0] if labels is not None else None)
+        per_sample = max(1, self._sample_nbytes(probe))
+        if per_sample * n <= threshold:
+            return None
+        import shutil
+        import tempfile
+        import weakref
+
+        # each shard must respect the memory bound that triggered the
+        # spill (and a 64 MB practical cap)
+        shard_bytes = min(threshold, 64 << 20)
+        shard_rows = int(min(n, max(1, shard_bytes // per_sample)))
+        spill_dir = tempfile.mkdtemp(prefix="zoo_nnframes_spill_")
+        paths = []
+        for start in range(0, n, shard_rows):
+            chunk = [self._row_to_sample(
+                feats[i], labels[i] if labels is not None else None)
+                for i in range(start, min(start + shard_rows, n))]
+            xs, ys = stack_samples(chunk)
+            path = os.path.join(spill_dir,
+                                f"shard{start // shard_rows:05d}.npz")
+            DiskFeatureSet.write_shard(path, list(xs), ys)
+            paths.append(path)
+        import logging
+        logging.getLogger("analytics_zoo_tpu.nnframes").info(
+            "NNFrames ingest spilled %d samples (~%.1f MB) to %d shards "
+            "under %s", n, per_sample * n / 1e6, len(paths), spill_dir)
+        # the shards were written from THIS process's rows — no further
+        # per-host striping (shard_per_host would drop all but 1/P of them)
+        fs = ShardedFileFeatureSet(paths, num_slice=1, shard_per_host=False)
+        weakref.finalize(fs, shutil.rmtree, spill_dir, ignore_errors=True)
+        return fs
 
     def _get_dataset(self, df, with_label=True) -> FeatureSet:
         # scalable ingest (SURVEY hard part (a)): a FeatureSet — notably
@@ -259,7 +325,11 @@ class NNEstimator(_Params):
         if isinstance(df, (list, tuple)) and df and \
                 all(isinstance(p, str) for p in df):
             return FeatureSet.files(list(df), label_col=self.label_col)
-        return FeatureSet.samples(self._extract_samples(df, with_label))
+        feats, labels = self._raw_columns(df, with_label)
+        spilled = self._maybe_spill(feats, labels)
+        if spilled is not None:
+            return spilled
+        return FeatureSet.samples(self._samples_from_columns(feats, labels))
 
     # -- fit (internalFit parity, NNEstimator.scala:414-479) ------------
     def fit(self, df) -> "NNModel":

@@ -352,7 +352,12 @@ class RayContext:
         try:
             self._wait_one(ready_id, None)
         except RemoteTaskError:
-            self._actors.pop(actor_id, None)
+            entry = self._actors.pop(actor_id, None)
+            if entry is not None and entry[0] == "remote":
+                # the remote ctor failed: nothing lives there — drop the
+                # placement count too, or failed ctors permanently bias
+                # _pick_actor_host away from this host
+                entry[1].actors.discard(actor_id)
             raise
         return ActorHandle(self, actor_id)
 

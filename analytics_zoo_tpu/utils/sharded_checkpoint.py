@@ -87,6 +87,65 @@ def _shard_fname(name: str, tag: Optional[str], proc: int) -> str:
             else f"{name}.{tag}.shard{proc}.npz")
 
 
+def _manifest_name(name: str, tag: Optional[str]) -> str:
+    return (name + MANIFEST_SUFFIX if tag is None
+            else f"{name}.{tag}{MANIFEST_SUFFIX}")
+
+
+COMMIT_FILE = "sharded.commit"
+
+
+def write_commit(directory: str, tag: str) -> None:
+    """The cross-group commit point: a multi-group checkpoint (params +
+    state + optim + meta) is valid only once this file names its tag.
+    Written LAST (atomic rename) — a crash between the per-group manifest
+    writes leaves the previous commit pointing at the previous tag's
+    complete, mutually-consistent file set, never a new-params/old-optim
+    mix."""
+    tmp = _join(directory, COMMIT_FILE + ".tmp")
+    with file_io.open_file(tmp, "wb") as f:
+        f.write(tag.encode())
+    file_io.rename(tmp, _join(directory, COMMIT_FILE))
+
+
+def read_commit(directory: str) -> Optional[str]:
+    uri = _join(directory, COMMIT_FILE)
+    if not file_io.exists(uri):
+        return None
+    with file_io.open_file(uri, "rb") as f:
+        return f.read().decode().strip() or None
+
+
+def gc_stale(directory: str, names: Sequence[str],
+             keep_tag: Optional[str]) -> None:
+    """Best-effort removal of shard/manifest files from tags other than
+    ``keep_tag`` (call AFTER write_commit). A reader racing the GC with
+    the old commit fails loudly (FileNotFoundError), never silently."""
+    try:
+        entries = file_io.listdir(directory)
+    except OSError:
+        return
+    keep = set()
+    for name in names:
+        keep.add(_manifest_name(name, keep_tag))
+        keep.update(f for f in entries
+                    if f.startswith(f"{name}.{keep_tag}.shard")
+                    or (keep_tag is None and
+                        f.startswith(f"{name}.shard")))
+    for fname in entries:
+        stale_shard = any(
+            fname.startswith(f"{name}.") and ".shard" in fname and
+            fname.endswith(".npz") for name in names)
+        stale_manifest = any(
+            fname.startswith(f"{name}.") and
+            fname.endswith(MANIFEST_SUFFIX) for name in names)
+        if (stale_shard or stale_manifest) and fname not in keep:
+            try:
+                file_io.remove(_join(directory, fname))
+            except OSError:
+                pass
+
+
 def save_shards(directory: str, name: str, leaves: Sequence[Any],
                 tag: Optional[str] = None) -> None:
     """Write this process's shard file for ``leaves`` (atomic). Call on
@@ -117,12 +176,10 @@ def save_shards(directory: str, name: str, leaves: Sequence[Any],
 def write_manifest(directory: str, name: str, leaves: Sequence[Any],
                    n_shard_files: Optional[int] = None,
                    tag: Optional[str] = None) -> None:
-    """Process 0 writes the manifest LAST (after all shard files exist):
-    its presence marks the checkpoint complete, so a reader can never
-    observe a half-written sharded checkpoint as valid. After the manifest
-    lands, shard files from earlier tags are garbage-collected
-    (best-effort; a reader racing the GC with the old manifest fails
-    loudly with FileNotFoundError, never silently)."""
+    """Process 0 writes the group manifest after all its shard files
+    exist. With a ``tag``, the manifest is tag-scoped and the checkpoint
+    only becomes visible at :func:`write_commit`; untagged manifests are
+    self-commiting (single-group module users)."""
     if jax.process_index() != 0:
         return
     n_files = n_shard_files if n_shard_files is not None \
@@ -136,22 +193,15 @@ def write_manifest(directory: str, name: str, leaves: Sequence[Any],
                    for leaf in leaves],
         "shard_files": shard_files,
     }
-    tmp = _join(directory, name + MANIFEST_SUFFIX + ".tmp")
+    fname = _manifest_name(name, tag)
+    tmp = _join(directory, fname + ".tmp")
     with file_io.open_file(tmp, "wb") as f:
         f.write(json.dumps(manifest).encode())
-    file_io.rename(tmp, _join(directory, name + MANIFEST_SUFFIX))
-    keep = set(shard_files)
-    try:
-        for fname in file_io.listdir(directory):
-            if fname.startswith(f"{name}.") and ".shard" in fname \
-                    and fname.endswith(".npz") and fname not in keep:
-                file_io.remove(_join(directory, fname))
-    except OSError:  # GC is best-effort
-        pass
+    file_io.rename(tmp, _join(directory, fname))
 
 
-def exists(directory: str, name: str) -> bool:
-    return file_io.exists(_join(directory, name + MANIFEST_SUFFIX))
+def exists(directory: str, name: str, tag: Optional[str] = None) -> bool:
+    return file_io.exists(_join(directory, _manifest_name(name, tag)))
 
 
 class _PieceCatalog:
@@ -168,9 +218,16 @@ class _PieceCatalog:
             if not file_io.exists(uri):
                 raise FileNotFoundError(
                     f"sharded checkpoint incomplete: missing {uri}")
-            # non-local schemes: buffer through memory (np.load needs seek)
-            with file_io.open_file(uri, "rb") as f:
-                npz = np.load(io.BytesIO(f.read()), allow_pickle=False)
+            scheme, local = file_io.split_scheme(uri)
+            if scheme == "file":
+                # NpzFile reads zip members lazily: each process touches
+                # only the bytes of the pieces overlapping ITS regions,
+                # not the whole checkpoint
+                npz = np.load(local, allow_pickle=False)
+            else:
+                # non-seekable remote streams: buffer through memory
+                with file_io.open_file(uri, "rb") as f:
+                    npz = np.load(io.BytesIO(f.read()), allow_pickle=False)
             self._files.append(npz)
             meta = json.loads(bytes(npz["__meta__"]).decode())
             for key, info in meta.items():
@@ -211,11 +268,12 @@ class _PieceCatalog:
 
 
 def load_shards(directory: str, name: str, shardings: Sequence[Any],
-                dtypes: Optional[Sequence[Any]] = None) -> List[jax.Array]:
+                dtypes: Optional[Sequence[Any]] = None,
+                tag: Optional[str] = None) -> List[jax.Array]:
     """Load a sharded checkpoint, placing leaf ``i`` with ``shardings[i]``
     (a ``jax.sharding.Sharding``). The saved layout need not match: each
     device's region is assembled from overlapping saved pieces."""
-    with file_io.open_file(_join(directory, name + MANIFEST_SUFFIX),
+    with file_io.open_file(_join(directory, _manifest_name(name, tag)),
                            "rb") as f:
         manifest = json.loads(f.read().decode())
     if len(shardings) != manifest["n_leaves"]:

@@ -165,8 +165,10 @@ saved_shard = np.asarray(kern.addressable_data(0))
 
 # save must auto-route to the sharded format (no gather anywhere)
 trainer.save_checkpoint(ckpt)
-assert sc.exists(ckpt, "params"), "sharded manifest missing"
-assert sc.exists(ckpt, "optim")
+tag = sc.read_commit(ckpt)
+assert tag is not None, "sharded commit missing"
+assert sc.exists(ckpt, "params", tag), "sharded manifest missing"
+assert sc.exists(ckpt, "optim", tag)
 assert not os.path.exists(os.path.join(ckpt, "model.npz")), \
     "flat format written for sharded state"
 

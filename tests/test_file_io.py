@@ -1,0 +1,92 @@
+"""file_io scheme dispatch + the pyarrow.fs remote handler (VERDICT r3
+next #10), exercised with a LocalFileSystem mounted under a mock remote
+scheme — the same adapter serves hdfs/gs/s3 when their pyarrow
+filesystems are constructible."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.utils import file_io
+from analytics_zoo_tpu.utils.arrow_fs import (ArrowFileSystem,
+                                              register_arrow_filesystem)
+
+
+@pytest.fixture()
+def mockfs(tmp_path):
+    from pyarrow import fs as pafs
+
+    register_arrow_filesystem("mockfs", pafs.LocalFileSystem())
+    yield f"mockfs://{tmp_path}"
+    file_io._SCHEMES.pop("mockfs", None)
+
+
+def test_bytes_roundtrip_and_listing(mockfs):
+    uri = f"{mockfs}/sub/dir/blob.bin"
+    file_io.write_bytes(uri, b"hello remote")
+    assert file_io.exists(uri)
+    assert file_io.read_bytes(uri) == b"hello remote"
+    assert file_io.listdir(f"{mockfs}/sub/dir") == ["blob.bin"]
+    assert file_io.glob(f"{mockfs}/sub/**/*.bin") or \
+        file_io.glob(f"{mockfs}/sub/*/*.bin")
+
+    file_io.rename(uri, f"{mockfs}/sub/dir/blob2.bin")
+    assert not file_io.exists(uri)
+    assert file_io.exists(f"{mockfs}/sub/dir/blob2.bin")
+    file_io.remove(f"{mockfs}/sub/dir/blob2.bin")
+    assert not file_io.exists(f"{mockfs}/sub/dir/blob2.bin")
+
+
+def test_unregistered_scheme_raises(tmp_path):
+    with pytest.raises(ValueError, match="no filesystem registered"):
+        file_io.open_file("nosuchfs://x/y", "rb")
+
+
+def test_sharded_checkpoint_over_remote_scheme(mockfs):
+    """The sharded checkpoint writer/reader runs entirely through the
+    registered filesystem — checkpoints work off-box."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from analytics_zoo_tpu.utils import sharded_checkpoint as sc
+
+    devs = np.array(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devs, ("data", "model"))
+    rng = np.random.default_rng(0)
+    host = rng.standard_normal((16, 8)).astype(np.float32)
+    arr = jax.device_put(host, NamedSharding(mesh, P("data", "model")))
+
+    directory = f"{mockfs}/ckpt"
+    sc.save_shards(directory, "params", [arr], tag="s1")
+    sc.write_manifest(directory, "params", [arr], tag="s1")
+    sc.write_commit(directory, "s1")
+    assert sc.read_commit(directory) == "s1"
+    assert sc.exists(directory, "params", "s1")
+
+    loaded = sc.load_shards(directory, "params",
+                            [NamedSharding(mesh, P("model", None))],
+                            tag="s1")
+    np.testing.assert_array_equal(np.asarray(loaded[0]), host)
+
+
+def test_feature_shards_over_remote_scheme(mockfs):
+    """DiskFeatureSet shard loading goes through file_io -> remote shards
+    stream through the registered scheme."""
+    from analytics_zoo_tpu.feature.feature_set import DiskFeatureSet
+
+    rng = np.random.default_rng(1)
+    local = []
+    for i in range(2):
+        x = rng.standard_normal((10, 4)).astype(np.float32)
+        y = rng.integers(0, 2, 10).astype(np.int32)
+        local.append((x, y))
+        import io as _io
+
+        buf = _io.BytesIO()
+        np.savez(buf, x0=x, y0=y)
+        file_io.write_bytes(f"{mockfs}/shards/s{i}.npz", buf.getvalue())
+
+    fs = DiskFeatureSet([f"{mockfs}/shards/s0.npz",
+                         f"{mockfs}/shards/s1.npz"])
+    assert fs.size() == 20
+    batches = list(fs.batches(10, shuffle=False))
+    np.testing.assert_array_equal(batches[0].inputs[0], local[0][0])

@@ -131,3 +131,49 @@ def test_nnestimator_accepts_featureset_and_shard_paths(tmp_path):
     assert nn_model is not None
     nn_model2 = fresh().fit(paths)                    # shard-path list
     assert nn_model2 is not None
+
+
+def test_nnestimator_auto_spill(tmp_path):
+    """When processed samples exceed config.nnframes_spill_bytes, ingest
+    transparently spills to sharded .npz files and streams them
+    (VERDICT r3 next #8) — with identical dataset content and a working
+    end-to-end fit/transform."""
+    from analytics_zoo_tpu.common.nncontext import (ZooConfig, ZooContext,
+                                                    set_nncontext)
+    from analytics_zoo_tpu.feature.feature_set import ShardedFileFeatureSet
+
+    df = _regression_df(n=64)
+    set_nncontext(None)
+    set_nncontext(ZooContext(ZooConfig(nnframes_spill_bytes=1,
+                                       log_every_n_steps=1000)))
+    try:
+        est = NNEstimator(_mlp(), "mse", [4], [1]) \
+            .setBatchSize(16).setMaxEpoch(2)
+        spilled = est._get_dataset(df)
+        assert isinstance(spilled, ShardedFileFeatureSet), type(spilled)
+        assert len(spilled.paths) > 1, "tiny threshold must multi-shard"
+
+        # identical content vs the in-memory path
+        set_nncontext(None)
+        set_nncontext(ZooContext(ZooConfig(log_every_n_steps=1000)))
+        est2 = NNEstimator(_mlp(), "mse", [4], [1])
+        resident = est2._get_dataset(df)
+        a = list(resident.batches(16, shuffle=False))
+        b = list(spilled.batches(16, shuffle=False))
+        assert len(a) == len(b)
+        for ba, bb in zip(a, b):
+            for xa, xb in zip(ba.inputs, bb.inputs):
+                np.testing.assert_array_equal(xa, xb)
+            np.testing.assert_array_equal(ba.targets, bb.targets)
+
+        # end-to-end fit through the spill path
+        set_nncontext(None)
+        set_nncontext(ZooContext(ZooConfig(nnframes_spill_bytes=1,
+                                           log_every_n_steps=1000)))
+        model = NNEstimator(_mlp(), "mse", [4], [1]) \
+            .setBatchSize(16).setMaxEpoch(2).fit(df)
+        out = model.transform(df)
+        assert len(out) == len(df)
+        assert np.isfinite(np.stack(out["prediction"].tolist())).all()
+    finally:
+        set_nncontext(None)

@@ -92,3 +92,66 @@ def test_watermark_trim():
         q.enqueue({"uri": str(i)})
     q.trim(5)
     assert q.stream_len() == 5
+
+
+def test_serving_lifecycle_cli(tmp_path):
+    """The ops-tier lifecycle (init -> start -> status -> serve traffic ->
+    stop) through the real CLI the scripts/ wrappers exec (VERDICT r3
+    next #9), on the file transport across a process boundary."""
+    import os
+    import subprocess
+    import sys
+
+    from analytics_zoo_tpu.serving import (FileStreamQueue, InputQueue,
+                                           OutputQueue)
+    from analytics_zoo_tpu.serving.cli import CONFIG
+
+    workdir = tmp_path / "serving"
+    model_dir = tmp_path / "model"
+    stream_dir = tmp_path / "stream"
+    _tiny_image_model().save_model(str(model_dir))
+
+    # the axon site hook rewrites JAX_PLATFORMS to "axon" inside the test
+    # process; the daemon must be pinned to CPU explicitly
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def cli(*args):
+        return subprocess.run(
+            [sys.executable, "-m", "analytics_zoo_tpu.serving.cli", *args,
+             "--dir", str(workdir)],
+            capture_output=True, text=True, timeout=120, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+    assert cli("init").returncode == 0
+    assert cli("init").returncode == 1          # refuses to overwrite
+    cfg = workdir / CONFIG
+    assert cfg.exists()
+    cfg.write_text(
+        f"model:\n  path: {model_dir}\n"
+        f"data:\n  src: file:{stream_dir}\n  image_shape: 3, 16, 16\n"
+        f"params:\n  batch_size: 4\n  top_n: 2\n")
+
+    assert cli("status").returncode == 3        # not running yet
+    out = cli("start")
+    assert out.returncode == 0, out.stderr + out.stdout
+    try:
+        assert cli("status").returncode == 0
+        assert cli("start").returncode == 1     # double-start refused
+
+        backend = FileStreamQueue(str(stream_dir))
+        rng = np.random.default_rng(0)
+        in_q = InputQueue(backend=backend)
+        for i in range(5):
+            img = rng.integers(0, 255, (16, 16, 3)).astype(np.uint8)
+            in_q.enqueue_image(f"img-{i}", img)
+        out_q = OutputQueue(backend=backend)
+        deadline = time.time() + 60
+        got = {}
+        while len(got) < 5 and time.time() < deadline:
+            got.update(out_q.dequeue())
+            time.sleep(0.2)
+        assert len(got) == 5, f"only {len(got)} results"
+    finally:
+        assert cli("stop").returncode == 0
+    assert cli("status").returncode == 3
+    assert not (workdir / "cluster-serving.pid").exists()

@@ -117,8 +117,10 @@ def test_engine_forced_sharded_checkpoint(tmp_path, monkeypatch):
         saved = jax.tree.map(lambda l: np.asarray(l), trainer.params)
         trainer.save_checkpoint(str(tmp_path))
 
-        assert sc.exists(str(tmp_path), "params")
-        assert sc.exists(str(tmp_path), "optim")
+        tag = sc.read_commit(str(tmp_path))
+        assert tag == "s2", tag
+        assert sc.exists(str(tmp_path), "params", tag)
+        assert sc.exists(str(tmp_path), "optim", tag)
         assert not os.path.exists(tmp_path / "model.npz")
 
         # diverge, then restore: params and step must come back
@@ -140,6 +142,15 @@ def test_engine_forced_sharded_checkpoint(tmp_path, monkeypatch):
         # training resumes from the restored state
         trainer.train(ArrayFeatureSet([x], y), batch_size=32,
                       end_trigger=MaxIteration(3))
+        assert trainer.step == 3
+
+        # overwrite in place: commit moves to the new tag, previous tag's
+        # files are garbage-collected after the commit
+        trainer.save_checkpoint(str(tmp_path))
+        assert sc.read_commit(str(tmp_path)) == "s3"
+        leftover = [f for f in os.listdir(tmp_path) if ".s2." in f]
+        assert not leftover, leftover
+        trainer.load_checkpoint(str(tmp_path))
         assert trainer.step == 3
     finally:
         set_nncontext(None)
