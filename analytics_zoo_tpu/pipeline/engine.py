@@ -33,7 +33,7 @@ from ..common.zoo_trigger import (And, EveryEpoch, MaxEpoch, MaxIteration,
 from ..feature.feature_set import (ArrayFeatureSet, FeatureSet, MiniBatch,
                                    minibatch_len, pad_minibatch,
                                    PrefetchIterator)
-from ..utils import serialization, sharded_checkpoint
+from ..utils import file_io, serialization, sharded_checkpoint
 from ..utils.profiling import ProfilerHook, peak_flops
 
 logger = logging.getLogger("analytics_zoo_tpu.engine")
@@ -815,9 +815,14 @@ class SPMDTrainer:
                  "epoch": np.asarray(self.epoch)})
             sharded_checkpoint.write_commit(directory, tag)
             # post-commit cleanup: earlier tags and any stale flat
-            # checkpoint that would shadow this one on load
+            # checkpoint that would shadow this one on load (file_io:
+            # works on remote checkpoint directories too)
             sharded_checkpoint.gc_stale(directory, list(groups), tag)
-            for fname in os.listdir(directory):
+            try:
+                entries = file_io.listdir(directory)
+            except OSError:
+                entries = []
+            for fname in entries:
                 stale_meta = fname.startswith("meta.s") and \
                     not fname.startswith(f"meta.{tag}.")
                 if stale_meta or fname in ("model.npz",
@@ -825,7 +830,7 @@ class SPMDTrainer:
                                            "optim.npz", "meta.npz",
                                            "meta.npz.treedef"):
                     try:
-                        os.remove(os.path.join(directory, fname))
+                        file_io.remove(os.path.join(directory, fname))
                     except OSError:
                         pass
             logger.info("sharded checkpoint saved to %s @step %d",
@@ -874,7 +879,7 @@ class SPMDTrainer:
         return sharded_checkpoint.exists(directory, "params", tag)
 
     def has_checkpoint(self, directory: str) -> bool:
-        return os.path.exists(os.path.join(directory, "model.npz")) or \
+        return file_io.exists(os.path.join(directory, "model.npz")) or \
             self._sharded_available(directory)
 
     def save_checkpoint(self, directory: Optional[str] = None):
@@ -885,7 +890,7 @@ class SPMDTrainer:
             self._save_checkpoint_sharded(directory)
             return
         if jax.process_index() == 0:
-            os.makedirs(directory, exist_ok=True)
+            file_io.makedirs(directory)
             # write to temp names + atomic rename so a reader (retry path
             # on another process) can never observe a half-written file.
             # Temp names keep the .npz suffix (save_leaves appends it
@@ -906,8 +911,8 @@ class SPMDTrainer:
                 writer(tmp)
                 final = os.path.join(directory, fname)
                 for suffix in sidecars:
-                    os.replace(tmp + suffix, final + suffix)
-                os.replace(tmp, final)
+                    file_io.rename(tmp + suffix, final + suffix)
+                file_io.rename(tmp, final)
             logger.info("checkpoint saved to %s @step %d", directory,
                         self.step)
         self._barrier("zoo_ckpt_save")
@@ -916,13 +921,13 @@ class SPMDTrainer:
         # writer (process 0) must have finished before anyone reads
         self._barrier("zoo_ckpt_load")
         if self._sharded_available(directory) and \
-                not os.path.exists(os.path.join(directory, "model.npz")):
+                not file_io.exists(os.path.join(directory, "model.npz")):
             self._load_checkpoint_sharded(directory)
             return
         blob = serialization.load_pytree(os.path.join(directory, "model.npz"))
         self.set_params(blob["params"], blob.get("state") or {})
         opt_path = os.path.join(directory, "optim.npz")
-        if os.path.exists(opt_path):
+        if file_io.exists(opt_path):
             template = self.tx.init(self.params)
             self.opt_state = self._place_opt_state(
                 serialization.load_leaves(opt_path, template))

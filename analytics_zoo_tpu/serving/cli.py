@@ -168,7 +168,12 @@ def cmd_stop(workdir: str, timeout: float = 10.0) -> int:
     if pid is None:
         print("not running")
         return 0
-    os.kill(pid, signal.SIGTERM)
+    # the daemon may exit between any probe and signal: an already-dead
+    # target is a successful stop, not a crash
+    try:
+        os.kill(pid, signal.SIGTERM)
+    except ProcessLookupError:
+        pass
     deadline = time.time() + timeout
     while time.time() < deadline:
         try:
@@ -177,7 +182,10 @@ def cmd_stop(workdir: str, timeout: float = 10.0) -> int:
             break
         time.sleep(0.1)
     else:
-        os.kill(pid, signal.SIGKILL)
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
     try:
         os.remove(pidfile)
     except OSError:

@@ -1,0 +1,16 @@
+"""analytics-zoo-tpu: a TPU-native rebuild of the Analytics Zoo capability
+surface (reference: SteNicholas/analytics-zoo) on JAX/XLA/Pallas.
+
+Package map (mirrors the reference's ``zoo`` python package, §1 of SURVEY.md):
+  common/     NNContext equivalent: mesh runtime, config, triggers
+  feature/    FeatureSet / ImageSet / TextSet / preprocessing chains
+  pipeline/   keras-style API, autograd, SPMD engine, estimator, nnframes,
+              inference
+  models/     built-in model zoo (recommendation, textclassification, ...)
+  ops/        pallas kernels (flash attention, ...) + tpu-first ops
+  parallel/   mesh / sharding rules / ring attention collectives
+  serving/    cluster-serving equivalent
+  net/        foreign-model ingest (Keras h5, TF SavedModel, ...)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,10 @@
+from .feature import rolling_window, train_val_split, Scaler
+from .forecaster import LSTMForecaster, TCNForecaster
+from .recipe import LSTMRandomRecipe, TCNRandomRecipe, Recipe
+from .search import (AutoForecaster, Choice, GridSearchEngine, RandInt,
+                     RandomSearchEngine, Uniform)
+
+__all__ = ["rolling_window", "train_val_split", "Scaler", "LSTMForecaster",
+           "TCNForecaster", "Recipe", "LSTMRandomRecipe", "TCNRandomRecipe",
+           "AutoForecaster", "Choice", "Uniform", "RandInt",
+           "RandomSearchEngine", "GridSearchEngine"]

@@ -1,0 +1,189 @@
+"""Hyperparameter search over the RayContext runtime.
+
+The reference's AutoML (off-tree ``automl`` branch; SURVEY.md §2.8 build-plan
+item 10) searches forecaster configs with Ray Tune on a RayOnSpark cluster.
+TPU-native rebuild: search-space primitives + random/grid engines that
+dispatch one trial per task onto :class:`analytics_zoo_tpu.ray.RayContext`
+workers (separate processes, CPU-pinned jax), with the driver collecting
+(config, val_loss) pairs and refitting the best config.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+logger = logging.getLogger("analytics_zoo_tpu.automl")
+
+
+# ---------------------------------------------------------------------------
+# search-space primitives (hp.* equivalents)
+# ---------------------------------------------------------------------------
+
+class Choice:
+    def __init__(self, options: Sequence):
+        self.options = list(options)
+
+    def sample(self, rng):
+        return self.options[int(rng.integers(len(self.options)))]
+
+    def grid(self):
+        return self.options
+
+
+class Uniform:
+    def __init__(self, low: float, high: float):
+        self.low, self.high = float(low), float(high)
+
+    def sample(self, rng):
+        return float(rng.uniform(self.low, self.high))
+
+    def grid(self):
+        return [self.low, (self.low + self.high) / 2, self.high]
+
+
+class RandInt:
+    def __init__(self, low: int, high: int):
+        self.low, self.high = int(low), int(high)
+
+    def sample(self, rng):
+        return int(rng.integers(self.low, self.high + 1))
+
+    def grid(self):
+        return list(range(self.low, self.high + 1))
+
+
+def sample_config(space: Dict, rng) -> Dict:
+    return {k: (v.sample(rng) if hasattr(v, "sample") else v)
+            for k, v in space.items()}
+
+
+def grid_configs(space: Dict) -> List[Dict]:
+    keys, values = [], []
+    for k, v in space.items():
+        keys.append(k)
+        values.append(v.grid() if hasattr(v, "grid") else [v])
+    return [dict(zip(keys, combo)) for combo in itertools.product(*values)]
+
+
+# ---------------------------------------------------------------------------
+# trial fn (runs inside a worker process)
+# ---------------------------------------------------------------------------
+
+def run_trial(config: Dict, x_train, y_train, x_val, y_val) -> Dict:
+    """Train one forecaster config; returns {config, val_loss, seconds}."""
+    from .forecaster import build_forecaster
+
+    t0 = time.time()
+    cfg = dict(config)
+    batch_size = int(cfg.pop("batch_size", 32))
+    epochs = int(cfg.pop("epochs", 1))
+    f = build_forecaster(lookback=x_train.shape[1],
+                         feature_dim=x_train.shape[2],
+                         horizon=y_train.shape[1], **cfg)
+    f.fit(x_train, y_train, batch_size=batch_size, epochs=epochs)
+    metrics = f.evaluate(x_val, y_val, batch_size=batch_size)
+    loss = float(metrics["loss"] if isinstance(metrics, dict) else metrics)
+    return {"config": config, "val_loss": loss,
+            "seconds": time.time() - t0}
+
+
+# ---------------------------------------------------------------------------
+# engines
+# ---------------------------------------------------------------------------
+
+class _EngineBase:
+    def __init__(self, ray_ctx=None):
+        self.ray_ctx = ray_ctx
+        self.trials: List[Dict] = []
+
+    def _configs(self, space, num_samples, seed) -> List[Dict]:
+        raise NotImplementedError
+
+    def run(self, space: Dict, data: Tuple, num_samples: int = 4,
+            epochs: int = 1, seed: int = 0) -> Dict:
+        """data = (x_train, y_train, x_val, y_val). Returns the best trial."""
+        x_train, y_train, x_val, y_val = data
+        configs = self._configs(space, num_samples, seed)
+        for c in configs:
+            c.setdefault("epochs", epochs)
+        if self.ray_ctx is not None and not self.ray_ctx.stopped:
+            refs = [self.ray_ctx.remote(run_trial).remote(
+                c, x_train, y_train, x_val, y_val) for c in configs]
+            self.trials = self.ray_ctx.get(refs)
+        else:
+            self.trials = [run_trial(c, x_train, y_train, x_val, y_val)
+                           for c in configs]
+        best = min(self.trials, key=lambda t: t["val_loss"])
+        logger.info("search done: %d trials, best %.5f %s",
+                    len(self.trials), best["val_loss"], best["config"])
+        return best
+
+
+class RandomSearchEngine(_EngineBase):
+    def _configs(self, space, num_samples, seed):
+        rng = np.random.default_rng(seed)
+        return [sample_config(space, rng) for _ in range(num_samples)]
+
+
+class GridSearchEngine(_EngineBase):
+    def _configs(self, space, num_samples, seed):
+        return grid_configs(space)
+
+
+# ---------------------------------------------------------------------------
+# facade
+# ---------------------------------------------------------------------------
+
+class AutoForecaster:
+    """AutoTSTrainer-style facade: search a recipe, refit the winner.
+
+    >>> auto = AutoForecaster(recipe=LSTMRandomRecipe(num_samples=4),
+    ...                       ray_ctx=ctx)
+    >>> pipeline = auto.fit(series, lookback=24, horizon=1)
+    >>> preds = pipeline.predict(x)
+    """
+
+    def __init__(self, recipe, ray_ctx=None, engine: str = "random"):
+        self.recipe = recipe
+        cls = RandomSearchEngine if engine == "random" else GridSearchEngine
+        self.engine = cls(ray_ctx)
+        self.best_trial: Optional[Dict] = None
+        self.forecaster = None
+
+    def fit(self, series: np.ndarray, lookback: int, horizon: int = 1,
+            val_ratio: float = 0.2, seed: int = 0):
+        from .feature import Scaler, rolling_window, train_val_split
+        from .forecaster import build_forecaster
+
+        self.scaler = Scaler()
+        scaled = self.scaler.fit_transform(series)
+        x, y = rolling_window(scaled, lookback, horizon)
+        (x_tr, y_tr), (x_val, y_val) = train_val_split(x, y, val_ratio)
+        self.best_trial = self.engine.run(
+            self.recipe.search_space(), (x_tr, y_tr, x_val, y_val),
+            num_samples=self.recipe.num_samples, epochs=self.recipe.epochs,
+            seed=seed)
+        # refit the winning config on the full window set (driver process)
+        cfg = dict(self.best_trial["config"])
+        batch_size = int(cfg.pop("batch_size", 32))
+        epochs = int(cfg.pop("epochs", 1))
+        self.forecaster = build_forecaster(
+            lookback=lookback, feature_dim=x.shape[2], horizon=horizon,
+            **cfg)
+        self.forecaster.fit(x, y, batch_size=batch_size, epochs=epochs)
+        return self
+
+    def predict(self, x):
+        if self.forecaster is None:
+            raise RuntimeError("call fit() first")
+        return self.scaler.inverse_transform_y(self.forecaster.predict(x))
+
+    def evaluate(self, x, y):
+        if self.forecaster is None:
+            raise RuntimeError("call fit() first")
+        return self.forecaster.evaluate(x, y)

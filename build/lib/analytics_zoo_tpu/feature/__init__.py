@@ -1,0 +1,17 @@
+from .common import (ArrayToTensor, BigDLAdapter, ChainedPreprocessing,
+                     FeatureLabelPreprocessing, FeatureToTupleAdapter,
+                     LambdaPreprocessing, MLlibVectorToTensor, Preprocessing,
+                     Relation, RelationPair, Relations, SampleToMiniBatch,
+                     ScalarToTensor, SeqToMultipleTensors, SeqToTensor,
+                     TensorToSample, ToTuple)
+from .feature_set import (ArrayFeatureSet, FeatureSet, GeneratorFeatureSet,
+                          MiniBatch, PrefetchIterator, Sample, pad_minibatch)
+
+__all__ = ["ArrayFeatureSet", "FeatureSet", "GeneratorFeatureSet",
+           "MiniBatch", "PrefetchIterator", "Sample", "pad_minibatch",
+           "Preprocessing", "ChainedPreprocessing", "LambdaPreprocessing",
+           "ScalarToTensor", "SeqToTensor", "SeqToMultipleTensors",
+           "ArrayToTensor", "MLlibVectorToTensor",
+           "FeatureLabelPreprocessing", "TensorToSample", "ToTuple",
+           "FeatureToTupleAdapter", "BigDLAdapter", "SampleToMiniBatch",
+           "Relation", "RelationPair", "Relations"]

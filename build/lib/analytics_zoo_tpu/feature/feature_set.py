@@ -1,0 +1,552 @@
+"""FeatureSet: the train-time dataset abstraction.
+
+Reference: ``zoo/.../feature/FeatureSet.scala`` — an RDD-backed dataset with
+memory tiers (DRAM / PMEM / DIRECT / DISK_AND_DRAM) feeding per-executor
+MiniBatch iterators.  TPU-native redesign: samples live in host RAM (numpy,
+possibly memory-mapped), a background thread prefetches minibatches, and each
+batch is laid onto the device mesh with ``jax.device_put`` under the batch
+sharding — the host→HBM copy overlaps the previous step's compute, replacing
+the reference's BlockManager fetch phase.
+"""
+
+from __future__ import annotations
+
+import math
+import queue
+import threading
+from typing import (Any, Callable, Dict, Iterator, List, Optional, Sequence,
+                    Tuple)
+
+import numpy as np
+
+
+class Sample:
+    """One (features, labels) record; mirrors BigDL ``Sample`` marshalled via
+    JTensor (pyzoo/zoo/common/utils.py:75)."""
+
+    def __init__(self, features, labels=None):
+        self.features = _as_list(features)
+        self.labels = _as_list(labels) if labels is not None else None
+
+    @staticmethod
+    def from_ndarray(features, labels=None):
+        return Sample(features, labels)
+
+
+def _as_list(x):
+    if isinstance(x, (list, tuple)):
+        return [np.asarray(v) for v in x]
+    return [np.asarray(x)]
+
+
+class MiniBatch(tuple):
+    """(inputs: tuple, targets, sample_weight) — pytree-friendly."""
+    __slots__ = ()
+
+    def __new__(cls, inputs, targets=None, weights=None):
+        return super().__new__(cls, (tuple(inputs), targets, weights))
+
+    @property
+    def inputs(self):
+        return self[0]
+
+    @property
+    def targets(self):
+        return self[1]
+
+    @property
+    def weights(self):
+        return self[2]
+
+
+class FeatureSet:
+    """Base: iterable of minibatches over host-resident data."""
+
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def num_batches(self, batch_size: int, drop_remainder: bool) -> int:
+        n = self.size()
+        return n // batch_size if drop_remainder else math.ceil(n / batch_size)
+
+    def batches(self, batch_size: int, shuffle: bool = False,
+                drop_remainder: bool = True, pad_remainder: bool = False,
+                seed: int = 0) -> Iterator[MiniBatch]:
+        raise NotImplementedError
+
+    def transform(self, preprocessing) -> "FeatureSet":
+        return TransformedFeatureSet(self, preprocessing)
+
+    def __len__(self):
+        return self.size()
+
+    # -- factories (parity with FeatureSet.rdd / ImageSet / python
+    #    zoo.feature.common.FeatureSet) --------------------------------
+    @staticmethod
+    def array(features, labels=None, weights=None) -> "ArrayFeatureSet":
+        return ArrayFeatureSet(features, labels, weights)
+
+    @staticmethod
+    def sample_rdd(samples: Sequence[Sample], **kw) -> "ArrayFeatureSet":
+        return FeatureSet.samples(samples)
+
+    @staticmethod
+    def samples(samples: Sequence[Sample]) -> "ArrayFeatureSet":
+        feats, labels = stack_samples(samples)
+        return ArrayFeatureSet(
+            list(feats) if len(feats) > 1 else feats[0], labels)
+
+    @staticmethod
+    def generator(fn: Callable[[], Iterator], size: int,
+                  batch_size_hint: Optional[int] = None):
+        return GeneratorFeatureSet(fn, size)
+
+    @staticmethod
+    def rdd(data, memory_type: str = "DRAM", **kw) -> "FeatureSet":
+        """Memory-tier factory (parity: ``FeatureSet.rdd``
+        ``feature/FeatureSet.scala:423-455`` with DRAM | PMEM | DIRECT |
+        DISK_AND_DRAM(n)).
+
+        ``data``: a FeatureSet, a sequence of Samples, or for
+        DISK_AND_DRAM a list of ``.npz`` shard paths. PMEM and DIRECT
+        both map to the native host arena (``native/zoo_data.cpp``) —
+        off-GC staging RAM replaces Optane.
+        """
+        mt = str(memory_type).upper()
+        if mt.startswith("DISK_AND_DRAM"):
+            num_slice = 1
+            if "(" in mt:
+                num_slice = int(mt.split("(")[1].rstrip(")"))
+            return DiskFeatureSet(list(data), num_slice=num_slice)
+        if isinstance(data, FeatureSet):
+            fs = data
+        else:
+            fs = FeatureSet.samples(list(data))
+        if mt in ("PMEM", "DIRECT") and isinstance(fs, ArrayFeatureSet):
+            try:
+                return DirectFeatureSet(fs.features, fs.labels, fs.weights)
+            except (ImportError, MemoryError):
+                return fs  # native arena unavailable/full: stay in DRAM
+        return fs
+
+    @staticmethod
+    def disk(paths: Sequence[str], num_slice: int = 1) -> "DiskFeatureSet":
+        return DiskFeatureSet(list(paths), num_slice=num_slice)
+
+    @staticmethod
+    def files(paths: Sequence[str], num_slice: int = 1,
+              columns: Optional[Sequence[str]] = None,
+              label_col: Optional[str] = None,
+              shard_per_host: bool = True) -> "ShardedFileFeatureSet":
+        """Sharded npz/csv/parquet files, striped one stripe per host."""
+        return ShardedFileFeatureSet(
+            list(paths), num_slice=num_slice, columns=columns,
+            label_col=label_col, shard_per_host=shard_per_host)
+
+
+class ArrayFeatureSet(FeatureSet):
+    """In-memory (host-RAM tier) dataset of numpy arrays."""
+
+    def __init__(self, features, labels=None, weights=None):
+        self.features: List[np.ndarray] = [np.asarray(f) for f in (
+            features if isinstance(features, (list, tuple)) else [features])]
+        n = self.features[0].shape[0]
+        for f in self.features:
+            assert f.shape[0] == n, "feature arrays disagree on batch dim"
+        self.labels = None
+        if labels is not None:
+            self.labels = [np.asarray(l) for l in (
+                labels if isinstance(labels, (list, tuple)) else [labels])]
+            for l in self.labels:
+                assert l.shape[0] == n
+        self.weights = np.asarray(weights) if weights is not None else None
+        self._n = n
+
+    def size(self):
+        return self._n
+
+    def batches(self, batch_size, shuffle=False, drop_remainder=True,
+                pad_remainder=False, seed=0):
+        n = self._n
+        idx = np.arange(n)
+        if shuffle:
+            np.random.default_rng(seed).shuffle(idx)
+        end = (n // batch_size) * batch_size if drop_remainder else n
+        for start in range(0, end, batch_size):
+            take = idx[start:start + batch_size]
+            pad = 0
+            if take.shape[0] < batch_size and pad_remainder:
+                pad = batch_size - take.shape[0]
+                take = np.concatenate([take, np.repeat(take[-1:], pad)])
+            xs = tuple(f[take] for f in self.features)
+            ys = None
+            if self.labels is not None:
+                ys = [l[take] for l in self.labels]
+                ys = ys[0] if len(ys) == 1 else tuple(ys)
+            w = np.ones(take.shape[0], np.float32)
+            if self.weights is not None:
+                w = self.weights[take].astype(np.float32)
+            if pad:
+                w[-pad:] = 0.0
+            yield MiniBatch(xs, ys, w)
+
+
+class DirectFeatureSet(ArrayFeatureSet):
+    """Samples staged in the native host arena (off-GC, 64-byte aligned).
+
+    The PMEM/DIRECT tier equivalent (``feature/pmem/NativeArray.scala`` +
+    ``PersistentMemoryAllocator.java:19``): sample bytes live outside the
+    Python heap in one contiguous slab, and batch slices are zero-copy
+    numpy views handed straight to ``jax.device_put``.
+    """
+
+    def __init__(self, features, labels=None, weights=None):
+        from ..utils.native_loader import load_zoo_data
+
+        lib = load_zoo_data()  # raises ImportError when unavailable
+        feats = [np.asarray(f) for f in (
+            features if isinstance(features, (list, tuple)) else [features])]
+        labs = None
+        if labels is not None:
+            labs = [np.asarray(l) for l in (
+                labels if isinstance(labels, (list, tuple)) else [labels])]
+        def aligned(a):  # arena rounds every allocation up to 64 bytes
+            return (a.nbytes + 63) & ~63
+
+        total = sum(aligned(a) for a in feats) + \
+            sum(aligned(a) for a in (labs or []))
+        self._arena = lib.arena(max(total + 64, 4096))
+        staged_feats = [self._arena.store(a).numpy() for a in feats]
+        staged_labs = [self._arena.store(a).numpy() for a in labs] \
+            if labs is not None else None
+        super().__init__(staged_feats, staged_labs, weights)
+
+    memory_type = "DIRECT"
+
+
+class DiskFeatureSet(FeatureSet):
+    """Sliced-epoch dataset over ``.npz`` shards.
+
+    Parity: ``DiskFeatureSet`` / DISK_AND_DRAM(n) (FeatureSet.scala:332)
+    — only ``num_slice`` shards are resident at a time; an epoch streams
+    through all shards. Shards hold arrays ``x0..xK`` (features) and
+    optional ``y0..yK`` (labels).
+    """
+
+    def __init__(self, paths: Sequence[str], num_slice: int = 1):
+        self.paths = list(paths)
+        self.num_slice = max(1, num_slice)
+        self._size_cache: Optional[List[int]] = None
+
+    def _load_shard(self, path: str) -> Dict[str, np.ndarray]:
+        """path -> {'x0'..: features, 'y0'..: labels}; overridable for
+        other on-disk formats (ShardedFileFeatureSet). Paths go through
+        utils.file_io, so hdfs://-style URIs work once a filesystem is
+        registered (Utils/File parity)."""
+        from ..utils import file_io
+        import io as _io
+
+        with np.load(_io.BytesIO(file_io.read_bytes(path))) as z:
+            return {k: z[k] for k in z.files}
+
+    @property
+    def _sizes(self) -> List[int]:
+        if self._size_cache is None:
+            self._size_cache = [self._load_shard(p)["x0"].shape[0]
+                                for p in self.paths]
+        return self._size_cache
+
+    @staticmethod
+    def write_shard(path: str, features, labels=None):
+        """Helper to produce shard files in the expected layout."""
+        feats = features if isinstance(features, (list, tuple)) \
+            else [features]
+        arrays = {f"x{i}": np.asarray(a) for i, a in enumerate(feats)}
+        if labels is not None:
+            labs = labels if isinstance(labels, (list, tuple)) else [labels]
+            arrays.update({f"y{i}": np.asarray(a)
+                           for i, a in enumerate(labs)})
+        np.savez(path, **arrays)
+
+    def size(self):
+        return sum(self._sizes)
+
+    def batches(self, batch_size, shuffle=False, drop_remainder=True,
+                pad_remainder=False, seed=0):
+        order = np.arange(len(self.paths))
+        if shuffle:
+            np.random.default_rng(seed).shuffle(order)
+        def numkey(k):
+            return (k[0], int(k[1:]))
+
+        carry: Optional[List[List[np.ndarray]]] = None  # [xs, ys]
+        groups = [order[s:s + self.num_slice]
+                  for s in range(0, len(order), self.num_slice)]
+        sizes_seen: Dict[int, int] = {}
+        for gi, group in enumerate(groups):
+            feats_acc: Dict[str, List[np.ndarray]] = {}
+            for pi in group:
+                shard = self._load_shard(self.paths[pi])
+                sizes_seen[int(pi)] = int(shard["x0"].shape[0])
+                for k, v in shard.items():
+                    feats_acc.setdefault(k, []).append(v)
+            if self._size_cache is None and \
+                    len(sizes_seen) == len(self.paths):
+                # size() after one epoch costs nothing: sizes were
+                # collected while streaming (no second full read)
+                self._size_cache = [sizes_seen[i]
+                                    for i in range(len(self.paths))]
+            merged = {k: np.concatenate(v) for k, v in feats_acc.items()}
+            xs = [merged[k] for k in sorted(merged, key=numkey)
+                  if k.startswith("x")]
+            ys = [merged[k] for k in sorted(merged, key=numkey)
+                  if k.startswith("y")]
+            if carry is not None:  # remainder samples from the last group
+                xs = [np.concatenate([c, a]) for c, a in zip(carry[0], xs)]
+                if ys:
+                    ys = [np.concatenate([c, a])
+                          for c, a in zip(carry[1], ys)]
+            last = gi == len(groups) - 1
+            n = xs[0].shape[0]
+            # keep the tail for the next group so drop_remainder only
+            # applies once per epoch, matching a flat dataset's count
+            keep = n if last else (n // batch_size) * batch_size
+            carry = None if last else [[a[keep:] for a in xs],
+                                       [a[keep:] for a in ys]]
+            slice_fs = ArrayFeatureSet([a[:keep] for a in xs],
+                                       [a[:keep] for a in ys] if ys
+                                       else None)
+            yield from slice_fs.batches(
+                batch_size, shuffle=shuffle,
+                drop_remainder=drop_remainder,
+                pad_remainder=pad_remainder, seed=seed + gi)
+
+
+class GeneratorFeatureSet(FeatureSet):
+    def __init__(self, fn, size):
+        self.fn = fn
+        self._size = size
+
+    def size(self):
+        return self._size
+
+    def batches(self, batch_size, shuffle=False, drop_remainder=True,
+                pad_remainder=False, seed=0):
+        buf_x, buf_y = [], []
+        for item in self.fn():
+            x, y = item if isinstance(item, tuple) and len(item) == 2 \
+                else (item, None)
+            buf_x.append(x)
+            buf_y.append(y)
+            if len(buf_x) == batch_size:
+                yield _stack_batch(buf_x, buf_y, batch_size)
+                buf_x, buf_y = [], []
+        if buf_x and not drop_remainder:
+            yield _stack_batch(buf_x, buf_y, batch_size if pad_remainder
+                               else len(buf_x), pad=pad_remainder)
+
+
+def stack_samples(samples: Sequence[Sample]):
+    """Stack Samples into (features_tuple, labels); the single shared
+    batching helper (used by FeatureSet.samples and SampleToMiniBatch)."""
+    samples = list(samples)
+    if not samples:
+        raise ValueError("empty sample collection")
+    n_feat = len(samples[0].features)
+    feats = tuple(np.stack([s.features[i] for s in samples])
+                  for i in range(n_feat))
+    labels = None
+    if samples[0].labels is not None:
+        labs = [np.stack([s.labels[i] for s in samples])
+                for i in range(len(samples[0].labels))]
+        labels = labs[0] if len(labs) == 1 else labs
+    return feats, labels
+
+
+def minibatch_len(batch: MiniBatch) -> int:
+    return len(batch.weights) if batch.weights is not None else \
+        len(batch.inputs[0])
+
+
+def pad_minibatch(batch: MiniBatch, target: int) -> MiniBatch:
+    """Pad a MiniBatch to ``target`` samples by repeating the last sample
+    with zero weight. Loss/metrics are weight-aware so the padding does not
+    bias them; note BatchNorm running stats are NOT weight-aware — training
+    batch sizes should be a multiple of the data-parallel size to avoid
+    padded samples entering normalization statistics."""
+    n = minibatch_len(batch)
+    if target <= n:
+        return batch
+    reps = target - n
+
+    def pad(a):
+        a = np.asarray(a)
+        return np.concatenate([a, np.repeat(a[-1:], reps, 0)])
+
+    xs = tuple(pad(x) for x in batch.inputs)
+    ys = batch.targets
+    if ys is not None:
+        ys = [pad(y) for y in ys] if isinstance(ys, (list, tuple)) \
+            else pad(ys)
+    w = batch.weights if batch.weights is not None else \
+        np.ones(n, np.float32)
+    w = np.concatenate([np.asarray(w), np.zeros(reps, np.float32)])
+    return MiniBatch(xs, ys, w)
+
+
+def _stack_batch(buf_x, buf_y, batch_size, pad=False):
+    n = len(buf_x)
+    multi = isinstance(buf_x[0], (list, tuple))
+    if multi:
+        xs = tuple(np.stack([b[i] for b in buf_x])
+                   for i in range(len(buf_x[0])))
+    else:
+        xs = (np.stack(buf_x),)
+    ys = None
+    if buf_y[0] is not None:
+        ys = np.stack(buf_y)
+    batch = MiniBatch(xs, ys, np.ones(n, np.float32))
+    if pad and n < batch_size:
+        batch = pad_minibatch(batch, batch_size)
+    return batch
+
+
+class TransformedFeatureSet(FeatureSet):
+    """Applies a Preprocessing chain per batch on the host, off the hot path
+    when wrapped by the prefetcher."""
+
+    def __init__(self, base: FeatureSet, preprocessing):
+        self.base = base
+        self.preprocessing = preprocessing
+
+    def size(self):
+        return self.base.size()
+
+    def batches(self, *args, **kw):
+        for batch in self.base.batches(*args, **kw):
+            yield self.preprocessing(batch)
+
+
+class ShardedFileFeatureSet(DiskFeatureSet):
+    """Sharded files -> per-host streaming infeed.
+
+    The SURVEY's hardest data-layer problem ((a): Spark-partition ->
+    infeed streaming without host OOM): the reference hides it inside
+    JVM-local MiniBatch iterators over cached RDD partitions
+    (NNEstimator.scala:382 getDataSet + FeatureSet memory tiers). Here
+    file shards play the role of partitions: each HOST keeps only the
+    shards striped to it (``paths[i]`` with ``i % num_processes ==
+    process_index``), an epoch streams ``num_slice`` shards at a time
+    through the DiskFeatureSet machinery, and the engine's
+    ``make_array_from_process_local_data`` path assembles the global batch
+    — so no host ever materializes the dataset (contrast: the round-1/2
+    ``df[col].tolist()`` NNFrames ingest).
+
+    Formats: ``.npz`` (DiskFeatureSet layout), ``.csv`` / ``.parquet``
+    (pandas; ``columns`` selects feature columns, ``label_col`` the label).
+    """
+
+    def __init__(self, paths: Sequence[str], num_slice: int = 1,
+                 columns: Optional[Sequence[str]] = None,
+                 label_col: Optional[str] = None,
+                 shard_per_host: bool = True,
+                 process_index: Optional[int] = None,
+                 num_processes: Optional[int] = None):
+        if shard_per_host:
+            if process_index is None or num_processes is None:
+                import jax
+                process_index = jax.process_index()
+                num_processes = jax.process_count()
+            if num_processes > 1:
+                paths = [p for i, p in enumerate(paths)
+                         if i % num_processes == process_index]
+                if not paths:
+                    raise ValueError(
+                        f"no shards for process {process_index}: provide "
+                        f">= {num_processes} files (one per host)")
+        super().__init__(paths, num_slice=num_slice)
+        self.columns = list(columns) if columns else None
+        self.label_col = label_col
+
+    def _load_shard(self, path: str) -> Dict[str, np.ndarray]:
+        lower = path.lower()
+        if lower.endswith(".npz"):
+            return super()._load_shard(path)
+        import io as _io
+
+        import pandas as pd
+
+        from ..utils import file_io
+
+        buf = _io.BytesIO(file_io.read_bytes(path))
+        if lower.endswith(".parquet") or lower.endswith(".pq"):
+            df = pd.read_parquet(buf)
+        elif lower.endswith(".csv"):
+            df = pd.read_csv(buf)
+        else:
+            raise ValueError(f"unsupported shard format: {path}")
+        cols = self.columns or [c for c in df.columns
+                                if c != self.label_col]
+        out = {"x0": df[cols].to_numpy(np.float32)}
+        if self.label_col is not None and self.label_col in df.columns:
+            out["y0"] = df[self.label_col].to_numpy()
+        return out
+
+
+class PrefetchIterator:
+    """Background-thread prefetch of host minibatches (double buffering the
+    host side; ``jax.device_put`` overlap covers the device side). Replaces
+    the reference's PMEM/DRAM cache tiers + MTSampleToMiniBatch."""
+
+    def __init__(self, it: Iterator, depth: int = 2):
+        self.it = it
+        self.q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self.done = object()
+        self.error = None
+        self._stopped = False
+        self.thread = threading.Thread(target=self._worker, daemon=True)
+        self.thread.start()
+
+    def _worker(self):
+        try:
+            for item in self.it:
+                while not self._stopped:
+                    try:
+                        self.q.put(item, timeout=0.2)
+                        break
+                    except queue.Full:
+                        continue
+                if self._stopped:
+                    return
+        except BaseException as e:  # propagate to consumer
+            self.error = e
+        finally:
+            while not self._stopped:
+                try:
+                    self.q.put(self.done, timeout=0.2)
+                    break
+                except queue.Full:
+                    continue
+
+    def close(self):
+        """Unblock and discard the producer (call when abandoning the
+        iterator mid-stream, e.g. early end-trigger or step failure)."""
+        self._stopped = True
+        try:
+            while True:
+                self.q.get_nowait()
+        except queue.Empty:
+            pass
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._stopped:
+            raise StopIteration
+        item = self.q.get()
+        if item is self.done:
+            if self.error is not None:
+                raise self.error
+            raise StopIteration
+        return item

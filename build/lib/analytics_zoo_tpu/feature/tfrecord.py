@@ -1,0 +1,64 @@
+"""TFRecord reading/writing.
+
+Parity: the reference ingests TFRecords through the
+``org.tensorflow:tensorflow-hadoop`` InputFormat (``tf_dataset.py:456-501``).
+Here the wire format (length ∥ masked-crc32c(length) ∥ payload ∥
+masked-crc32c(payload)) is read directly; a C++ reader (``native/``,
+built via ``make -C native``) handles bulk decode + CRC at memory
+bandwidth, with this pure-python fallback when the shared library is
+absent.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterable, Iterator
+
+from ..utils.crc32c import masked_crc
+
+
+def _native_lib():
+    """ctypes handle to the C++ reader (native/libzoo_data.so), if built."""
+    try:
+        from ..utils.native_loader import load_zoo_data
+        return load_zoo_data()
+    except ImportError:
+        return None
+
+
+def read_tfrecord(path: str, verify_crc: bool = False) -> Iterator[bytes]:
+    """Yield raw record payloads from a TFRecord file."""
+    lib = _native_lib()
+    if lib is not None:
+        yield from lib.read_tfrecord(path, verify_crc)
+        return
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(12)
+            if len(header) < 12:
+                return
+            (length,), (len_crc,) = (struct.unpack("<Q", header[:8]),
+                                     struct.unpack("<I", header[8:]))
+            if verify_crc and masked_crc(header[:8]) != len_crc:
+                raise IOError(f"corrupt TFRecord length crc in {path}")
+            data = f.read(length)
+            if len(data) < length:
+                raise IOError(f"truncated TFRecord in {path}")
+            (data_crc,) = struct.unpack("<I", f.read(4))
+            if verify_crc and masked_crc(data) != data_crc:
+                raise IOError(f"corrupt TFRecord data crc in {path}")
+            yield data
+
+
+def write_tfrecord(path: str, records: Iterable[bytes]) -> int:
+    """Write records in TFRecord framing; returns count."""
+    n = 0
+    with open(path, "wb") as f:
+        for rec in records:
+            header = struct.pack("<Q", len(rec))
+            f.write(header)
+            f.write(struct.pack("<I", masked_crc(header)))
+            f.write(rec)
+            f.write(struct.pack("<I", masked_crc(rec)))
+            n += 1
+    return n

@@ -1,0 +1,3 @@
+from .common import KerasZooModel, ZooModel
+
+__all__ = ["KerasZooModel", "ZooModel"]

@@ -1,0 +1,3 @@
+from .anomaly_detector import AnomalyDetector, FeatureLabelIndex
+
+__all__ = ["AnomalyDetector", "FeatureLabelIndex"]

@@ -1,0 +1,103 @@
+"""ZooModel base.
+
+Parity: ``zoo/.../models/common/ZooModel.scala`` + ``KerasZooModel`` and the
+python mirror ``pyzoo/zoo/models/common/zoo_model.py`` — a built-in model
+owns an internal Keras graph (``self.model``) and forwards the training
+surface; ``saveModel``/``loadModel`` round-trips the whole model.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Optional
+
+import numpy as np
+
+
+class ZooModel:
+    """Base for the built-in model zoo; subclasses set ``self.model`` to a
+    KerasNet built in ``build_model``."""
+
+    model = None
+
+    # -- training surface forwarded to the internal KerasNet -----------
+    def compile(self, optimizer, loss, metrics=None):
+        return self.model.compile(optimizer, loss, metrics)
+
+    def fit(self, x, y=None, batch_size=32, nb_epoch=10,
+            validation_data=None, **kw):
+        return self.model.fit(x, y, batch_size=batch_size, nb_epoch=nb_epoch,
+                              validation_data=validation_data, **kw)
+
+    def evaluate(self, x, y=None, batch_size=32):
+        return self.model.evaluate(x, y, batch_size=batch_size)
+
+    def predict(self, x, batch_size=128, distributed=True):
+        return self.model.predict(x, batch_size=batch_size)
+
+    def predict_classes(self, x, batch_size=128, zero_based_label=True):
+        return self.model.predict_classes(
+            x, batch_size=batch_size, zero_based_label=zero_based_label)
+
+    def set_tensorboard(self, log_dir, app_name):
+        self.model.set_tensorboard(log_dir, app_name)
+
+    def set_checkpoint(self, path, over_write=True, trigger=None):
+        self.model.set_checkpoint(path, over_write=over_write,
+                                  trigger=trigger)
+
+    def set_constant_gradient_clipping(self, min_value, max_value):
+        self.model.set_constant_gradient_clipping(min_value, max_value)
+
+    def set_gradient_clipping_by_l2_norm(self, clip_norm):
+        self.model.set_gradient_clipping_by_l2_norm(clip_norm)
+
+    def get_weights(self):
+        return self.model.get_weights()
+
+    def set_weights(self, weights):
+        self.model.set_weights(weights)
+
+    def summary(self):
+        return self.model.summary()
+
+    # -- persistence ---------------------------------------------------
+    def save_model(self, path, weight_path=None, over_write=False):
+        """Saves the zoo-model wrapper (config) + internal Keras model."""
+        if os.path.exists(path) and not over_write:
+            raise IOError(f"{path} exists; pass over_write=True")
+        os.makedirs(path, exist_ok=True)
+        self.model.save_model(os.path.join(path, "keras"), over_write=True)
+        meta = {"class": type(self).__name__,
+                "module": type(self).__module__,
+                "config": getattr(self, "_zoo_config", {})}
+        with open(os.path.join(path, "zoo_model.pkl"), "wb") as f:
+            pickle.dump(meta, f)
+
+    saveModel = save_model
+
+    @classmethod
+    def load_model(cls, path, weight_path=None):
+        import importlib
+
+        from ..pipeline.api.keras.models import KerasNet
+
+        with open(os.path.join(path, "zoo_model.pkl"), "rb") as f:
+            meta = pickle.load(f)
+        module = importlib.import_module(meta["module"])
+        klass = getattr(module, meta["class"])
+        obj = klass.__new__(klass)
+        obj._zoo_config = dict(meta["config"])
+        for k, v in meta["config"].items():
+            setattr(obj, k, v)
+        obj.model = KerasNet.load_model(os.path.join(path, "keras"))
+        return obj
+
+    def _record_config(self, **kwargs):
+        self._zoo_config = kwargs
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+
+KerasZooModel = ZooModel

@@ -1,0 +1,7 @@
+"""Image model zoo (reference: ``zoo/.../models/image/``)."""
+
+from .common import (ImageConfigure, ImageModel, LabelOutput,
+                     imagenet_preprocess)
+
+__all__ = ["ImageModel", "ImageConfigure", "LabelOutput",
+           "imagenet_preprocess"]

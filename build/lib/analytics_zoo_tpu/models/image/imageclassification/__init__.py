@@ -1,0 +1,3 @@
+from .image_classifier import ImageClassifier, backbones
+
+__all__ = ["ImageClassifier", "backbones"]

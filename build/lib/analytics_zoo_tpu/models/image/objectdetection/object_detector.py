@@ -1,0 +1,146 @@
+"""ObjectDetector model family.
+
+Parity: ``zoo/.../models/image/objectdetection/ObjectDetector.scala`` +
+``Visualizer`` — detection models with preprocessing/postprocessing
+configures and image-set prediction. The detector itself is the TPU-native
+SSD in ``ssd.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ....feature.common import ChainedPreprocessing
+from ....feature.image.image_feature import ImageFeature
+from ....feature.image.image_set import ImageSet
+from ....feature.image.preprocessing import (ImageChannelNormalize,
+                                             ImageMatToTensor, ImageResize,
+                                             ImageSetToSample)
+from ..common import ImageConfigure, ImageModel
+from .ssd import (MultiBoxLoss, build_ssd, detection_output, match_priors)
+
+
+def ssd_preprocess(size: int = 300) -> ChainedPreprocessing:
+    """Resize → normalize → NCHW (the reference SSD preprocessing chain)."""
+    return ChainedPreprocessing([
+        ImageResize(size, size),
+        ImageChannelNormalize(123.0, 117.0, 104.0),
+        ImageMatToTensor(format="NCHW"),
+        ImageSetToSample(),
+    ])
+
+
+class ScaleDetection:
+    """Rescale normalized boxes back to original image size
+    (ScaleDetection.scala parity)."""
+
+    def __call__(self, feature: ImageFeature, rows: np.ndarray):
+        h = feature.get("original_height") or feature.height
+        w = feature.get("original_width") or feature.width
+        rows = np.asarray(rows).copy()
+        rows[:, 2] *= w
+        rows[:, 4] *= w
+        rows[:, 3] *= h
+        rows[:, 5] *= h
+        feature["detection"] = rows
+        return feature
+
+
+class ObjectDetector(ImageModel):
+    """SSD-based detector (ObjectDetector.scala parity).
+
+    ``predict_image_set`` output: per image an (top_k, 6) array of
+    [class, score, x1, y1, x2, y2] in original-image pixels; rows with
+    score <= 0 are padding.
+    """
+
+    def __init__(self, class_num: int = 21, model_name: str = "ssd-300",
+                 image_size: int = 300, base_channels: int = 32,
+                 label_map: Optional[Dict[int, str]] = None,
+                 conf_threshold: float = 0.3, top_k: int = 100):
+        self._record_config(class_num=class_num, model_name=model_name,
+                            image_size=image_size,
+                            base_channels=base_channels,
+                            conf_threshold=conf_threshold, top_k=top_k)
+        self.model, self.priors = build_ssd(class_num, image_size,
+                                            base_channels)
+        self.label_map = label_map or {}
+        self.config = ImageConfigure(pre_processor=ssd_preprocess(
+            image_size))
+        self._detect = jax.jit(
+            lambda preds: detection_output(
+                preds, self.priors, class_num,
+                conf_threshold=conf_threshold, top_k=top_k))
+
+    # -- training --------------------------------------------------------
+    def compile(self, optimizer="sgd", loss=None, metrics=None):
+        return self.model.compile(
+            optimizer, loss or MultiBoxLoss(self.class_num), metrics)
+
+    def encode_targets(self, gt_boxes: Sequence[np.ndarray],
+                       gt_labels: Sequence[np.ndarray],
+                       threshold: float = 0.5) -> np.ndarray:
+        """Host-side target assignment for a batch of ground truths.
+        Boxes are corner-form, normalized to [0,1]; labels are 1-based
+        (0 = background). Returns (B, num_priors, 5)."""
+        return np.stack([
+            match_priors(b, l, self.priors, threshold)
+            for b, l in zip(gt_boxes, gt_labels)])
+
+    # -- inference -------------------------------------------------------
+    def detect(self, images: np.ndarray) -> np.ndarray:
+        """(B,3,S,S) preprocessed images -> (B, top_k, 6) detections in
+        normalized coordinates."""
+        preds = self.model.predict(images, batch_size=len(images))
+        return np.asarray(self._detect(np.asarray(preds)))
+
+    def predict_image_set(self, image_set: ImageSet,
+                          configure: Optional[ImageConfigure] = None,
+                          batch_size: int = 8) -> ImageSet:
+        cfg = configure or self.config
+        # remember the original image + size before the resize (detections
+        # are reported — and visualized — in original pixels)
+        for f in image_set.to_local().features:
+            f["original_height"] = f.height
+            f["original_width"] = f.width
+            f["original_mat"] = f.get_image()
+        data = image_set.transform(cfg.pre_processor)
+        feats = data.to_local().features
+        arrays = np.stack([f.get_sample().features[0] for f in feats])
+        rows = self.detect(arrays)
+        scale = ScaleDetection()
+        for f, r in zip(feats, rows):
+            keep = r[:, 1] > 0
+            f[ImageFeature.predict] = r[keep]
+            scale(f, r[keep])
+        return data
+
+    predictImageSet = predict_image_set
+
+
+def visualize(feature: ImageFeature, label_map: Optional[dict] = None,
+              threshold: float = 0.3,
+              out_key: str = "visualized") -> np.ndarray:
+    """Draw detection boxes on the original image (Visualizer parity)."""
+    import cv2
+
+    base = feature.get("original_mat")
+    if base is None:
+        base = feature.get_image()
+    img = np.ascontiguousarray(base).astype(np.uint8)
+    rows = feature.get("detection")
+    label_map = label_map or {}
+    for row in (rows if rows is not None else []):
+        cls, score, x1, y1, x2, y2 = row[:6]
+        if score < threshold:
+            continue
+        cv2.rectangle(img, (int(x1), int(y1)), (int(x2), int(y2)),
+                      (0, 255, 0), 2)
+        tag = f"{label_map.get(int(cls), int(cls))}: {score:.2f}"
+        cv2.putText(img, tag, (int(x1), max(0, int(y1) - 4)),
+                    cv2.FONT_HERSHEY_SIMPLEX, 0.5, (0, 255, 0), 1)
+    feature[out_key] = img
+    return img

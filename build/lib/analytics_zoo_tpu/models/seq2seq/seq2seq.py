@@ -1,0 +1,345 @@
+"""Seq2seq: generic recurrent encoder + bridge + decoder.
+
+Parity: ``zoo/.../models/seq2seq/{Seq2seq,RNNEncoder,RNNDecoder,Bridge}.scala``
+and ``pyzoo/zoo/models/seq2seq/seq2seq.py``. The encoder emits (sequence
+output, per-layer final states); the optional Bridge maps encoder states to
+decoder initial states (dense / densenonlinear / customized,
+Bridge.scala:50-85); the decoder consumes [decoder_input, init_states]; an
+optional generator maps decoder outputs to the final result; ``infer`` is the
+reference's greedy step-by-step decode loop (Seq2seq.scala:114-160).
+
+TPU design: the reference threads hidden state through BigDL ``Recurrent``
+mutable get/setHiddenState hooks with hand-written backward plumbing
+(RNNEncoder.scala:80-105). Here states are ordinary outputs of a pure
+``lax.scan`` — jax.grad differentiates through encoder→bridge→decoder with no
+custom backward; each layer's input projection is one hoisted MXU matmul.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...pipeline.api.keras.engine.base import (KerasLayer, get_activation_fn,
+                                               init_tensor)
+from ...pipeline.api.keras.engine.graph import Variable
+from ...pipeline.api.keras.models import Model
+from ..common import ZooModel
+
+_STATES_PER_LAYER = {"lstm": 2, "gru": 1, "simplernn": 1}
+_GATES = {"lstm": 4, "gru": 3, "simplernn": 1}
+
+
+def _cell_step(rnn_type, h_states, xt, U, hidden, act, inner):
+    """One timestep. ``h_states``: tuple of per-layer state (lstm: (h, c)).
+
+    Gate orders follow the layer library (keras-1): LSTM [i, f, c, o],
+    GRU [z, r, h].
+    """
+    if rnn_type == "lstm":
+        h_prev, c_prev = h_states
+        z = xt + jnp.matmul(h_prev, U)
+        i = inner(z[:, :hidden])
+        f = inner(z[:, hidden:2 * hidden])
+        g = act(z[:, 2 * hidden:3 * hidden])
+        o = inner(z[:, 3 * hidden:])
+        c = f * c_prev + i * g
+        ht = o * act(c)
+        return (ht, c), ht
+    if rnn_type == "gru":
+        (h_prev,) = h_states
+        zr = xt[:, :2 * hidden] + jnp.matmul(h_prev, U[:, :2 * hidden])
+        z = inner(zr[:, :hidden])
+        r = inner(zr[:, hidden:])
+        hh = act(xt[:, 2 * hidden:] +
+                 jnp.matmul(r * h_prev, U[:, 2 * hidden:]))
+        ht = z * h_prev + (1.0 - z) * hh
+        return (ht,), ht
+    (h_prev,) = h_states
+    ht = act(xt + jnp.matmul(h_prev, U))
+    return (ht,), ht
+
+
+class _RNNCoder(KerasLayer):
+    """Shared machinery: embedding + stacked scan over ``nlayers`` cells."""
+
+    def __init__(self, rnn_type="lstm", nlayers=1, hidden_size=None,
+                 embedding=None, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.rnn_type = str(rnn_type).lower()
+        if self.rnn_type not in _STATES_PER_LAYER:
+            raise ValueError(
+                f"rnn_type must be simplernn | lstm | gru, got {rnn_type}")
+        self.nlayers = int(nlayers)
+        self.hidden_size = int(hidden_size)
+        self.embedding = embedding
+        self.states_per_layer = _STATES_PER_LAYER[self.rnn_type]
+        self.n_states = self.nlayers * self.states_per_layer
+        self.act = get_activation_fn("tanh")
+        self.inner = get_activation_fn("hard_sigmoid")
+
+    @classmethod
+    def initialize(cls, rnn_type, nlayers, hidden_size, embedding=None,
+                   input_shape=None):
+        """Parity: RNNEncoder.initialize / RNNDecoder.initialize
+        (seq2seq.py:70-79)."""
+        return cls(rnn_type, nlayers, hidden_size, embedding=embedding,
+                   input_shape=input_shape)
+
+    def _build_stack(self, rng, feat_dim):
+        gates = _GATES[self.rnn_type]
+        h = self.hidden_size
+        params = {}
+        d = feat_dim
+        for l in range(self.nlayers):
+            r_w, r_u, rng = jax.random.split(rng, 3)
+            b = jnp.zeros((gates * h,))
+            if self.rnn_type == "lstm":
+                b = b.at[h:2 * h].set(1.0)  # forget-gate bias
+            params[f"l{l}"] = {
+                "W": init_tensor(r_w, (d, gates * h)),
+                "U": init_tensor(r_u, (h, gates * h), "orthogonal"),
+                "b": b}
+            d = h
+        return params
+
+    def _embed(self, params, x, training):
+        if self.embedding is None:
+            return x
+        return self.embedding.call(params.get("embedding", {}), x,
+                                   training=training)
+
+    def _run_stack(self, params, x, init_states, collect_last=True):
+        """x: (B, T, D). init_states: list of n_states arrays (B, H) (or
+        None for zeros). Returns (seq_out, final_states list)."""
+        h = self.hidden_size
+        b = x.shape[0]
+        spl = self.states_per_layer
+        finals: List[jnp.ndarray] = []
+        y = x
+        for l in range(self.nlayers):
+            p = params[f"l{l}"]
+            xw = jnp.matmul(y, p["W"].astype(y.dtype)) + \
+                p["b"].astype(y.dtype)
+            U = p["U"].astype(y.dtype)
+            if init_states is None:
+                carry0 = tuple(jnp.zeros((b, h), y.dtype)
+                               for _ in range(spl))
+            else:
+                carry0 = tuple(s.astype(y.dtype) for s in
+                               init_states[l * spl:(l + 1) * spl])
+
+            def cell(carry, xt, U=U):
+                return _cell_step(self.rnn_type, carry, xt, U, h,
+                                  self.act, self.inner)
+
+            xs = jnp.swapaxes(xw, 0, 1)
+            carry, ys = jax.lax.scan(cell, carry0, xs)
+            y = jnp.swapaxes(ys, 0, 1)
+            finals.extend(carry)
+        return y, finals
+
+
+class RNNEncoder(_RNNCoder):
+    """Outputs: [seq_output (B,T,H)] + per-layer final states
+    (lstm: h then c per layer), so ``num_outputs = 1 + nlayers *
+    states_per_layer`` — the reference's T(rnnOutput, T(states))
+    (RNNEncoder.scala:73-80) flattened into graph edges."""
+
+    @property
+    def num_outputs(self):
+        return 1 + self.n_states
+
+    def build(self, rng, input_shape):
+        params = {}
+        feat = input_shape[-1]
+        if self.embedding is not None:
+            r_e, rng = jax.random.split(rng)
+            params["embedding"] = self.embedding.build(r_e, input_shape)
+            feat = self.embedding.compute_output_shape(input_shape)[-1]
+        params.update(self._build_stack(rng, int(feat)))
+        return params
+
+    def call(self, params, x, training=False, **kw):
+        y = self._embed(params, x, training)
+        seq, finals = self._run_stack(params, y, None)
+        return (seq,) + tuple(finals)
+
+    def compute_output_shape(self, s):
+        if self.embedding is not None:
+            s = self.embedding.compute_output_shape(s)
+        seq_shape = (s[0], s[1], self.hidden_size)
+        state_shape = (s[0], self.hidden_size)
+        return [seq_shape] + [state_shape] * self.n_states
+
+
+class RNNDecoder(_RNNCoder):
+    """Inputs: [decoder_input, init_state_1, ..., init_state_N]; output the
+    decoded sequence (B, T, H)."""
+
+    def build(self, rng, input_shape):
+        x_shape = input_shape[0]
+        params = {}
+        feat = x_shape[-1]
+        if self.embedding is not None:
+            r_e, rng = jax.random.split(rng)
+            params["embedding"] = self.embedding.build(r_e, x_shape)
+            feat = self.embedding.compute_output_shape(x_shape)[-1]
+        params.update(self._build_stack(rng, int(feat)))
+        return params
+
+    def call(self, params, inputs, training=False, **kw):
+        x, states = inputs[0], list(inputs[1:])
+        y = self._embed(params, x, training)
+        seq, _ = self._run_stack(params, y, states)
+        return seq
+
+    def compute_output_shape(self, s):
+        x_shape = s[0]
+        if self.embedding is not None:
+            x_shape = self.embedding.compute_output_shape(x_shape)
+        return (x_shape[0], x_shape[1], self.hidden_size)
+
+
+class Bridge(KerasLayer):
+    """Maps encoder final states to decoder initial states.
+
+    Parity: Bridge.scala:50-85 — states are concatenated, passed through one
+    Dense of size ``decoder_hidden_size * n_states`` ("dense": linear,
+    "densenonlinear": tanh, both bias-free), then split back into n_states
+    pieces. "customized" applies a caller-provided layer to the concatenation
+    and splits its output evenly.
+    """
+
+    def __init__(self, bridge_type="dense", decoder_hidden_size=0,
+                 bridge=None, name=None, **kwargs):
+        super().__init__(name=name)
+        self.bridge_type = str(bridge_type).lower()
+        if self.bridge_type not in ("dense", "densenonlinear", "customized"):
+            raise ValueError(
+                "Only support dense | densenonlinear | customized as "
+                f"bridge_type, got {bridge_type}")
+        self.decoder_hidden_size = int(decoder_hidden_size)
+        self.bridge = bridge
+        self.n_states = None  # set by Seq2seq before graph construction
+
+    @classmethod
+    def initialize(cls, bridge_type, decoder_hidden_size):
+        return cls(bridge_type, decoder_hidden_size)
+
+    @classmethod
+    def initialize_from_keras_layer(cls, bridge):
+        return cls("customized", 0, bridge)
+
+    @property
+    def num_outputs(self):
+        assert self.n_states is not None, \
+            "Bridge must be configured by Seq2seq before use"
+        return self.n_states
+
+    def build(self, rng, input_shapes):
+        if not isinstance(input_shapes[0], (list, tuple)):
+            input_shapes = [input_shapes]
+        total_in = sum(int(s[-1]) for s in input_shapes)
+        if self.bridge_type == "customized":
+            cat_shape = (input_shapes[0][0], total_in)
+            return {"bridge": self.bridge.build(rng, cat_shape)}
+        total_out = self.decoder_hidden_size * len(input_shapes)
+        self._annotate(W=("in", "out"))
+        return {"W": init_tensor(rng, (total_in, total_out))}
+
+    def call(self, params, states, training=False, **kw):
+        if not isinstance(states, (list, tuple)):
+            states = [states]
+        cat = jnp.concatenate(list(states), axis=-1)
+        if self.bridge_type == "customized":
+            out = self.bridge.call(params["bridge"], cat, training=training)
+        else:
+            out = jnp.matmul(cat, params["W"].astype(cat.dtype))
+            if self.bridge_type == "densenonlinear":
+                out = jnp.tanh(out)
+        if len(states) == 1:
+            return out
+        return tuple(jnp.split(out, len(states), axis=-1))
+
+    def compute_output_shape(self, input_shapes):
+        if not isinstance(input_shapes[0], (list, tuple)):
+            input_shapes = [input_shapes]
+        n = len(input_shapes)
+        if self.bridge_type == "customized":
+            total_in = sum(int(s[-1]) for s in input_shapes)
+            out = self.bridge.compute_output_shape(
+                (input_shapes[0][0], total_in))
+            per = int(out[-1]) // n
+            shapes = [(s[0], per) for s in input_shapes]
+        else:
+            shapes = [(s[0], self.decoder_hidden_size) for s in input_shapes]
+        return shapes[0] if n == 1 else shapes
+
+
+class Seq2seq(ZooModel):
+    """Arguments (seq2seq.py:158-183): encoder, decoder, input_shape (no
+    batch dim), output_shape, optional bridge and generator layers."""
+
+    def __init__(self, encoder, decoder, input_shape, output_shape,
+                 bridge=None, generator=None):
+        if input_shape is None or output_shape is None:
+            raise TypeError("input_shape and output_shape cannot be None")
+        self.encoder = encoder
+        self.decoder = decoder
+        self.input_shape_ = list(input_shape)
+        self.output_shape_ = list(output_shape)
+        self.bridge = bridge
+        self.generator = generator
+        self._record_config(input_shape_=self.input_shape_,
+                            output_shape_=self.output_shape_)
+        self.model = self.build_model()
+
+    def build_model(self):
+        from ...pipeline.api.keras.engine.base import Input
+
+        encoder_input = Input(shape=tuple(self.input_shape_),
+                              name="encoder_input")
+        decoder_input = Input(shape=tuple(self.output_shape_),
+                              name="decoder_input")
+        enc_outs = self.encoder(encoder_input)
+        states = list(enc_outs[1:])
+        if self.bridge is not None:
+            self.bridge.n_states = len(states)
+            mapped = self.bridge(states)
+            states = list(mapped) if isinstance(mapped, tuple) else [mapped]
+        dec_out = self.decoder([decoder_input] + states)
+        out = self.generator(dec_out) if self.generator is not None \
+            else dec_out
+        return Model([encoder_input, decoder_input], out)
+
+    def infer(self, input, start_sign, max_seq_len=30, stop_sign=None,
+              build_output=None):
+        """Greedy decode (Seq2seq.scala:114-160).
+
+        * input: (T_in, feat) or (1, T_in, feat) encoder input.
+        * start_sign: (feat,) tensor fed as the first decoder step.
+        * stop_sign: stop early when the newest prediction matches.
+        * build_output: optional callable mapping the model output sequence
+          (e.g. a Dense over hidden) before selecting the last timestep.
+
+        Returns the decoded sequence (1, T_out, ...) including start_sign.
+        """
+        input = np.asarray(input, np.float32)
+        if input.ndim == len(self.input_shape_):
+            input = input[None]
+        start = np.asarray(start_sign, np.float32)[None, None]  # (1,1,feat)
+        cur = start
+        for _ in range(max_seq_len):
+            pred_seq = self.model.predict([input, cur], batch_size=1)
+            if build_output is not None:
+                pred_seq = build_output(pred_seq)
+            nxt = np.asarray(pred_seq)[:, -1:]
+            cur = np.concatenate([cur, nxt], axis=1)
+            if stop_sign is not None and np.allclose(
+                    nxt[0, 0], np.asarray(stop_sign, np.float32), atol=1e-8):
+                break
+        return cur

@@ -1,0 +1,30 @@
+"""TextMatcher base.
+
+Parity: ``zoo/.../models/textmatching/TextMatcher.scala`` — common surface
+for text-matching models: query length, vocab/embedding configuration and the
+'ranking' vs 'classification' target mode.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..common import ZooModel
+
+
+class TextMatcher(ZooModel):
+    TARGET_MODES = ("ranking", "classification")
+
+    def __init__(self, text1_length, vocab_size, embed_size=300,
+                 embed_weights=None, train_embed=True, target_mode="ranking"):
+        if target_mode not in self.TARGET_MODES:
+            raise ValueError(
+                f"target_mode must be one of {self.TARGET_MODES}, "
+                f"got {target_mode}")
+        self.text1_length = int(text1_length)
+        self.vocab_size = int(vocab_size)
+        self.embed_size = int(embed_size)
+        self.embed_weights = None if embed_weights is None else \
+            np.asarray(embed_weights, np.float32)
+        self.train_embed = bool(train_embed)
+        self.target_mode = target_mode

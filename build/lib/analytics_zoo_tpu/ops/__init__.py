@@ -1,0 +1,3 @@
+from .attention import attention_reference, flash_attention
+
+__all__ = ["attention_reference", "flash_attention"]

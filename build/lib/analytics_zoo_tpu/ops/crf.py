@@ -1,0 +1,110 @@
+"""Linear-chain CRF ops: log-likelihood (forward algorithm) + Viterbi decode.
+
+The reference's NER head *is* a CRF — ``pyzoo/zoo/tfpark/text/keras/ner.py``
+builds nlp_architect's ``NERCRF`` and ``pos_tagging.py`` offers
+``classifier='crf'``. The reference delegates the math to an external
+package; here it is ~100 lines of jax built on ``lax.scan`` (static-shape,
+compiler-friendly time recursion — the TPU-idiomatic form of the dynamic
+loops the TF implementation uses).
+
+Conventions: ``unary`` (B, L, E) per-token emission scores (logits, NOT
+probabilities), ``trans`` (E, E) with ``trans[i, j]`` the score of moving
+from tag ``i`` to tag ``j``, ``mask`` (B, L) in {0,1} with all real tokens
+prefixing the pad tail (the first token must be real).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _time_major(x):
+    return jnp.swapaxes(x, 0, 1)
+
+
+def crf_sequence_score(unary, tags, trans, mask=None):
+    """Score of a given tag path: sum of chosen emissions + transitions."""
+    unary = unary.astype(jnp.float32)
+    b, l, e = unary.shape
+    tags = tags.astype(jnp.int32)
+    if mask is None:
+        mask = jnp.ones((b, l), jnp.float32)
+    mask = mask.astype(jnp.float32)
+    emit = jnp.take_along_axis(unary, tags[..., None], axis=-1)[..., 0]
+    score = (emit * mask).sum(-1)
+    if l > 1:
+        t = trans[tags[:, :-1], tags[:, 1:]]           # (B, L-1)
+        pair_mask = mask[:, :-1] * mask[:, 1:]
+        score = score + (t * pair_mask).sum(-1)
+    return score
+
+
+def crf_log_normalizer(unary, trans, mask=None):
+    """log Z per sequence via the forward algorithm (scan over time)."""
+    unary = unary.astype(jnp.float32)
+    b, l, e = unary.shape
+    if mask is None:
+        mask = jnp.ones((b, l), jnp.float32)
+    mask = mask.astype(jnp.float32)
+    alpha0 = unary[:, 0]                               # (B, E)
+
+    def step(alpha, inp):
+        u_t, m_t = inp                                 # (B,E), (B,)
+        scores = alpha[:, :, None] + trans[None] + u_t[:, None, :]
+        new = jax.scipy.special.logsumexp(scores, axis=1)
+        alpha = jnp.where(m_t[:, None] > 0, new, alpha)
+        return alpha, None
+
+    if l > 1:
+        xs = (_time_major(unary[:, 1:]), _time_major(mask[:, 1:]))
+        alpha0, _ = jax.lax.scan(step, alpha0, xs)
+    return jax.scipy.special.logsumexp(alpha0, axis=-1)
+
+
+def crf_log_likelihood(unary, tags, trans, mask=None):
+    """Per-sequence log p(tags | unary) — the CRF training objective."""
+    return (crf_sequence_score(unary, tags, trans, mask)
+            - crf_log_normalizer(unary, trans, mask))
+
+
+def crf_decode(unary, trans, mask=None):
+    """Viterbi: returns (best_tags (B, L) int32, best_score (B,)).
+
+    Masked (pad) positions repeat the last real tag through the identity
+    backpointer; callers that care should re-mask the output.
+    """
+    unary = unary.astype(jnp.float32)
+    b, l, e = unary.shape
+    if mask is None:
+        mask = jnp.ones((b, l), jnp.float32)
+    mask = mask.astype(jnp.float32)
+    alpha0 = unary[:, 0]
+    identity_bp = jnp.broadcast_to(jnp.arange(e, dtype=jnp.int32)[None],
+                                   (b, e))
+
+    def fwd(alpha, inp):
+        u_t, m_t = inp
+        scores = alpha[:, :, None] + trans[None]       # (B, Eprev, Enext)
+        bp = scores.argmax(axis=1).astype(jnp.int32)   # (B, Enext)
+        new = scores.max(axis=1) + u_t
+        alpha = jnp.where(m_t[:, None] > 0, new, alpha)
+        bp = jnp.where(m_t[:, None] > 0, bp, identity_bp)
+        return alpha, bp
+
+    if l == 1:
+        best = alpha0.argmax(-1).astype(jnp.int32)
+        return best[:, None], alpha0.max(-1)
+
+    xs = (_time_major(unary[:, 1:]), _time_major(mask[:, 1:]))
+    alpha, bps = jax.lax.scan(fwd, alpha0, xs)         # bps: (L-1, B, E)
+    last = alpha.argmax(-1).astype(jnp.int32)          # (B,)
+    best_score = alpha.max(-1)
+
+    def back(tag, bp):
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        return prev, prev
+
+    _, prevs = jax.lax.scan(back, last, bps, reverse=True)  # (L-1, B)
+    tags = jnp.concatenate([_time_major(prevs), last[:, None]], axis=1)
+    return tags.astype(jnp.int32), best_score

@@ -1,0 +1,58 @@
+"""Mesh construction helpers.
+
+Replaces the reference's cluster topology handling (Spark executor/core
+counts, ``EngineRef.getNodeNumber/getCoreNumber`` in Topology.scala:1102)
+with explicit ``jax.sharding.Mesh`` axes:
+
+  data   — pure data parallelism (gradient psum)
+  pipe   — pipeline stages (ppermute microbatch handoff)
+  seq    — sequence/context parallelism (ring attention)
+  expert — expert parallelism (MoE all_to_all)
+  model  — tensor parallelism (Megatron-style column/row sharding)
+
+On real hardware ``mesh_utils.create_device_mesh`` lays axes onto the ICI
+torus so the fastest-varying axis (model) gets nearest-neighbor links.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+AXES = ("data", "pipe", "seq", "expert", "model")
+
+
+def make_mesh(data: int = -1, pipe: int = 1, seq: int = 1, expert: int = 1,
+              model: int = 1, devices: Optional[Sequence] = None):
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(devices) if devices is not None else jax.devices()
+    n = len(devices)
+    fixed = pipe * seq * expert * model
+    if data <= 0:
+        data = n // fixed
+    shape = (data, pipe, seq, expert, model)
+    if int(np.prod(shape)) != n:
+        raise ValueError(f"mesh {dict(zip(AXES, shape))} != {n} devices")
+    try:
+        from jax.experimental import mesh_utils
+        arr = mesh_utils.create_device_mesh(shape, devices=devices)
+    except Exception:
+        arr = np.array(devices).reshape(shape)
+    return Mesh(arr, AXES)
+
+
+def batch_spec():
+    """Batch dim sharded over every non-model axis (data-parallel batch
+    split; pipe/seq/expert axes also consume batch when unused for their
+    primary role is not the case — batch rides 'data' only when others
+    are active)."""
+    from jax.sharding import PartitionSpec as P
+    return P("data")
+
+
+def replicated():
+    from jax.sharding import PartitionSpec as P
+    return P()

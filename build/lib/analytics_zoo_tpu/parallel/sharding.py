@@ -1,0 +1,71 @@
+"""Parameter sharding rules.
+
+The reference shards nothing but the optimizer update (AllReduceParameter
+blocks, Topology.scala:1119-1143); model state is replicated per core. Here
+layers annotate params with *logical axes* (``KerasLayer._annotate``:
+Dense kernel ('in','out'), Embedding table ('vocab','embed'), transformer
+qkv ('embed','heads') ...) and this module maps logical axes → mesh axes,
+yielding a pytree of ``NamedSharding`` that the SPMD engine applies at init.
+XLA then inserts the matching collectives (allreduce for row-parallel
+matmuls, allgather where needed) — the Megatron recipe without hand-written
+communication.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+# Default logical-axis → mesh-axis mapping (Megatron-style TP):
+DEFAULT_RULES: Dict[str, Optional[str]] = {
+    "heads": "model",     # qkv column-parallel
+    "mlp": "model",       # mlp-in column-parallel / mlp-out row-parallel
+    "vocab": "model",     # embedding vocab-sharded
+    "embed": None,        # hidden dim replicated
+    "in": None,
+    "out": None,
+    "kv": None,
+    "expert": "expert",   # stacked expert weights over the EP axis
+    "stage": "pipe",      # stacked pipeline-stage weights over the PP axis
+}
+
+FSDP_RULES = dict(DEFAULT_RULES, embed="data")  # fully-sharded variant
+
+
+def make_param_sharding_fn(graph, mesh, rules: Optional[Dict] = None):
+    """Build a ``params -> pytree of NamedSharding`` function for a
+    GraphFunction whose layers carry axis annotations."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rules = dict(DEFAULT_RULES, **(rules or {}))
+    annotations: Dict[str, Dict[str, tuple]] = {
+        layer.name: layer.param_axes() for layer in graph.layers}
+
+    def spec_for(layer_name, path):
+        axes = annotations.get(layer_name, {})
+        key = "/".join(path)
+        logical = axes.get(key)
+        if logical is None:
+            return P()
+        mesh_axes = []
+        for ax in logical:
+            mapped = rules.get(ax) if ax is not None else None
+            mesh_axes.append(mapped if mapped in mesh.axis_names else None)
+        # a dim can only be sharded if divisible; leave validation to runtime
+        return P(*mesh_axes)
+
+    def sharding_fn(params):
+        def walk(subtree, layer_name, path):
+            if isinstance(subtree, dict):
+                return {k: walk(v, layer_name, path + [k])
+                        for k, v in subtree.items()}
+            return NamedSharding(mesh, spec_for(layer_name, path))
+
+        return {layer_name: walk(sub, layer_name, [])
+                for layer_name, sub in params.items()}
+
+    return sharding_fn
+
+
+def shard_params(params, sharding_fn):
+    import jax
+    return jax.device_put(params, sharding_fn(params))

@@ -1,0 +1,421 @@
+"""Autograd: symbolic math over Variables + CustomLoss + Lambda + Parameter.
+
+Parity surface: ``zoo/.../pipeline/api/autograd/`` — ``AutoGrad`` math
+(math.scala:32: abs/sum/clip/square/sqrt/maximum/mm/batchDot/l2Normalize/
+erf/...), ``Variable`` operators (Variable.scala:365-378), ``CustomLoss``
+(CustomLoss.scala:29-66), ``Lambda`` (Lambda.scala:49), ``Parameter``
+(KerasParameter.scala:31,73) — and the python mirror
+``pyzoo/zoo/pipeline/api/autograd.py``.
+
+Every op is dual-dispatch: on a :class:`Variable` it extends the symbolic
+graph; on a concrete array it evaluates eagerly with jnp. A loss written
+against this API therefore works both as a traced graph node and inside a
+jitted train step — there is no separate "autograd engine", it is all one
+XLA program (the reference needed a BigDL-module interpreter for this).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .keras.engine.base import KerasLayer
+from .keras.engine.graph import Node, Variable
+
+
+class Lambda(KerasLayer):
+    """Wrap an arbitrary jnp function as a layer (Lambda.scala:49)."""
+
+    def __init__(self, function: Callable, output_shape=None,
+                 input_shape=None, name=None, num_outputs: int = 1,
+                 **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.function = function
+        self.output_shape_spec = output_shape
+        self.num_outputs = num_outputs
+
+    def call(self, params, x, training=False, **kw):
+        if isinstance(x, (list, tuple)):
+            return self.function(*x)
+        return self.function(x)
+
+    def compute_output_shape(self, input_shape):
+        if self.output_shape_spec is not None:
+            spec = self.output_shape_spec
+            if self.num_outputs > 1:
+                if not (isinstance(spec, (list, tuple)) and
+                        len(spec) == self.num_outputs and
+                        all(isinstance(s, (list, tuple)) for s in spec)):
+                    raise ValueError(
+                        "num_outputs > 1 needs output_shape as a list of "
+                        f"{self.num_outputs} shape tuples")
+                return [tuple(s) if s and s[0] is None
+                        else (None,) + tuple(s) for s in spec]
+            return tuple(spec) if spec and spec[0] is None \
+                else (None,) + tuple(spec)
+        # infer via abstract evaluation
+        shapes = input_shape if isinstance(input_shape, list) \
+            else [input_shape]
+
+        def run(*arrays):
+            return self.function(*arrays) if len(arrays) > 1 \
+                else self.function(arrays[0])
+
+        avals = [jax.ShapeDtypeStruct(tuple(2 if d is None else d
+                                            for d in s), jnp.float32)
+                 for s in shapes]
+        out = jax.eval_shape(run, *avals)
+        out_shape = out.shape if hasattr(out, "shape") else \
+            [o.shape for o in out]
+        if isinstance(out_shape, tuple):
+            return (None,) + tuple(out_shape[1:])
+        return [(None,) + tuple(s[1:]) for s in out_shape]
+
+    def get_config(self):  # functions aren't json-serializable; pickle is ok
+        return dict(super().get_config())
+
+
+class ParameterLayer(KerasLayer):
+    """A trainable free tensor (KerasParameter.scala:31)."""
+
+    def __init__(self, shape, init_weight=None, init_method="glorot_uniform",
+                 trainable=True, name=None, **kwargs):
+        super().__init__(name=name)
+        self.shape = tuple(int(s) for s in shape)
+        self.init_weight = init_weight
+        self.init_method = init_method
+        self.trainable = trainable
+
+    def build(self, rng, input_shape):
+        from .keras.engine.base import init_tensor
+        if self.init_weight is not None:
+            w = jnp.asarray(self.init_weight, jnp.float32)
+        else:
+            w = init_tensor(rng, self.shape, self.init_method)
+        return {"weight": w}
+
+    def call(self, params, x, training=False, **kw):
+        w = params["weight"]
+        return w if self.trainable else jax.lax.stop_gradient(w)
+
+    def compute_output_shape(self, input_shape):
+        return self.shape
+
+
+def Parameter(shape, init_weight=None, init_method="glorot_uniform",
+              trainable=True, name=None) -> Variable:
+    layer = ParameterLayer(shape, init_weight, init_method, trainable,
+                           name=name)
+    node = Node(layer, [])
+    return Variable(node, layer.shape)
+
+
+# ---------------------------------------------------------------------------
+# dual-dispatch op machinery
+# ---------------------------------------------------------------------------
+
+def _is_sym(x):
+    return isinstance(x, Variable)
+
+
+def _apply(fn: Callable, shape_fn: Callable, *args, op_name="op"):
+    """args: mix of Variables and constants. Symbolic if any Variable."""
+    if any(_is_sym(a) for a in args):
+        sym_inputs = [a for a in args if _is_sym(a)]
+
+        def call_fn(*concrete_sym):
+            it = iter(concrete_sym)
+            full = [next(it) if _is_sym(a) else a for a in args]
+            return fn(*full)
+
+        layer = Lambda(call_fn, name=None)
+        layer.name = layer.name.replace("lambda", op_name)
+        in_shapes = [v.shape for v in sym_inputs]
+        out_shape = shape_fn([s for s in in_shapes]) if shape_fn else \
+            layer.compute_output_shape(
+                in_shapes if len(in_shapes) > 1 else in_shapes[0])
+        node = Node(layer, sym_inputs)
+        return Variable(node, out_shape)
+    return fn(*args)
+
+
+def _broadcast_shape(shapes):
+    out = ()
+    for s in shapes:
+        s = tuple(s)
+        r = []
+        for a, b in zip(reversed(out), reversed(s)):
+            if a is None or b is None:
+                r.append(None)
+            else:
+                r.append(max(a, b))
+        longer = out if len(out) > len(s) else s
+        out = tuple(longer[:len(longer) - len(r)]) + tuple(reversed(r))
+    return out
+
+
+def _binary_op(a, b, mode):
+    fns = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+           "div": jnp.divide, "pow": jnp.power}
+    return _apply(fns[mode], _broadcast_shape_of_args, a, b, op_name=mode)
+
+
+def _broadcast_shape_of_args(in_shapes):
+    return _broadcast_shape(in_shapes)
+
+
+def _unary(fn, name):
+    def op(x):
+        return _apply(fn, lambda s: tuple(s[0]), x, op_name=name)
+
+    op.__name__ = name
+    return op
+
+
+neg = _unary(jnp.negative, "neg")
+abs = _unary(jnp.abs, "abs")  # noqa: A001 - parity with AutoGrad.abs
+square = _unary(jnp.square, "square")
+sqrt = _unary(jnp.sqrt, "sqrt")
+exp = _unary(jnp.exp, "exp")
+log = _unary(jnp.log, "log")
+erf = _unary(jax.lax.erf, "erf")
+softsign = _unary(jax.nn.soft_sign, "softsign")
+softplus = _unary(jax.nn.softplus, "softplus")
+relu = _unary(jax.nn.relu, "relu")
+sigmoid = _unary(jax.nn.sigmoid, "sigmoid")
+tanh = _unary(jnp.tanh, "tanh")
+
+
+def _reduced_shape(shape, axis, keepdims):
+    if axis is None:
+        return (None,) if not keepdims else tuple(1 for _ in shape)
+    axis = axis if axis >= 0 else len(shape) + axis
+    if keepdims:
+        return tuple(1 if i == axis else d for i, d in enumerate(shape))
+    return tuple(d for i, d in enumerate(shape) if i != axis)
+
+
+def sum(x, axis=0, keepdims=False):  # noqa: A001 - parity AutoGrad.sum
+    return _apply(lambda a: jnp.sum(a, axis=axis, keepdims=keepdims),
+                  lambda s: _reduced_shape(s[0], axis, keepdims), x,
+                  op_name="sum")
+
+
+def mean(x, axis=0, keepdims=False):
+    return _apply(lambda a: jnp.mean(a, axis=axis, keepdims=keepdims),
+                  lambda s: _reduced_shape(s[0], axis, keepdims), x,
+                  op_name="mean")
+
+
+def maximum(x, y):
+    return _apply(jnp.maximum, _broadcast_shape_of_args, x, y,
+                  op_name="maximum")
+
+
+def minimum(x, y):
+    return _apply(jnp.minimum, _broadcast_shape_of_args, x, y,
+                  op_name="minimum")
+
+
+def clip(x, min_value, max_value):
+    return _apply(lambda a: jnp.clip(a, min_value, max_value),
+                  lambda s: tuple(s[0]), x, op_name="clip")
+
+
+def pow(x, a):  # noqa: A001
+    return _binary_op(x, a, "pow")
+
+
+def epsilon():
+    return 1e-7
+
+
+def mm(x, y, axes=None):
+    """Batched matmul with optional contraction axes (AutoGrad.mm)."""
+
+    def fn(a, b):
+        if axes is None:
+            return jnp.matmul(a, b)
+        ax, bx = axes
+        return jax.lax.dot_general(
+            a, b, (((ax,), (bx,)),
+                   (tuple(range(0, 0)), tuple(range(0, 0)))))
+
+    def shape_fn(shapes):
+        sa, sb = shapes
+        if axes is None:
+            return tuple(sa[:-1]) + (sb[-1],)
+        ax = axes[0] if axes[0] >= 0 else len(sa) + axes[0]
+        bx = axes[1] if axes[1] >= 0 else len(sb) + axes[1]
+        return tuple(d for i, d in enumerate(sa) if i != ax) + \
+            tuple(d for i, d in enumerate(sb) if i != bx)
+
+    return _apply(fn, shape_fn, x, y, op_name="mm")
+
+
+def batch_dot(x, y, axes=(2, 2), normalize=False):
+    """Batch dot over given axes (AutoGrad.batchDot); inputs (B, ..., D)."""
+
+    def fn(a, b):
+        if normalize:
+            a = a / jnp.maximum(
+                jnp.linalg.norm(a, axis=axes[0], keepdims=True), 1e-12)
+            b = b / jnp.maximum(
+                jnp.linalg.norm(b, axis=axes[1], keepdims=True), 1e-12)
+        return jax.lax.dot_general(
+            a, b, (((axes[0],), (axes[1],)), ((0,), (0,))))
+
+    def shape_fn(shapes):
+        sa, sb = shapes
+        ax = axes[0] if axes[0] >= 0 else len(sa) + axes[0]
+        bx = axes[1] if axes[1] >= 0 else len(sb) + axes[1]
+        return (sa[0],) + tuple(d for i, d in enumerate(sa)
+                                if i not in (0, ax)) + \
+            tuple(d for i, d in enumerate(sb) if i not in (0, bx))
+
+    return _apply(fn, shape_fn, x, y, op_name="batch_dot")
+
+
+batchDot = batch_dot
+
+
+def l2_normalize(x, axis=-1):
+    return _apply(
+        lambda a: a / jnp.maximum(jnp.linalg.norm(a, axis=axis,
+                                                  keepdims=True), 1e-12),
+        lambda s: tuple(s[0]), x, op_name="l2_normalize")
+
+
+l2Normalize = l2_normalize
+
+
+def stack(inputs, axis=1):
+    def fn(*arrays):
+        return jnp.stack(arrays, axis=axis)
+
+    def shape_fn(shapes):
+        s = list(shapes[0])
+        ax = axis if axis >= 0 else len(s) + axis + 1
+        s.insert(ax, len(shapes))
+        return tuple(s)
+
+    return _apply(fn, shape_fn, *inputs, op_name="stack")
+
+
+def concatenate(inputs, axis=-1):
+    def fn(*arrays):
+        return jnp.concatenate(arrays, axis=axis)
+
+    def shape_fn(shapes):
+        s = list(shapes[0])
+        ax = axis if axis >= 0 else len(s) + axis
+        total = 0
+        for sh in shapes:
+            if sh[ax] is None:
+                total = None
+                break
+            total += sh[ax]
+        s[ax] = total
+        return tuple(s)
+
+    return _apply(fn, shape_fn, *inputs, op_name="concat")
+
+
+def expand_dims(x, axis):
+    def shape_fn(shapes):
+        s = list(shapes[0])
+        ax = axis if axis >= 0 else len(s) + axis + 1
+        s.insert(ax, 1)
+        return tuple(s)
+
+    return _apply(lambda a: jnp.expand_dims(a, axis), shape_fn, x,
+                  op_name="expand_dims")
+
+
+def squeeze(x, dim):
+    def shape_fn(shapes):
+        s = shapes[0]
+        d = dim if dim >= 0 else len(s) + dim
+        return tuple(v for i, v in enumerate(s) if i != d)
+
+    return _apply(lambda a: jnp.squeeze(a, dim), shape_fn, x,
+                  op_name="squeeze")
+
+
+def index_select(x, dim, index):
+    def shape_fn(shapes):
+        s = shapes[0]
+        d = dim if dim >= 0 else len(s) + dim
+        return tuple(v for i, v in enumerate(s) if i != d)
+
+    return _apply(lambda a: jax.lax.index_in_dim(a, index, dim,
+                                                 keepdims=False),
+                  shape_fn, x, op_name="index_select")
+
+
+def contiguous(x):
+    return x
+
+
+def _slice_dim(x, dim, start_index, length):
+    def shape_fn(shapes):
+        s = list(shapes[0])
+        d = dim if dim >= 0 else len(s) + dim
+        s[d] = length
+        return tuple(s)
+
+    return _apply(lambda a: jax.lax.slice_in_dim(
+        a, start_index, start_index + length, axis=dim), shape_fn, x,
+        op_name="slice")
+
+
+def _slice_variable(x, key):
+    def fn(a):
+        return a[key]
+
+    def shape_fn(shapes):
+        s = shapes[0]
+        probe = np.zeros(tuple(2 if d is None else d for d in s),
+                         np.float32)[key]
+        out = list(probe.shape)
+        if s[0] is None and len(out) > 0:
+            out[0] = None
+        return tuple(out)
+
+    return _apply(fn, shape_fn, x, op_name="getitem")
+
+
+# ---------------------------------------------------------------------------
+# CustomLoss (CustomLoss.scala:29-66)
+# ---------------------------------------------------------------------------
+
+class CustomLoss:
+    """Build a loss from an autograd expression ``fn(y_true, y_pred)``.
+
+    Because ops are dual-dispatch, the same expression evaluates eagerly
+    inside the jitted step — usable anywhere a ``LossFunction`` is.
+    """
+
+    def __init__(self, loss_fn: Callable, y_pred_shape=None,
+                 y_true_shape=None):
+        self.loss_fn = loss_fn
+
+    def per_sample(self, y_pred, y_true):
+        out = self.loss_fn(y_true, y_pred)
+        out = jnp.asarray(out)
+        if out.ndim == 0:
+            return jnp.broadcast_to(out, (y_pred.shape[0],))
+        return out.reshape(out.shape[0], -1).mean(axis=-1)
+
+    def __call__(self, y_pred, y_true, sample_weight=None):
+        losses = self.per_sample(y_pred, y_true)
+        if sample_weight is not None:
+            return jnp.sum(losses * sample_weight) / \
+                jnp.maximum(jnp.sum(sample_weight), 1e-7)
+        return jnp.mean(losses)
+
+    def forward(self, y_true, y_pred):
+        return float(self(jnp.asarray(y_pred), jnp.asarray(y_true)))

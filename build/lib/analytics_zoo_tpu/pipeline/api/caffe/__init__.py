@@ -1,0 +1,3 @@
+from .loader import CaffeLoader, load_caffe
+
+__all__ = ["CaffeLoader", "load_caffe"]

@@ -1,0 +1,268 @@
+"""KerasLayer base class.
+
+Reference: every zoo layer is a ``KerasLayer`` wrapper that computes an output
+shape and instantiates BigDL modules
+(``zoo/.../keras/layers/KerasLayerWrapper.scala:111``). Here a layer is a
+*stateless description*: ``build`` returns a params pytree, ``call`` is a pure
+function of (params, inputs) — the shapes/weights live outside the object so
+the whole model jits into one XLA program and params can be sharded with
+``jax.sharding`` without touching layer code.
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+import inspect
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .graph import Node, Variable
+
+_name_counters: Dict[str, Any] = collections.defaultdict(lambda: 0)
+
+
+def _auto_name(cls_name: str) -> str:
+    key = cls_name.lower()
+    _name_counters[key] += 1
+    return f"{key}_{_name_counters[key]}"
+
+
+def _capture_config(init):
+    """Wrap __init__ to record the bound constructor args for serialization."""
+
+    @functools.wraps(init)
+    def wrapped(self, *args, **kwargs):
+        if not hasattr(self, "_config"):
+            try:
+                bound = inspect.signature(init).bind(self, *args, **kwargs)
+                bound.apply_defaults()
+                cfg = dict(bound.arguments)
+                cfg.pop("self", None)
+                cfg.pop("kwargs", None)
+                self._config = cfg
+            except TypeError:
+                self._config = {}
+        init(self, *args, **kwargs)
+
+    return wrapped
+
+
+class KerasLayer:
+    """Base class for all layers.
+
+    Subclasses implement:
+      * ``build(rng, input_shape) -> params`` (dict of jnp arrays; may be {})
+      * ``call(params, inputs, training=False, **kw) -> outputs``
+      * ``compute_output_shape(input_shape) -> shape`` (batch dim = None)
+
+    Layers with non-trainable state (BatchNorm moving stats) set
+    ``has_state=True``, implement ``init_state`` and return
+    ``(outputs, new_state)`` from ``call``. Stochastic layers (Dropout) set
+    ``stochastic=True`` and accept an ``rng`` kwarg.
+    """
+
+    has_state = False
+    stochastic = False
+    num_outputs = 1
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        if "__init__" in cls.__dict__:
+            cls.__init__ = _capture_config(cls.__dict__["__init__"])
+
+    def __init__(self, input_shape=None, name: Optional[str] = None, **kwargs):
+        self.name = name or _auto_name(type(self).__name__)
+        # Reference layers accept input_shape WITHOUT the batch dim.
+        self.input_shape = (None,) + tuple(input_shape) if input_shape else None
+        self._param_axes: Dict[str, Tuple[Optional[str], ...]] = {}
+
+    # -- to be overridden ------------------------------------------------
+    def build(self, rng, input_shape) -> Dict[str, Any]:
+        return {}
+
+    def init_state(self, input_shape) -> Dict[str, Any]:
+        return {}
+
+    def call(self, params, inputs, training: bool = False, **kwargs):
+        raise NotImplementedError(type(self).__name__)
+
+    def compute_output_shape(self, input_shape):
+        return input_shape
+
+    # -- sharding metadata ----------------------------------------------
+    def param_axes(self) -> Dict[str, Tuple[Optional[str], ...]]:
+        """Logical axis names per param (e.g. kernel -> ('in', 'out')).
+
+        ``parallel.sharding`` maps logical axes to mesh axes; layers record
+        this in ``build`` via :meth:`_annotate`.
+        """
+        return self._param_axes
+
+    def _annotate(self, **axes):
+        self._param_axes.update(axes)
+
+    # -- symbolic application -------------------------------------------
+    def __call__(self, x):
+        if isinstance(x, Variable) or (
+                isinstance(x, (list, tuple)) and x and
+                all(isinstance(v, Variable) for v in x)):
+            inputs = [x] if isinstance(x, Variable) else list(x)
+            in_shape = inputs[0].shape if len(inputs) == 1 else \
+                [v.shape for v in inputs]
+            out_shape = self.compute_output_shape(in_shape)
+            node = Node(self, inputs)
+            if self.num_outputs > 1:
+                return tuple(
+                    Variable(node, s, index=i)
+                    for i, s in enumerate(out_shape))
+            return Variable(node, out_shape)
+        # Eager escape hatch: apply to concrete arrays with fresh params.
+        raise TypeError(
+            f"{type(self).__name__} must be called on symbolic Variable(s); "
+            "got " + str(type(x)))
+
+    # -- serialization ---------------------------------------------------
+    def get_config(self) -> Dict[str, Any]:
+        cfg = dict(getattr(self, "_config", {}))
+        cfg.pop("name", None)
+        return cfg
+
+    @classmethod
+    def from_config(cls, config):
+        return cls(**config)
+
+    def __repr__(self):
+        return f"{type(self).__name__}(name={self.name})"
+
+
+class InputLayer(KerasLayer):
+    def __init__(self, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+
+    def call(self, params, inputs, training=False, **kwargs):
+        return inputs
+
+
+def Input(shape=None, name: Optional[str] = None) -> Variable:
+    """Create a graph input Variable. ``shape`` excludes the batch dim,
+    matching the reference's ``Input`` (keras/layers/Input.scala)."""
+    if shape is None:
+        raise ValueError("Input(shape=...) is required")
+    return Variable(None, (None,) + tuple(shape), name=name)
+
+
+# ---------------------------------------------------------------------------
+# Initializers — names follow the reference's ``init`` strings
+# (KerasUtils.getInitMethod: glorot_uniform, one, zero, uniform, normal).
+# ---------------------------------------------------------------------------
+
+def init_tensor(rng, shape, init="glorot_uniform", dtype=jnp.float32,
+                scale: float = 0.05):
+    shape = tuple(int(s) for s in shape)
+    if callable(init):
+        return init(rng, shape, dtype)
+    init = (init or "glorot_uniform").lower()
+    if init in ("glorot_uniform", "xavier"):
+        fan_in, fan_out = _fans(shape)
+        limit = np.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(rng, shape, dtype, -limit, limit)
+    if init == "glorot_normal":
+        fan_in, fan_out = _fans(shape)
+        std = np.sqrt(2.0 / (fan_in + fan_out))
+        return std * jax.random.normal(rng, shape, dtype)
+    if init in ("he_normal", "msra"):
+        fan_in, _ = _fans(shape)
+        std = np.sqrt(2.0 / fan_in)
+        return std * jax.random.normal(rng, shape, dtype)
+    if init == "he_uniform":
+        fan_in, _ = _fans(shape)
+        limit = np.sqrt(6.0 / fan_in)
+        return jax.random.uniform(rng, shape, dtype, -limit, limit)
+    if init == "lecun_uniform":
+        fan_in, _ = _fans(shape)
+        limit = np.sqrt(3.0 / fan_in)
+        return jax.random.uniform(rng, shape, dtype, -limit, limit)
+    if init in ("uniform",):
+        return jax.random.uniform(rng, shape, dtype, -scale, scale)
+    if init in ("normal", "gaussian"):
+        return scale * jax.random.normal(rng, shape, dtype)
+    if init in ("zero", "zeros"):
+        return jnp.zeros(shape, dtype)
+    if init in ("one", "ones"):
+        return jnp.ones(shape, dtype)
+    if init == "orthogonal":
+        return jax.nn.initializers.orthogonal()(rng, shape, dtype)
+    raise ValueError(f"Unknown init: {init}")
+
+
+def _fans(shape):
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels: spatial dims first? we store (spatial..., in, out)
+    receptive = int(np.prod(shape[:-2]))
+    return shape[-2] * receptive, shape[-1] * receptive
+
+
+# ---------------------------------------------------------------------------
+# Activations — string names follow KerasUtils.getKerasActivation.
+# ---------------------------------------------------------------------------
+
+ACTIVATIONS = {
+    "relu": jax.nn.relu,
+    "relu6": jax.nn.relu6,
+    "tanh": jnp.tanh,
+    "sigmoid": jax.nn.sigmoid,
+    # Keras-1/BigDL hard_sigmoid is clip(0.2x+0.5, 0, 1); jax.nn.hard_sigmoid
+    # is the slope-1/6 variant — use the parity definition.
+    "hard_sigmoid": lambda x: jnp.clip(0.2 * x + 0.5, 0.0, 1.0),
+    "softmax": jax.nn.softmax,
+    "softplus": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "linear": lambda x: x,
+    "elu": jax.nn.elu,
+    "selu": jax.nn.selu,
+    "gelu": jax.nn.gelu,
+    "exp": jnp.exp,
+    "log": jnp.log,
+    "swish": jax.nn.silu,
+    "log_softmax": jax.nn.log_softmax,
+    "mish": jax.nn.mish,
+    "square": jnp.square,
+    "sqrt": jnp.sqrt,
+    "abs": jnp.abs,
+}
+
+
+class NamedActivation:
+    """Picklable activation wrapper (stores the name, not the jax fn)."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name):
+        self.name = name
+
+    def __call__(self, x):
+        return ACTIVATIONS[self.name](x)
+
+    def __reduce__(self):
+        return (NamedActivation, (self.name,))
+
+    def __repr__(self):
+        return f"activation:{self.name}"
+
+
+def get_activation_fn(name):
+    if name is None:
+        return None
+    if callable(name):
+        return name
+    key = name.lower()
+    if key not in ACTIVATIONS:
+        raise ValueError(f"Unknown activation: {name}")
+    return NamedActivation(key)

@@ -1,0 +1,120 @@
+"""Advanced activation layers: LeakyReLU, ELU, PReLU, SReLU, ThresholdedReLU,
+RReLU, Softmax (keras/layers/*.scala)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..engine.base import KerasLayer
+
+
+class LeakyReLU(KerasLayer):
+    def __init__(self, alpha=0.3, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.alpha = alpha
+
+    def call(self, params, x, training=False, **kw):
+        return jnp.where(x >= 0, x, self.alpha * x)
+
+
+class ELU(KerasLayer):
+    def __init__(self, alpha=1.0, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.alpha = alpha
+
+    def call(self, params, x, training=False, **kw):
+        return jnp.where(x >= 0, x, self.alpha * (jnp.exp(x) - 1.0))
+
+
+class PReLU(KerasLayer):
+    """Learnable per-channel slope (PReLU.scala: nOutputPlane semantics —
+    one alpha per channel of dim 1, or a single shared alpha)."""
+
+    def __init__(self, n_output_plane=0, input_shape=None, name=None,
+                 **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.n_output_plane = int(n_output_plane)
+
+    def build(self, rng, input_shape):
+        n = self.n_output_plane if self.n_output_plane > 0 else 1
+        return {"alpha": jnp.full((n,), 0.25)}
+
+    def call(self, params, x, training=False, **kw):
+        alpha = params["alpha"]
+        if alpha.shape[0] > 1:
+            bshape = [1] * x.ndim
+            bshape[1] = alpha.shape[0]
+            alpha = alpha.reshape(bshape)
+        return jnp.where(x >= 0, x, alpha * x)
+
+
+class SReLU(KerasLayer):
+    """S-shaped ReLU with 4 learnable per-element tensors
+    (SReLU.scala: t_left, a_left, t_right, a_right)."""
+
+    def __init__(self, t_left_init="zero", a_left_init="glorot_uniform",
+                 t_right_init="glorot_uniform", a_right_init="one",
+                 shared_axes=None, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.shared_axes = shared_axes
+        self.inits = (t_left_init, a_left_init, t_right_init, a_right_init)
+
+    def _param_shape(self, input_shape):
+        shape = [int(d) for d in input_shape[1:]]
+        if self.shared_axes:
+            for ax in self.shared_axes:
+                shape[ax - 1] = 1
+        return tuple(shape)
+
+    def build(self, rng, input_shape):
+        from ..engine.base import init_tensor
+        shape = self._param_shape(input_shape)
+        keys = jax.random.split(rng, 4)
+        tl, al, tr, ar = [init_tensor(k, shape, i)
+                          for k, i in zip(keys, self.inits)]
+        return {"t_left": tl, "a_left": al, "t_right": tr, "a_right": ar}
+
+    def call(self, params, x, training=False, **kw):
+        tl, al = params["t_left"], params["a_left"]
+        tr, ar = params["t_right"], params["a_right"]
+        y = jnp.where(x < tl, tl + al * (x - tl), x)
+        return jnp.where(x > tr, tr + ar * (x - tr), y)
+
+
+class ThresholdedReLU(KerasLayer):
+    def __init__(self, theta=1.0, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.theta = theta
+
+    def call(self, params, x, training=False, **kw):
+        return jnp.where(x > self.theta, x, 0.0).astype(x.dtype)
+
+
+class RReLU(KerasLayer):
+    """Randomized leaky ReLU (RReLU.scala): random slope in [lower, upper]
+    while training, fixed mean slope at inference."""
+
+    stochastic = True
+
+    def __init__(self, lower=1.0 / 8, upper=1.0 / 3, input_shape=None,
+                 name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.lower, self.upper = lower, upper
+
+    def call(self, params, x, training=False, rng=None, **kw):
+        if training and rng is not None:
+            a = jax.random.uniform(rng, x.shape, x.dtype, self.lower,
+                                   self.upper)
+        else:
+            a = (self.lower + self.upper) / 2.0
+        return jnp.where(x >= 0, x, a * x)
+
+
+class Softmax(KerasLayer):
+    def __init__(self, axis: int = -1, input_shape=None, name=None, **kw):
+        super().__init__(input_shape=input_shape, name=name)
+        self.axis = int(axis)
+
+    def call(self, params, x, training=False, **kw):
+        return jax.nn.softmax(x, axis=self.axis)

@@ -1,0 +1,604 @@
+"""Convolution layers.
+
+Parity surface: Convolution1D/2D/3D, AtrousConvolution1D/2D, Deconvolution2D,
+SeparableConvolution2D, ShareConvolution2D, LocallyConnected1D/2D,
+Cropping1/2/3D, UpSampling1/2/3D, ZeroPadding1/2/3D (keras/layers/*.scala).
+
+TPU design: every conv lowers to ``lax.conv_general_dilated`` with explicit
+``dimension_numbers`` — no host-side layout transposes; XLA picks the MXU
+tiling. Default dim_ordering is "th" (NCHW) for API parity with the
+reference's BigDL backend, but kernels are stored HWIO so "tf" mode shares
+code.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..engine.base import KerasLayer, get_activation_fn, init_tensor
+
+
+def _pair(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+
+
+def _conv_out(length, k, stride, border_mode, dilation=1):
+    if length is None:
+        return None
+    keff = (k - 1) * dilation + 1
+    if border_mode == "same":
+        return (length + stride - 1) // stride
+    return (length - keff) // stride + 1
+
+
+class Convolution2D(KerasLayer):
+    def __init__(self, nb_filter, nb_row, nb_col, init="glorot_uniform",
+                 activation=None, border_mode="valid", subsample=(1, 1),
+                 dim_ordering="th", W_regularizer=None, b_regularizer=None,
+                 bias=True, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.nb_filter = int(nb_filter)
+        self.kernel_size = (int(nb_row), int(nb_col))
+        self.init = init
+        self.activation = get_activation_fn(activation)
+        self.border_mode = border_mode
+        self.subsample = _pair(subsample)
+        self.dim_ordering = dim_ordering
+        self.bias = bias
+        self.dilation = (1, 1)
+
+    def _in_channels(self, input_shape):
+        return int(input_shape[1] if self.dim_ordering == "th"
+                   else input_shape[3])
+
+    def build(self, rng, input_shape):
+        cin = self._in_channels(input_shape)
+        kh, kw = self.kernel_size
+        params = {"kernel": init_tensor(
+            rng, (kh, kw, cin, self.nb_filter), self.init)}
+        self._annotate(kernel=(None, None, "in", "out"))
+        if self.bias:
+            params["bias"] = jnp.zeros((self.nb_filter,))
+        return params
+
+    def _dn(self):
+        return ("NCHW", "HWIO", "NCHW") if self.dim_ordering == "th" \
+            else ("NHWC", "HWIO", "NHWC")
+
+    def call(self, params, x, training=False, **kw):
+        pad = "SAME" if self.border_mode == "same" else "VALID"
+        y = jax.lax.conv_general_dilated(
+            x, params["kernel"].astype(x.dtype), self.subsample, pad,
+            rhs_dilation=self.dilation, dimension_numbers=self._dn())
+        if self.bias:
+            b = params["bias"].astype(x.dtype)
+            y = y + (b[None, :, None, None] if self.dim_ordering == "th"
+                     else b)
+        return self.activation(y) if self.activation else y
+
+    def compute_output_shape(self, s):
+        kh, kw = self.kernel_size
+        sh, sw = self.subsample
+        dh, dw = self.dilation
+        if self.dim_ordering == "th":
+            return (s[0], self.nb_filter,
+                    _conv_out(s[2], kh, sh, self.border_mode, dh),
+                    _conv_out(s[3], kw, sw, self.border_mode, dw))
+        return (s[0], _conv_out(s[1], kh, sh, self.border_mode, dh),
+                _conv_out(s[2], kw, sw, self.border_mode, dw), self.nb_filter)
+
+
+class AtrousConvolution2D(Convolution2D):
+    def __init__(self, nb_filter, nb_row, nb_col, atrous_rate=(1, 1),
+                 **kwargs):
+        super().__init__(nb_filter, nb_row, nb_col, **kwargs)
+        self.dilation = _pair(atrous_rate)
+
+
+class Convolution1D(KerasLayer):
+    """Conv over (batch, steps, dim) — Keras-1 layout regardless of
+    dim_ordering (Convolution1D.scala)."""
+
+    def __init__(self, nb_filter, filter_length, init="glorot_uniform",
+                 activation=None, border_mode="valid", subsample_length=1,
+                 W_regularizer=None, b_regularizer=None, bias=True,
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.nb_filter = int(nb_filter)
+        self.filter_length = int(filter_length)
+        self.init = init
+        self.activation = get_activation_fn(activation)
+        self.border_mode = border_mode
+        self.subsample = int(subsample_length)
+        self.bias = bias
+        self.dilation = 1
+
+    def build(self, rng, input_shape):
+        cin = int(input_shape[-1])
+        params = {"kernel": init_tensor(
+            rng, (self.filter_length, cin, self.nb_filter), self.init)}
+        self._annotate(kernel=(None, "in", "out"))
+        if self.bias:
+            params["bias"] = jnp.zeros((self.nb_filter,))
+        return params
+
+    def call(self, params, x, training=False, **kw):
+        pad = "SAME" if self.border_mode == "same" else "VALID"
+        y = jax.lax.conv_general_dilated(
+            x, params["kernel"].astype(x.dtype), (self.subsample,), pad,
+            rhs_dilation=(self.dilation,),
+            dimension_numbers=("NWC", "WIO", "NWC"))
+        if self.bias:
+            y = y + params["bias"].astype(x.dtype)
+        return self.activation(y) if self.activation else y
+
+    def compute_output_shape(self, s):
+        return (s[0], _conv_out(s[1], self.filter_length, self.subsample,
+                                self.border_mode, self.dilation),
+                self.nb_filter)
+
+
+class AtrousConvolution1D(Convolution1D):
+    def __init__(self, nb_filter, filter_length, atrous_rate=1, **kwargs):
+        super().__init__(nb_filter, filter_length, **kwargs)
+        self.dilation = int(atrous_rate)
+
+
+class Convolution3D(KerasLayer):
+    def __init__(self, nb_filter, kernel_dim1, kernel_dim2, kernel_dim3,
+                 init="glorot_uniform", activation=None, border_mode="valid",
+                 subsample=(1, 1, 1), dim_ordering="th", W_regularizer=None,
+                 b_regularizer=None, bias=True, input_shape=None, name=None,
+                 **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.nb_filter = int(nb_filter)
+        self.kernel_size = (int(kernel_dim1), int(kernel_dim2),
+                            int(kernel_dim3))
+        self.init = init
+        self.activation = get_activation_fn(activation)
+        self.border_mode = border_mode
+        self.subsample = tuple(subsample)
+        self.dim_ordering = dim_ordering
+        self.bias = bias
+
+    def build(self, rng, input_shape):
+        cin = int(input_shape[1] if self.dim_ordering == "th"
+                  else input_shape[4])
+        params = {"kernel": init_tensor(
+            rng, self.kernel_size + (cin, self.nb_filter), self.init)}
+        if self.bias:
+            params["bias"] = jnp.zeros((self.nb_filter,))
+        return params
+
+    def call(self, params, x, training=False, **kw):
+        pad = "SAME" if self.border_mode == "same" else "VALID"
+        dn = ("NCDHW", "DHWIO", "NCDHW") if self.dim_ordering == "th" \
+            else ("NDHWC", "DHWIO", "NDHWC")
+        y = jax.lax.conv_general_dilated(
+            x, params["kernel"].astype(x.dtype), self.subsample, pad,
+            dimension_numbers=dn)
+        if self.bias:
+            b = params["bias"].astype(x.dtype)
+            y = y + (b[None, :, None, None, None]
+                     if self.dim_ordering == "th" else b)
+        return self.activation(y) if self.activation else y
+
+    def compute_output_shape(self, s):
+        ks, ss = self.kernel_size, self.subsample
+        if self.dim_ordering == "th":
+            dims = tuple(_conv_out(s[2 + i], ks[i], ss[i], self.border_mode)
+                         for i in range(3))
+            return (s[0], self.nb_filter) + dims
+        dims = tuple(_conv_out(s[1 + i], ks[i], ss[i], self.border_mode)
+                     for i in range(3))
+        return (s[0],) + dims + (self.nb_filter,)
+
+
+class Deconvolution2D(KerasLayer):
+    """Transposed conv (Deconvolution2D.scala); 'th' ordering only in the
+    reference."""
+
+    def __init__(self, nb_filter, nb_row, nb_col, output_shape=None,
+                 init="glorot_uniform", activation=None, border_mode="valid",
+                 subsample=(1, 1), dim_ordering="th", W_regularizer=None,
+                 b_regularizer=None, bias=True, input_shape=None, name=None,
+                 **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.nb_filter = int(nb_filter)
+        self.kernel_size = (int(nb_row), int(nb_col))
+        self.init = init
+        self.activation = get_activation_fn(activation)
+        self.border_mode = border_mode
+        self.subsample = _pair(subsample)
+        self.dim_ordering = dim_ordering
+        self.bias = bias
+
+    def build(self, rng, input_shape):
+        cin = int(input_shape[1] if self.dim_ordering == "th"
+                  else input_shape[3])
+        kh, kw = self.kernel_size
+        # conv_transpose with HWIO: (kh, kw, out, in) via transpose_kernel
+        params = {"kernel": init_tensor(
+            rng, (kh, kw, self.nb_filter, cin), self.init)}
+        if self.bias:
+            params["bias"] = jnp.zeros((self.nb_filter,))
+        return params
+
+    def call(self, params, x, training=False, **kw):
+        pad = "SAME" if self.border_mode == "same" else "VALID"
+        dn = ("NCHW", "HWIO", "NCHW") if self.dim_ordering == "th" \
+            else ("NHWC", "HWIO", "NHWC")
+        y = jax.lax.conv_transpose(
+            x, params["kernel"].astype(x.dtype), self.subsample, pad,
+            dimension_numbers=dn, transpose_kernel=True)
+        if self.bias:
+            b = params["bias"].astype(x.dtype)
+            y = y + (b[None, :, None, None] if self.dim_ordering == "th"
+                     else b)
+        return self.activation(y) if self.activation else y
+
+    def compute_output_shape(self, s):
+        kh, kw = self.kernel_size
+        sh, sw = self.subsample
+
+        def out(l, k, st):
+            if l is None:
+                return None
+            if self.border_mode == "same":
+                return l * st
+            return (l - 1) * st + k
+
+        if self.dim_ordering == "th":
+            return (s[0], self.nb_filter, out(s[2], kh, sh), out(s[3], kw, sw))
+        return (s[0], out(s[1], kh, sh), out(s[2], kw, sw), self.nb_filter)
+
+
+class SeparableConvolution2D(KerasLayer):
+    def __init__(self, nb_filter, nb_row, nb_col, init="glorot_uniform",
+                 activation=None, border_mode="valid", subsample=(1, 1),
+                 depth_multiplier=1, dim_ordering="th", bias=True,
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.nb_filter = int(nb_filter)
+        self.kernel_size = (int(nb_row), int(nb_col))
+        self.init = init
+        self.activation = get_activation_fn(activation)
+        self.border_mode = border_mode
+        self.subsample = _pair(subsample)
+        self.depth_multiplier = int(depth_multiplier)
+        self.dim_ordering = dim_ordering
+        self.bias = bias
+
+    def build(self, rng, input_shape):
+        cin = int(input_shape[1] if self.dim_ordering == "th"
+                  else input_shape[3])
+        kh, kw = self.kernel_size
+        r1, r2 = jax.random.split(rng)
+        params = {
+            "depthwise": init_tensor(
+                r1, (kh, kw, 1, cin * self.depth_multiplier), self.init),
+            "pointwise": init_tensor(
+                r2, (1, 1, cin * self.depth_multiplier, self.nb_filter),
+                self.init)}
+        if self.bias:
+            params["bias"] = jnp.zeros((self.nb_filter,))
+        return params
+
+    def call(self, params, x, training=False, **kw):
+        pad = "SAME" if self.border_mode == "same" else "VALID"
+        dn = ("NCHW", "HWIO", "NCHW") if self.dim_ordering == "th" \
+            else ("NHWC", "HWIO", "NHWC")
+        cin = x.shape[1] if self.dim_ordering == "th" else x.shape[3]
+        y = jax.lax.conv_general_dilated(
+            x, params["depthwise"].astype(x.dtype), self.subsample, pad,
+            dimension_numbers=dn, feature_group_count=cin)
+        y = jax.lax.conv_general_dilated(
+            y, params["pointwise"].astype(x.dtype), (1, 1), "VALID",
+            dimension_numbers=dn)
+        if self.bias:
+            b = params["bias"].astype(x.dtype)
+            y = y + (b[None, :, None, None] if self.dim_ordering == "th"
+                     else b)
+        return self.activation(y) if self.activation else y
+
+    def compute_output_shape(self, s):
+        kh, kw = self.kernel_size
+        sh, sw = self.subsample
+        if self.dim_ordering == "th":
+            return (s[0], self.nb_filter,
+                    _conv_out(s[2], kh, sh, self.border_mode),
+                    _conv_out(s[3], kw, sw, self.border_mode))
+        return (s[0], _conv_out(s[1], kh, sh, self.border_mode),
+                _conv_out(s[2], kw, sw, self.border_mode), self.nb_filter)
+
+
+class ShareConvolution2D(Convolution2D):
+    """Reference ShareConvolution2D shares gradient buffers across time — a
+    JVM memory optimization with identical math; alias of Convolution2D."""
+
+
+class LocallyConnected2D(KerasLayer):
+    """Unshared conv (LocallyConnected2D.scala): per-position kernels via
+    patch extraction + einsum (MXU-friendly batched matmul)."""
+
+    def __init__(self, nb_filter, nb_row, nb_col, activation=None,
+                 border_mode="valid", subsample=(1, 1), dim_ordering="th",
+                 bias=True, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.nb_filter = int(nb_filter)
+        self.kernel_size = (int(nb_row), int(nb_col))
+        self.activation = get_activation_fn(activation)
+        self.border_mode = border_mode
+        self.subsample = _pair(subsample)
+        self.dim_ordering = dim_ordering
+        self.bias = bias
+
+    def _out_hw(self, input_shape):
+        kh, kw = self.kernel_size
+        sh, sw = self.subsample
+        if self.dim_ordering == "th":
+            h, w = input_shape[2], input_shape[3]
+        else:
+            h, w = input_shape[1], input_shape[2]
+        return (_conv_out(h, kh, sh, self.border_mode),
+                _conv_out(w, kw, sw, self.border_mode))
+
+    def build(self, rng, input_shape):
+        cin = int(input_shape[1] if self.dim_ordering == "th"
+                  else input_shape[3])
+        kh, kw = self.kernel_size
+        oh, ow = self._out_hw(input_shape)
+        params = {"kernel": init_tensor(
+            rng, (oh * ow, kh * kw * cin, self.nb_filter), "glorot_uniform")}
+        if self.bias:
+            params["bias"] = jnp.zeros((oh, ow, self.nb_filter))
+        return params
+
+    def call(self, params, x, training=False, **kw):
+        if self.dim_ordering != "th":
+            x = jnp.transpose(x, (0, 3, 1, 2))
+        pad = "SAME" if self.border_mode == "same" else "VALID"
+        patches = jax.lax.conv_general_dilated_patches(
+            x, self.kernel_size, self.subsample, pad)  # (B, C*kh*kw, OH, OW)
+        b, ck, oh, ow = patches.shape
+        patches = patches.reshape(b, ck, oh * ow).transpose(2, 0, 1)
+        y = jnp.einsum("pbc,pcf->pbf", patches,
+                       params["kernel"].astype(x.dtype))
+        y = y.transpose(1, 2, 0).reshape(b, self.nb_filter, oh, ow)
+        if self.bias:
+            y = y + params["bias"].astype(x.dtype).transpose(2, 0, 1)[None]
+        if self.dim_ordering != "th":
+            y = jnp.transpose(y, (0, 2, 3, 1))
+        return self.activation(y) if self.activation else y
+
+    def compute_output_shape(self, s):
+        oh, ow = self._out_hw(s)
+        if self.dim_ordering == "th":
+            return (s[0], self.nb_filter, oh, ow)
+        return (s[0], oh, ow, self.nb_filter)
+
+
+class LocallyConnected1D(KerasLayer):
+    def __init__(self, nb_filter, filter_length, activation=None,
+                 border_mode="valid", subsample_length=1, bias=True,
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.nb_filter = int(nb_filter)
+        self.filter_length = int(filter_length)
+        self.activation = get_activation_fn(activation)
+        self.border_mode = border_mode
+        self.subsample = int(subsample_length)
+        self.bias = bias
+
+    def build(self, rng, input_shape):
+        cin = int(input_shape[-1])
+        ol = _conv_out(input_shape[1], self.filter_length, self.subsample,
+                       self.border_mode)
+        params = {"kernel": init_tensor(
+            rng, (ol, self.filter_length * cin, self.nb_filter))}
+        if self.bias:
+            params["bias"] = jnp.zeros((ol, self.nb_filter))
+        return params
+
+    def call(self, params, x, training=False, **kw):
+        # x: (B, L, C) -> patches (B, C*k, OL)
+        pad = "SAME" if self.border_mode == "same" else "VALID"
+        patches = jax.lax.conv_general_dilated_patches(
+            jnp.transpose(x, (0, 2, 1))[:, :, None, :],
+            (1, self.filter_length), (1, self.subsample), pad)
+        b, ck, _, ol = patches.shape
+        patches = patches.reshape(b, ck, ol).transpose(2, 0, 1)
+        y = jnp.einsum("pbc,pcf->pbf", patches,
+                       params["kernel"].astype(x.dtype)).transpose(1, 0, 2)
+        if self.bias:
+            y = y + params["bias"].astype(x.dtype)
+        return self.activation(y) if self.activation else y
+
+    def compute_output_shape(self, s):
+        ol = _conv_out(s[1], self.filter_length, self.subsample,
+                       self.border_mode)
+        return (s[0], ol, self.nb_filter)
+
+
+# ---------------------------------------------------------------------------
+# Shape-manipulation conv companions
+# ---------------------------------------------------------------------------
+
+class Cropping1D(KerasLayer):
+    def __init__(self, cropping=(1, 1), input_shape=None, name=None, **kw):
+        super().__init__(input_shape=input_shape, name=name)
+        self.cropping = tuple(cropping)
+
+    def call(self, params, x, training=False, **kw):
+        a, b = self.cropping
+        return x[:, a:x.shape[1] - b if b else x.shape[1]]
+
+    def compute_output_shape(self, s):
+        return (s[0], None if s[1] is None else s[1] - sum(self.cropping),
+                s[2])
+
+
+class Cropping2D(KerasLayer):
+    def __init__(self, cropping=((0, 0), (0, 0)), dim_ordering="th",
+                 input_shape=None, name=None, **kw):
+        super().__init__(input_shape=input_shape, name=name)
+        self.cropping = tuple(tuple(c) for c in cropping)
+        self.dim_ordering = dim_ordering
+
+    def call(self, params, x, training=False, **kw):
+        (t, b), (l, r) = self.cropping
+        if self.dim_ordering == "th":
+            return x[:, :, t:x.shape[2] - b if b else x.shape[2],
+                     l:x.shape[3] - r if r else x.shape[3]]
+        return x[:, t:x.shape[1] - b if b else x.shape[1],
+                 l:x.shape[2] - r if r else x.shape[2], :]
+
+    def compute_output_shape(self, s):
+        (t, b), (l, r) = self.cropping
+
+        def crop(d, c):
+            return None if d is None else d - c
+
+        if self.dim_ordering == "th":
+            return (s[0], s[1], crop(s[2], t + b), crop(s[3], l + r))
+        return (s[0], crop(s[1], t + b), crop(s[2], l + r), s[3])
+
+
+class Cropping3D(KerasLayer):
+    def __init__(self, cropping=((1, 1), (1, 1), (1, 1)), dim_ordering="th",
+                 input_shape=None, name=None, **kw):
+        super().__init__(input_shape=input_shape, name=name)
+        self.cropping = tuple(tuple(c) for c in cropping)
+        self.dim_ordering = dim_ordering
+
+    def call(self, params, x, training=False, **kw):
+        slices = [slice(None)] * x.ndim
+        offset = 2 if self.dim_ordering == "th" else 1
+        for i, (a, b) in enumerate(self.cropping):
+            dim = offset + i
+            slices[dim] = slice(a, x.shape[dim] - b if b else x.shape[dim])
+        return x[tuple(slices)]
+
+    def compute_output_shape(self, s):
+        s = list(s)
+        offset = 2 if self.dim_ordering == "th" else 1
+        for i, (a, b) in enumerate(self.cropping):
+            if s[offset + i] is not None:
+                s[offset + i] -= (a + b)
+        return tuple(s)
+
+
+class UpSampling1D(KerasLayer):
+    def __init__(self, length=2, input_shape=None, name=None, **kw):
+        super().__init__(input_shape=input_shape, name=name)
+        self.length = int(length)
+
+    def call(self, params, x, training=False, **kw):
+        return jnp.repeat(x, self.length, axis=1)
+
+    def compute_output_shape(self, s):
+        return (s[0], None if s[1] is None else s[1] * self.length, s[2])
+
+
+class UpSampling2D(KerasLayer):
+    def __init__(self, size=(2, 2), dim_ordering="th", input_shape=None,
+                 name=None, **kw):
+        super().__init__(input_shape=input_shape, name=name)
+        self.size = _pair(size)
+        self.dim_ordering = dim_ordering
+
+    def call(self, params, x, training=False, **kw):
+        h_ax, w_ax = (2, 3) if self.dim_ordering == "th" else (1, 2)
+        x = jnp.repeat(x, self.size[0], axis=h_ax)
+        return jnp.repeat(x, self.size[1], axis=w_ax)
+
+    def compute_output_shape(self, s):
+        def up(d, f):
+            return None if d is None else d * f
+
+        if self.dim_ordering == "th":
+            return (s[0], s[1], up(s[2], self.size[0]), up(s[3], self.size[1]))
+        return (s[0], up(s[1], self.size[0]), up(s[2], self.size[1]), s[3])
+
+
+class UpSampling3D(KerasLayer):
+    def __init__(self, size=(2, 2, 2), dim_ordering="th", input_shape=None,
+                 name=None, **kw):
+        super().__init__(input_shape=input_shape, name=name)
+        self.size = tuple(size)
+
+    def call(self, params, x, training=False, **kw):
+        for i, f in enumerate(self.size):
+            x = jnp.repeat(x, f, axis=2 + i)
+        return x
+
+    def compute_output_shape(self, s):
+        s = list(s)
+        for i, f in enumerate(self.size):
+            if s[2 + i] is not None:
+                s[2 + i] *= f
+        return tuple(s)
+
+
+class ZeroPadding1D(KerasLayer):
+    def __init__(self, padding=1, input_shape=None, name=None, **kw):
+        super().__init__(input_shape=input_shape, name=name)
+        self.padding = _pair(padding) if isinstance(padding, (list, tuple)) \
+            else (int(padding), int(padding))
+
+    def call(self, params, x, training=False, **kw):
+        return jnp.pad(x, ((0, 0), self.padding, (0, 0)))
+
+    def compute_output_shape(self, s):
+        return (s[0], None if s[1] is None else s[1] + sum(self.padding),
+                s[2])
+
+
+class ZeroPadding2D(KerasLayer):
+    def __init__(self, padding=(1, 1), dim_ordering="th", input_shape=None,
+                 name=None, **kw):
+        super().__init__(input_shape=input_shape, name=name)
+        if len(padding) == 2 and not isinstance(padding[0], (list, tuple)):
+            self.padding = ((padding[0], padding[0]),
+                            (padding[1], padding[1]))
+        else:
+            self.padding = tuple(tuple(p) for p in padding)
+        self.dim_ordering = dim_ordering
+
+    def call(self, params, x, training=False, **kw):
+        if self.dim_ordering == "th":
+            return jnp.pad(x, ((0, 0), (0, 0)) + self.padding)
+        return jnp.pad(x, ((0, 0),) + self.padding + ((0, 0),))
+
+    def compute_output_shape(self, s):
+        (t, b), (l, r) = self.padding
+
+        def pad(d, c):
+            return None if d is None else d + c
+
+        if self.dim_ordering == "th":
+            return (s[0], s[1], pad(s[2], t + b), pad(s[3], l + r))
+        return (s[0], pad(s[1], t + b), pad(s[2], l + r), s[3])
+
+
+class ZeroPadding3D(KerasLayer):
+    def __init__(self, padding=(1, 1, 1), dim_ordering="th", input_shape=None,
+                 name=None, **kw):
+        super().__init__(input_shape=input_shape, name=name)
+        self.padding = tuple(int(p) for p in padding)
+
+    def call(self, params, x, training=False, **kw):
+        p = self.padding
+        return jnp.pad(x, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]),
+                           (p[2], p[2])))
+
+    def compute_output_shape(self, s):
+        s = list(s)
+        for i, p in enumerate(self.padding):
+            if s[2 + i] is not None:
+                s[2 + i] += 2 * p
+        return tuple(s)

@@ -1,0 +1,609 @@
+"""Core layers.
+
+Parity surface: ``zoo/.../pipeline/api/keras/layers/`` — Dense, Dropout,
+Activation, Flatten, Reshape, Permute, RepeatVector, Masking, Highway,
+MaxoutDense, Select, Narrow, Squeeze, ExpandDim, Identity, and the simple
+elementwise layers (Exp, Log, Sqrt, Square, Power, Negative, AddConstant,
+MulConstant, CAdd, CMul, Mul, Scale, BinaryThreshold, Threshold, HardTanh,
+HardShrink, SoftShrink, ...). All are pure jnp: XLA fuses them into
+surrounding matmuls, so depth here is free on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..engine.base import (KerasLayer, get_activation_fn, init_tensor)
+
+
+def _dims(shape):
+    return tuple(-1 if d is None else int(d) for d in shape)
+
+
+class Dense(KerasLayer):
+    """Fully connected: applies to the last dim (Dense.scala). Kernel is
+    annotated ('in','out') so tensor-parallel layouts can shard it."""
+
+    def __init__(self, output_dim, init="glorot_uniform", activation=None,
+                 W_regularizer=None, b_regularizer=None, bias=True,
+                 input_dim=None, input_shape=None, name=None, **kwargs):
+        if input_dim is not None and input_shape is None:
+            input_shape = (input_dim,)
+        super().__init__(input_shape=input_shape, name=name)
+        self.output_dim = int(output_dim)
+        self.init = init
+        self.activation = get_activation_fn(activation)
+        self.bias = bias
+
+    def build(self, rng, input_shape):
+        in_dim = int(input_shape[-1])
+        k_rng, b_rng = jax.random.split(rng)
+        params = {"kernel": init_tensor(k_rng, (in_dim, self.output_dim),
+                                        self.init)}
+        self._annotate(kernel=("in", "out"))
+        if self.bias:
+            params["bias"] = jnp.zeros((self.output_dim,))
+            self._annotate(bias=("out",))
+        return params
+
+    def call(self, params, x, training=False, **kw):
+        y = jnp.matmul(x, params["kernel"])
+        if self.bias:
+            y = y + params["bias"]
+        if self.activation is not None:
+            y = self.activation(y)
+        return y
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape[:-1]) + (self.output_dim,)
+
+
+class Activation(KerasLayer):
+    def __init__(self, activation, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.fn = get_activation_fn(activation)
+
+    def call(self, params, x, training=False, **kw):
+        return self.fn(x)
+
+
+class Dropout(KerasLayer):
+    stochastic = True
+
+    def __init__(self, p, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.p = float(p)
+
+    def call(self, params, x, training=False, rng=None, **kw):
+        if not training or rng is None or self.p <= 0.0:
+            return x
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+class SpatialDropout1D(KerasLayer):
+    stochastic = True
+
+    def __init__(self, p=0.5, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.p = float(p)
+
+    def call(self, params, x, training=False, rng=None, **kw):
+        if not training or rng is None or self.p <= 0.0:
+            return x
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(rng, keep, (x.shape[0], 1, x.shape[2]))
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+class SpatialDropout2D(KerasLayer):
+    stochastic = True
+
+    def __init__(self, p=0.5, dim_ordering="th", input_shape=None, name=None,
+                 **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.p = float(p)
+        self.dim_ordering = dim_ordering
+
+    def call(self, params, x, training=False, rng=None, **kw):
+        if not training or rng is None or self.p <= 0.0:
+            return x
+        keep = 1.0 - self.p
+        if self.dim_ordering == "th":  # (B, C, H, W): drop whole channels
+            shape = (x.shape[0], x.shape[1], 1, 1)
+        else:  # (B, H, W, C)
+            shape = (x.shape[0], 1, 1, x.shape[3])
+        mask = jax.random.bernoulli(rng, keep, shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+class SpatialDropout3D(KerasLayer):
+    stochastic = True
+
+    def __init__(self, p=0.5, dim_ordering="th", input_shape=None, name=None,
+                 **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.p = float(p)
+        self.dim_ordering = dim_ordering
+
+    def call(self, params, x, training=False, rng=None, **kw):
+        if not training or rng is None or self.p <= 0.0:
+            return x
+        keep = 1.0 - self.p
+        if self.dim_ordering == "th":
+            shape = (x.shape[0], x.shape[1], 1, 1, 1)
+        else:
+            shape = (x.shape[0], 1, 1, 1, x.shape[4])
+        mask = jax.random.bernoulli(rng, keep, shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+class Flatten(KerasLayer):
+    def call(self, params, x, training=False, **kw):
+        return x.reshape(x.shape[0], -1) if x.ndim > 1 else \
+            x.reshape(x.shape[0], 1)
+
+    def compute_output_shape(self, input_shape):
+        rest = [d for d in input_shape[1:]]
+        return (input_shape[0], int(np.prod(rest)) if rest else 1)
+
+
+class Reshape(KerasLayer):
+    def __init__(self, target_shape, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.target_shape = tuple(int(d) for d in target_shape)
+
+    def call(self, params, x, training=False, **kw):
+        return x.reshape((x.shape[0],) + self.target_shape)
+
+    def compute_output_shape(self, input_shape):
+        target = self.target_shape
+        if -1 in target:
+            if target.count(-1) > 1:
+                raise ValueError(f"Reshape{target}: at most one -1 allowed")
+            known = 1
+            for d in input_shape[1:]:
+                known *= int(d)
+            fixed = 1
+            for d in target:
+                if d != -1:
+                    fixed *= d
+            if known % fixed != 0:
+                raise ValueError(
+                    f"cannot Reshape {tuple(input_shape[1:])} "
+                    f"({known} elements) into {target}")
+            target = tuple(known // fixed if d == -1 else d for d in target)
+        return (input_shape[0],) + target
+
+
+class Permute(KerasLayer):
+    """Permute non-batch dims; dims are 1-based like Keras (Permute.scala)."""
+
+    def __init__(self, dims, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.dims = tuple(int(d) for d in dims)
+
+    def call(self, params, x, training=False, **kw):
+        return jnp.transpose(x, (0,) + self.dims)
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0],) + tuple(input_shape[d] for d in self.dims)
+
+
+class RepeatVector(KerasLayer):
+    def __init__(self, n, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.n = int(n)
+
+    def call(self, params, x, training=False, **kw):
+        return jnp.repeat(x[:, None, :], self.n, axis=1)
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0], self.n, input_shape[1])
+
+
+class Masking(KerasLayer):
+    def __init__(self, mask_value=0.0, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.mask_value = mask_value
+
+    def call(self, params, x, training=False, **kw):
+        keep = jnp.any(x != self.mask_value, axis=-1, keepdims=True)
+        return jnp.where(keep, x, 0.0).astype(x.dtype)
+
+
+class Highway(KerasLayer):
+    """Highway network layer (Highway.scala)."""
+
+    def __init__(self, activation="tanh", W_regularizer=None,
+                 b_regularizer=None, bias=True, input_shape=None, name=None,
+                 **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.activation = get_activation_fn(activation)
+        self.bias = bias
+
+    def build(self, rng, input_shape):
+        d = int(input_shape[-1])
+        r1, r2 = jax.random.split(rng)
+        params = {"kernel": init_tensor(r1, (d, d)),
+                  "gate_kernel": init_tensor(r2, (d, d))}
+        if self.bias:
+            params["bias"] = jnp.zeros((d,))
+            params["gate_bias"] = jnp.full((d,), -2.0)
+        return params
+
+    def call(self, params, x, training=False, **kw):
+        h = jnp.matmul(x, params["kernel"])
+        g = jnp.matmul(x, params["gate_kernel"])
+        if self.bias:
+            h = h + params["bias"]
+            g = g + params["gate_bias"]
+        h = self.activation(h) if self.activation else h
+        t = jax.nn.sigmoid(g)
+        return t * h + (1.0 - t) * x
+
+
+class MaxoutDense(KerasLayer):
+    def __init__(self, output_dim, nb_feature=4, bias=True, input_shape=None,
+                 name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.output_dim = int(output_dim)
+        self.nb_feature = int(nb_feature)
+        self.bias = bias
+
+    def build(self, rng, input_shape):
+        d = int(input_shape[-1])
+        params = {"kernel": init_tensor(
+            rng, (self.nb_feature, d, self.output_dim))}
+        if self.bias:
+            params["bias"] = jnp.zeros((self.nb_feature, self.output_dim))
+        return params
+
+    def call(self, params, x, training=False, **kw):
+        y = jnp.einsum("bd,kdo->bko", x, params["kernel"])
+        if self.bias:
+            y = y + params["bias"]
+        return jnp.max(y, axis=1)
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0], self.output_dim)
+
+
+class Select(KerasLayer):
+    """Select one index along a dim, removing it (Select.scala). ``dim``
+    counts the batch dim as 0; negative indexes from the end."""
+
+    def __init__(self, dim, index, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.dim = int(dim)
+        self.index = int(index)
+
+    def call(self, params, x, training=False, **kw):
+        idx = self.index if self.index >= 0 else x.shape[self.dim] + self.index
+        return jax.lax.index_in_dim(x, idx, self.dim, keepdims=False)
+
+    def compute_output_shape(self, input_shape):
+        dim = self.dim if self.dim >= 0 else len(input_shape) + self.dim
+        return tuple(d for i, d in enumerate(input_shape) if i != dim)
+
+
+class Narrow(KerasLayer):
+    """Slice `length` elements starting at `offset` along `dim`
+    (Narrow.scala)."""
+
+    def __init__(self, dim, offset, length=1, input_shape=None, name=None,
+                 **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.dim, self.offset, self.length = int(dim), int(offset), int(length)
+
+    def call(self, params, x, training=False, **kw):
+        length = self.length if self.length > 0 else \
+            x.shape[self.dim] - self.offset
+        return jax.lax.slice_in_dim(x, self.offset, self.offset + length,
+                                    axis=self.dim)
+
+    def compute_output_shape(self, input_shape):
+        dim = self.dim if self.dim >= 0 else len(input_shape) + self.dim
+        length = self.length if self.length > 0 else \
+            input_shape[dim] - self.offset
+        return tuple(length if i == dim else d
+                     for i, d in enumerate(input_shape))
+
+
+class Squeeze(KerasLayer):
+    def __init__(self, dim, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.dim = dim
+
+    def call(self, params, x, training=False, **kw):
+        return jnp.squeeze(x, axis=self.dim)
+
+    def compute_output_shape(self, input_shape):
+        dims = self.dim if isinstance(self.dim, (list, tuple)) else [self.dim]
+        dims = [d if d >= 0 else len(input_shape) + d for d in dims]
+        return tuple(d for i, d in enumerate(input_shape) if i not in dims)
+
+
+class ExpandDim(KerasLayer):
+    def __init__(self, dim, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.dim = int(dim)
+
+    def call(self, params, x, training=False, **kw):
+        return jnp.expand_dims(x, self.dim)
+
+    def compute_output_shape(self, input_shape):
+        shape = list(input_shape)
+        dim = self.dim if self.dim >= 0 else len(shape) + self.dim + 1
+        shape.insert(dim, 1)
+        return tuple(shape)
+
+
+class Identity(KerasLayer):
+    def call(self, params, x, training=False, **kw):
+        return x
+
+
+class Max(KerasLayer):
+    """Max along a dim (Max.scala), optionally returning indices."""
+
+    def __init__(self, dim, num_input_dims=-1, return_value=True,
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.dim = int(dim)
+        self.return_value = return_value
+
+    def call(self, params, x, training=False, **kw):
+        if self.return_value:
+            return jnp.max(x, axis=self.dim)
+        return jnp.argmax(x, axis=self.dim)
+
+    def compute_output_shape(self, input_shape):
+        dim = self.dim if self.dim >= 0 else len(input_shape) + self.dim
+        return tuple(d for i, d in enumerate(input_shape) if i != dim)
+
+
+class SplitTensor(KerasLayer):
+    """Split along a dim into equal chunks (SplitTensor.scala)."""
+
+    def __init__(self, dim, num_splits, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.dim = int(dim)
+        self.num_splits = int(num_splits)
+        self.num_outputs = self.num_splits
+
+    def call(self, params, x, training=False, **kw):
+        return tuple(jnp.split(x, self.num_splits, axis=self.dim))
+
+    def compute_output_shape(self, input_shape):
+        dim = self.dim if self.dim >= 0 else len(input_shape) + self.dim
+        chunk = input_shape[dim] // self.num_splits if input_shape[dim] else \
+            None
+        one = tuple(chunk if i == dim else d
+                    for i, d in enumerate(input_shape))
+        return [one] * self.num_splits
+
+
+# ---------------------------------------------------------------------------
+# Simple elementwise layers
+# ---------------------------------------------------------------------------
+
+class _Elementwise(KerasLayer):
+    fn = staticmethod(lambda x: x)
+
+    def call(self, params, x, training=False, **kw):
+        return type(self).fn(x)
+
+
+class Exp(_Elementwise):
+    fn = staticmethod(jnp.exp)
+
+
+class Log(_Elementwise):
+    fn = staticmethod(jnp.log)
+
+
+class Sqrt(_Elementwise):
+    fn = staticmethod(jnp.sqrt)
+
+
+class Square(_Elementwise):
+    fn = staticmethod(jnp.square)
+
+
+class Negative(_Elementwise):
+    fn = staticmethod(jnp.negative)
+
+
+class Power(KerasLayer):
+    def __init__(self, power, scale=1.0, shift=0.0, input_shape=None,
+                 name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.power, self.scale, self.shift = power, scale, shift
+
+    def call(self, params, x, training=False, **kw):
+        return jnp.power(self.scale * x + self.shift, self.power)
+
+
+class AddConstant(KerasLayer):
+    def __init__(self, constant, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.constant = constant
+
+    def call(self, params, x, training=False, **kw):
+        return x + self.constant
+
+
+class MulConstant(KerasLayer):
+    def __init__(self, constant, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.constant = constant
+
+    def call(self, params, x, training=False, **kw):
+        return x * self.constant
+
+
+class CAdd(KerasLayer):
+    """Learnable per-element bias with broadcastable shape (CAdd.scala)."""
+
+    def __init__(self, size, b_regularizer=None, input_shape=None, name=None,
+                 **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.size = tuple(int(s) for s in size)
+
+    def build(self, rng, input_shape):
+        return {"bias": jnp.zeros(self.size)}
+
+    def call(self, params, x, training=False, **kw):
+        return x + params["bias"]
+
+
+class CMul(KerasLayer):
+    def __init__(self, size, W_regularizer=None, input_shape=None, name=None,
+                 **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.size = tuple(int(s) for s in size)
+
+    def build(self, rng, input_shape):
+        return {"weight": jnp.ones(self.size)}
+
+    def call(self, params, x, training=False, **kw):
+        return x * params["weight"]
+
+
+class Mul(KerasLayer):
+    """Single learnable scalar multiplier (Mul.scala)."""
+
+    def build(self, rng, input_shape):
+        return {"weight": jnp.ones(())}
+
+    def call(self, params, x, training=False, **kw):
+        return x * params["weight"]
+
+
+class Scale(KerasLayer):
+    """y = weight * x + bias, both of shape `size` (Scale.scala)."""
+
+    def __init__(self, size, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.size = tuple(int(s) for s in size)
+
+    def build(self, rng, input_shape):
+        return {"weight": jnp.ones(self.size), "bias": jnp.zeros(self.size)}
+
+    def call(self, params, x, training=False, **kw):
+        return x * params["weight"] + params["bias"]
+
+
+class BinaryThreshold(KerasLayer):
+    def __init__(self, value=1e-6, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.value = value
+
+    def call(self, params, x, training=False, **kw):
+        return (x > self.value).astype(x.dtype)
+
+
+class Threshold(KerasLayer):
+    def __init__(self, th=1e-6, v=0.0, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.th, self.v = th, v
+
+    def call(self, params, x, training=False, **kw):
+        return jnp.where(x > self.th, x, self.v).astype(x.dtype)
+
+
+class HardTanh(KerasLayer):
+    def __init__(self, min_value=-1.0, max_value=1.0, input_shape=None,
+                 name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.min_value, self.max_value = min_value, max_value
+
+    def call(self, params, x, training=False, **kw):
+        return jnp.clip(x, self.min_value, self.max_value)
+
+
+class HardShrink(KerasLayer):
+    def __init__(self, value=0.5, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.value = value
+
+    def call(self, params, x, training=False, **kw):
+        return jnp.where(jnp.abs(x) > self.value, x, 0.0).astype(x.dtype)
+
+
+class SoftShrink(KerasLayer):
+    def __init__(self, value=0.5, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.value = value
+
+    def call(self, params, x, training=False, **kw):
+        return jnp.sign(x) * jnp.maximum(jnp.abs(x) - self.value, 0.0)
+
+
+class GaussianNoise(KerasLayer):
+    stochastic = True
+
+    def __init__(self, sigma, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.sigma = sigma
+
+    def call(self, params, x, training=False, rng=None, **kw):
+        if not training or rng is None:
+            return x
+        return x + self.sigma * jax.random.normal(rng, x.shape, x.dtype)
+
+
+class GaussianDropout(KerasLayer):
+    stochastic = True
+
+    def __init__(self, p, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.p = p
+
+    def call(self, params, x, training=False, rng=None, **kw):
+        if not training or rng is None:
+            return x
+        stddev = np.sqrt(self.p / (1.0 - self.p))
+        return x * (1.0 + stddev * jax.random.normal(rng, x.shape, x.dtype))
+
+
+class GaussianSampler(KerasLayer):
+    """VAE reparameterization: input [mean, log_var] (GaussianSampler.scala)."""
+
+    stochastic = True
+
+    def call(self, params, x, training=False, rng=None, **kw):
+        mean, log_var = x
+        if rng is None:
+            return mean
+        eps = jax.random.normal(rng, mean.shape, mean.dtype)
+        return mean + jnp.exp(0.5 * log_var) * eps
+
+    def compute_output_shape(self, input_shape):
+        return input_shape[0]
+
+
+class ResizeBilinear(KerasLayer):
+    def __init__(self, output_height, output_width, align_corners=False,
+                 dim_ordering="th", input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.oh, self.ow = int(output_height), int(output_width)
+        self.dim_ordering = dim_ordering
+
+    def call(self, params, x, training=False, **kw):
+        if self.dim_ordering == "th":
+            shape = (x.shape[0], x.shape[1], self.oh, self.ow)
+        else:
+            shape = (x.shape[0], self.oh, self.ow, x.shape[3])
+        return jax.image.resize(x, shape, method="bilinear")
+
+    def compute_output_shape(self, s):
+        if self.dim_ordering == "th":
+            return (s[0], s[1], self.oh, self.ow)
+        return (s[0], self.oh, self.ow, s[3])

@@ -1,0 +1,59 @@
+"""CRF sequence-labeling head.
+
+The reference's NER model is *defined* by this head: nlp_architect's NERCRF
+(``pyzoo/zoo/tfpark/text/keras/ner.py:49``) and the ``classifier='crf'``
+option of SequenceTagger (``pos_tagging.py``). The math lives in
+``ops/crf.py`` (scan-based forward algorithm + Viterbi).
+
+Because the framework's losses see only model *outputs*, the layer emits
+``[unary_scores, transitions]`` (transitions broadcast over the batch) and
+:class:`~analytics_zoo_tpu.pipeline.api.keras.objectives.CRFLoss` consumes
+the pair; decoding goes through :meth:`CRF.decode`.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..engine.base import KerasLayer
+from .....ops import crf as crf_ops
+
+
+class CRF(KerasLayer):
+    """Linear-chain CRF over per-token scores.
+
+    Input: unary scores ``(B, L, E)`` (logits). Outputs:
+    ``[unary (B, L, E), transitions (B, E, E)]``.
+    """
+
+    num_outputs = 2
+
+    def __init__(self, num_tags, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.num_tags = int(num_tags)
+
+    def build(self, rng, input_shape):
+        del rng  # transitions start at zero (uniform), like nlp_architect
+        return {"trans": jnp.zeros((self.num_tags, self.num_tags),
+                                   jnp.float32)}
+
+    def call(self, params, x, training=False, **kw):
+        b = x.shape[0]
+        trans = jnp.broadcast_to(params["trans"][None],
+                                 (b, self.num_tags, self.num_tags))
+        return x.astype(jnp.float32), trans
+
+    def compute_output_shape(self, input_shape):
+        return [tuple(input_shape),
+                (input_shape[0], self.num_tags, self.num_tags)]
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def decode(unary, trans, mask=None):
+        """Viterbi-decode model outputs: ``(B, L)`` best tags (numpy)."""
+        trans = trans[0] if np.ndim(trans) == 3 else trans
+        tags, _ = crf_ops.crf_decode(jnp.asarray(unary), jnp.asarray(trans),
+                                     None if mask is None
+                                     else jnp.asarray(mask))
+        return np.asarray(tags)

@@ -1,0 +1,114 @@
+"""Embedding layers.
+
+Parity: Embedding.scala, SparseEmbedding.scala, WordEmbedding.scala (400 LoC
+— frozen pretrained word vectors). On TPU an embedding lookup is a gather
+from an HBM-resident table; for tensor parallelism the table is annotated
+('vocab', 'embed') so it can shard over the model axis.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..engine.base import KerasLayer, init_tensor
+
+
+class Embedding(KerasLayer):
+    def __init__(self, input_dim, output_dim, init="uniform", weights=None,
+                 trainable=True, input_length=None, W_regularizer=None,
+                 input_shape=None, name=None, **kwargs):
+        if input_shape is None and input_length is not None:
+            input_shape = (input_length,)
+        super().__init__(input_shape=input_shape, name=name)
+        self.input_dim = int(input_dim)
+        self.output_dim = int(output_dim)
+        self.init = init
+        self.weights = weights
+        self.trainable = trainable
+
+    def build(self, rng, input_shape):
+        if self.weights is not None:
+            table = jnp.asarray(self.weights, jnp.float32)
+            assert table.shape == (self.input_dim, self.output_dim)
+        else:
+            table = init_tensor(rng, (self.input_dim, self.output_dim),
+                                self.init)
+        self._annotate(table=("vocab", "embed"))
+        return {"table": table}
+
+    def call(self, params, x, training=False, **kw):
+        idx = x.astype(jnp.int32)
+        table = params["table"]
+        if not self.trainable:
+            table = jax.lax.stop_gradient(table)
+        return jnp.take(table, idx, axis=0)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape) + (self.output_dim,)
+
+
+class SparseEmbedding(Embedding):
+    """The reference's SparseEmbedding backs sparse-gradient updates
+    (SparseEmbedding.scala). On TPU, XLA already turns the gather's backward
+    pass into a scatter-add; dense optimizer state is sharded, so the class
+    is an alias with the same construction surface."""
+
+
+class WordEmbedding(KerasLayer):
+    """Pretrained, frozen word embeddings (WordEmbedding.scala). Build from
+    a {word: vector} map or a glove file via ``WordEmbedding.from_glove``."""
+
+    def __init__(self, embedding_file=None, word_index=None, trainable=False,
+                 input_length=None, weights=None, input_shape=None, name=None,
+                 **kwargs):
+        if input_shape is None and input_length is not None:
+            input_shape = (input_length,)
+        super().__init__(input_shape=input_shape, name=name)
+        self.trainable = trainable
+        if weights is not None:
+            self.table = np.asarray(weights, np.float32)
+        elif embedding_file is not None:
+            self.table = _load_glove_table(embedding_file, word_index)
+        else:
+            raise ValueError("need weights or embedding_file")
+        self.output_dim = self.table.shape[1]
+
+    def build(self, rng, input_shape):
+        return {"table": jnp.asarray(self.table)}
+
+    def call(self, params, x, training=False, **kw):
+        table = params["table"]
+        if not self.trainable:
+            table = jax.lax.stop_gradient(table)
+        return jnp.take(table, x.astype(jnp.int32), axis=0)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape) + (self.output_dim,)
+
+    @staticmethod
+    def get_word_index(embedding_file):
+        index = {}
+        with open(embedding_file, "r", encoding="utf-8") as f:
+            for i, line in enumerate(f):
+                word = line.split(" ", 1)[0]
+                index[word] = i + 1
+        return index
+
+
+def _load_glove_table(path, word_index=None):
+    vectors = {}
+    dim = None
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            parts = line.rstrip().split(" ")
+            vectors[parts[0]] = np.asarray(parts[1:], np.float32)
+            dim = len(parts) - 1
+    if word_index is None:
+        word_index = {w: i + 1 for i, w in enumerate(vectors)}
+    table = np.zeros((max(word_index.values()) + 1, dim), np.float32)
+    for word, idx in word_index.items():
+        if word in vectors:
+            table[idx] = vectors[word]
+    return table

@@ -1,0 +1,134 @@
+"""Sparse Mixture-of-Experts layer, sharded over the ``expert`` mesh axis.
+
+Rebuild-scope new work (the reference has no MoE / expert parallelism —
+SURVEY.md §2.3 lists EP as absent). TPU-first design: the classic
+top-k-gating MoE (Shazeer-style) expressed entirely as dense einsums over a
+stacked expert dimension so XLA can lay the experts across the ``expert``
+mesh axis and insert the dispatch/combine all-to-alls itself — no
+host-side routing, no ragged shapes, MXU-shaped matmuls throughout.
+
+Dispatch uses the standard one-hot capacity scheme: each token picks its
+top-k experts; a running per-expert cumsum assigns capacity slots; tokens
+over capacity are dropped (their combine weight is zero), keeping every
+shape static under ``jit``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..engine.base import KerasLayer, get_activation_fn, init_tensor
+
+
+class SparseMoE(KerasLayer):
+    """Top-k gated mixture of expert MLPs.
+
+    Input ``(B, L, H)`` (or ``(B, H)``); output same shape. Expert weights
+    are stacked ``(E, ...)`` and annotated with the ``expert`` logical axis
+    so ``parallel.sharding`` lays them across the ``expert`` mesh axis.
+    """
+
+    def __init__(self, n_experts: int, intermediate_size: int,
+                 top_k: int = 2, capacity_factor: float = 1.25,
+                 activation: str = "gelu", router_noise: float = 0.0,
+                 input_shape=None, name: Optional[str] = None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        if top_k < 1 or top_k > n_experts:
+            raise ValueError(f"top_k {top_k} out of range for "
+                             f"{n_experts} experts")
+        self.n_experts = n_experts
+        self.intermediate_size = intermediate_size
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.activation = get_activation_fn(activation)
+        self.router_noise = router_noise
+
+    def build(self, rng, input_shape):
+        h = int(input_shape[-1])
+        e, f = self.n_experts, self.intermediate_size
+        r1, r2, r3 = jax.random.split(rng, 3)
+        params = {
+            "router_w": init_tensor(r1, (h, e)),
+            "w_in": init_tensor(r2, (e, h, f)),
+            "b_in": jnp.zeros((e, f)),
+            "w_out": init_tensor(r3, (e, f, h)),
+            "b_out": jnp.zeros((e, h)),
+        }
+        self._annotate(**{
+            "router_w": ("embed", None),
+            "w_in": ("expert", "embed", "mlp"),
+            "b_in": ("expert", "mlp"),
+            "w_out": ("expert", "mlp", "embed"),
+            "b_out": ("expert", "embed"),
+        })
+        return params
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape)
+
+    # ------------------------------------------------------------------
+    def _route(self, params, flat, rng, training):
+        logits = jnp.matmul(flat, params["router_w"].astype(flat.dtype))
+        if training and self.router_noise > 0 and rng is not None:
+            logits = logits + self.router_noise * jax.random.normal(
+                rng, logits.shape, logits.dtype)
+        gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        return gates
+
+    def call(self, params, inputs, training: bool = False, rng=None,
+             **kwargs):
+        x = inputs[0] if isinstance(inputs, (list, tuple)) else inputs
+        orig_shape = x.shape
+        h = orig_shape[-1]
+        flat = x.reshape(-1, h)                       # (N, H)
+        n = flat.shape[0]
+        e, k = self.n_experts, self.top_k
+        cap = max(1, int(math.ceil(k * n / e * self.capacity_factor)))
+
+        gates = self._route(params, flat, rng, training)     # (N, E) f32
+        top_w, top_i = jax.lax.top_k(gates, k)               # (N, k)
+        top_w = top_w / jnp.maximum(top_w.sum(-1, keepdims=True), 1e-9)
+
+        # capacity assignment: a running per-expert count across k slots
+        dispatch = jnp.zeros((n, e, cap), jnp.float32)
+        combine = jnp.zeros((n, e, cap), jnp.float32)
+        used = jnp.zeros((e,), jnp.float32)  # slots consumed per expert
+        for slot in range(k):                # k is small and static
+            onehot = jax.nn.one_hot(top_i[:, slot], e)       # (N, E)
+            pos = jnp.cumsum(onehot, axis=0) - 1 + used[None, :]
+            pos = pos * onehot
+            in_cap = (pos < cap).astype(jnp.float32) * onehot
+            sel = jax.nn.one_hot(jnp.clip(pos, 0, cap - 1).astype(jnp.int32),
+                                 cap) * in_cap[..., None]    # (N, E, C)
+            dispatch = dispatch + sel
+            combine = combine + sel * top_w[:, slot][:, None, None]
+            used = used + jnp.sum(onehot, axis=0)
+
+        xin = jnp.einsum("nec,nh->ech", dispatch.astype(x.dtype), flat)
+        h1 = jnp.einsum("ech,ehf->ecf", xin,
+                        params["w_in"].astype(x.dtype)) + \
+            params["b_in"][:, None].astype(x.dtype)
+        h1 = self.activation(h1)
+        h2 = jnp.einsum("ecf,efh->ech", h1,
+                        params["w_out"].astype(x.dtype)) + \
+            params["b_out"][:, None].astype(x.dtype)
+        out = jnp.einsum("nec,ech->nh", combine.astype(x.dtype), h2)
+        return out.reshape(orig_shape)
+
+    # ------------------------------------------------------------------
+    def load_balancing_loss(self, params, x):
+        """Switch-style aux loss ``E * sum_e f_e * p_e`` (fraction of tokens
+        routed to e × mean router prob for e); add to the training loss to
+        keep experts utilized."""
+        x = x[0] if isinstance(x, (list, tuple)) else x
+        flat = x.reshape(-1, x.shape[-1])
+        gates = self._route(params, flat, None, False)
+        top1 = jnp.argmax(gates, axis=-1)
+        frac = jnp.mean(jax.nn.one_hot(top1, self.n_experts), axis=0)
+        prob = jnp.mean(gates, axis=0)
+        return self.n_experts * jnp.sum(frac * prob)

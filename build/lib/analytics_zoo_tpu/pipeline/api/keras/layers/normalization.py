@@ -1,0 +1,131 @@
+"""Normalization layers: BatchNormalization (moving stats as engine state),
+LayerNorm, LRN2D, WithinChannelLRN2D.
+
+Parity: BatchNormalization.scala (Keras-1 args: axis default 1 = channel for
+'th' ordering), LayerNorm.scala / InternalLayerNorm.scala (used by
+Transformer/BERT).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..engine.base import KerasLayer
+
+
+class BatchNormalization(KerasLayer):
+    has_state = True
+
+    def __init__(self, epsilon=1e-3, momentum=0.99, beta_init="zero",
+                 gamma_init="one", axis=1, dim_ordering="th",
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.epsilon = epsilon
+        self.momentum = momentum
+        self.axis = axis
+        self.scale_and_center = True
+
+    def _dim(self, input_shape):
+        axis = self.axis if self.axis >= 0 else len(input_shape) + self.axis
+        d = input_shape[axis]
+        return axis, int(d)
+
+    def build(self, rng, input_shape):
+        _, d = self._dim(input_shape)
+        return {"gamma": jnp.ones((d,)), "beta": jnp.zeros((d,))}
+
+    def init_state(self, input_shape):
+        _, d = self._dim(input_shape)
+        return {"moving_mean": jnp.zeros((d,)),
+                "moving_var": jnp.ones((d,))}
+
+    def call(self, params, x, training=False, state=None, **kw):
+        axis, d = self._dim((None,) + x.shape[1:])
+        reduce_axes = tuple(i for i in range(x.ndim) if i != axis)
+        bshape = [1] * x.ndim
+        bshape[axis] = d
+        state = state or self.init_state((None,) + x.shape[1:])
+        if training:
+            mean = jnp.mean(x, axis=reduce_axes)
+            var = jnp.var(x, axis=reduce_axes)
+            m = self.momentum
+            new_state = {
+                "moving_mean": m * state["moving_mean"] + (1 - m) * mean,
+                "moving_var": m * state["moving_var"] + (1 - m) * var,
+            }
+        else:
+            mean, var = state["moving_mean"], state["moving_var"]
+            new_state = state
+        inv = jax.lax.rsqrt(var + self.epsilon)
+        y = (x - mean.reshape(bshape)) * inv.reshape(bshape)
+        y = y * params["gamma"].reshape(bshape) + \
+            params["beta"].reshape(bshape)
+        return y.astype(x.dtype), new_state
+
+
+class LayerNorm(KerasLayer):
+    """Layer normalization over the last dim (LayerNorm.scala /
+    InternalLayerNorm.scala — hidden_size, epsilon args)."""
+
+    def __init__(self, hidden_size=None, epsilon=1e-5, input_shape=None,
+                 name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.hidden_size = hidden_size
+        self.epsilon = epsilon
+
+    def build(self, rng, input_shape):
+        d = int(self.hidden_size or input_shape[-1])
+        return {"gamma": jnp.ones((d,)), "beta": jnp.zeros((d,))}
+
+    def call(self, params, x, training=False, **kw):
+        # compute moments in f32 for bf16 inputs (TPU numerics guardrail)
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+        y = (xf - mean) * jax.lax.rsqrt(var + self.epsilon)
+        y = y * params["gamma"] + params["beta"]
+        return y.astype(x.dtype)
+
+
+class LRN2D(KerasLayer):
+    """Local response normalization across channels (LRN2D.scala)."""
+
+    def __init__(self, alpha=1e-4, k=1.0, beta=0.75, n=5, dim_ordering="th",
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.alpha, self.k, self.beta, self.n = alpha, k, beta, int(n)
+        self.dim_ordering = dim_ordering
+
+    def call(self, params, x, training=False, **kw):
+        c_axis = 1 if self.dim_ordering == "th" else 3
+        sq = jnp.square(x)
+        half = self.n // 2
+        # sum over a sliding window of channels via padding + cumsum
+        pads = [(0, 0)] * x.ndim
+        pads[c_axis] = (half, half)
+        padded = jnp.pad(sq, pads)
+        windows = [jax.lax.slice_in_dim(padded, i, i + x.shape[c_axis],
+                                        axis=c_axis)
+                   for i in range(self.n)]
+        norm = self.k + (self.alpha / self.n) * sum(windows)
+        return x / jnp.power(norm, self.beta)
+
+
+class WithinChannelLRN2D(KerasLayer):
+    def __init__(self, size=5, alpha=1.0, beta=0.75, input_shape=None,
+                 name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.size, self.alpha, self.beta = int(size), alpha, beta
+
+    def call(self, params, x, training=False, **kw):
+        # average of squares over a spatial window per channel ('th' layout)
+        sq = jnp.square(x)
+        window = jnp.ones((self.size, self.size), x.dtype) / (self.size ** 2)
+        kernel = window[None, None]
+        b, c, h, w = x.shape
+        avg = jax.lax.conv_general_dilated(
+            sq.reshape(b * c, 1, h, w), kernel, (1, 1), "SAME",
+            dimension_numbers=("NCHW", "OIHW", "NCHW")).reshape(b, c, h, w)
+        return x / jnp.power(1.0 + self.alpha * avg, self.beta)

@@ -1,0 +1,191 @@
+"""Pooling layers (Pooling1D/2D/3D + Global variants, keras/layers/*.scala).
+All lower to ``lax.reduce_window`` — XLA maps these onto the VPU."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..engine.base import KerasLayer
+from .convolutional import _conv_out, _pair
+
+
+def _pool2d(x, window, strides, padding, mode, dim_ordering):
+    if dim_ordering == "th":
+        dims = (1, 1) + window
+        strd = (1, 1) + strides
+    else:
+        dims = (1,) + window + (1,)
+        strd = (1,) + strides + (1,)
+    if mode == "max":
+        init = -jnp.inf
+        out = jax.lax.reduce_window(x, init, jax.lax.max, dims, strd, padding)
+        return out
+    out = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strd, padding)
+    if padding == "VALID":
+        return out / float(np.prod(window))
+    ones = jnp.ones_like(x)
+    counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims, strd,
+                                   padding)
+    return out / counts
+
+
+class MaxPooling2D(KerasLayer):
+    mode = "max"
+
+    def __init__(self, pool_size=(2, 2), strides=None, border_mode="valid",
+                 dim_ordering="th", input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.pool_size = _pair(pool_size)
+        self.strides = _pair(strides) if strides is not None else \
+            self.pool_size
+        self.border_mode = border_mode
+        self.dim_ordering = dim_ordering
+
+    def call(self, params, x, training=False, **kw):
+        pad = "SAME" if self.border_mode == "same" else "VALID"
+        return _pool2d(x, self.pool_size, self.strides, pad, self.mode,
+                       self.dim_ordering)
+
+    def compute_output_shape(self, s):
+        ph, pw = self.pool_size
+        sh, sw = self.strides
+        if self.dim_ordering == "th":
+            return (s[0], s[1], _conv_out(s[2], ph, sh, self.border_mode),
+                    _conv_out(s[3], pw, sw, self.border_mode))
+        return (s[0], _conv_out(s[1], ph, sh, self.border_mode),
+                _conv_out(s[2], pw, sw, self.border_mode), s[3])
+
+
+class AveragePooling2D(MaxPooling2D):
+    mode = "avg"
+
+
+class MaxPooling1D(KerasLayer):
+    mode = "max"
+
+    def __init__(self, pool_length=2, stride=None, border_mode="valid",
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.pool_length = int(pool_length)
+        self.stride = int(stride) if stride is not None else self.pool_length
+        self.border_mode = border_mode
+
+    def call(self, params, x, training=False, **kw):
+        pad = "SAME" if self.border_mode == "same" else "VALID"
+        dims = (1, self.pool_length, 1)
+        strd = (1, self.stride, 1)
+        if self.mode == "max":
+            return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, dims,
+                                         strd, pad)
+        out = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strd, pad)
+        if pad == "VALID":
+            return out / float(self.pool_length)
+        counts = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add,
+                                       dims, strd, pad)
+        return out / counts
+
+    def compute_output_shape(self, s):
+        return (s[0], _conv_out(s[1], self.pool_length, self.stride,
+                                self.border_mode), s[2])
+
+
+class AveragePooling1D(MaxPooling1D):
+    mode = "avg"
+
+
+class MaxPooling3D(KerasLayer):
+    mode = "max"
+
+    def __init__(self, pool_size=(2, 2, 2), strides=None, border_mode="valid",
+                 dim_ordering="th", input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.pool_size = tuple(pool_size)
+        self.strides = tuple(strides) if strides is not None else \
+            self.pool_size
+        self.border_mode = border_mode
+        self.dim_ordering = dim_ordering
+
+    def call(self, params, x, training=False, **kw):
+        pad = "SAME" if self.border_mode == "same" else "VALID"
+        if self.dim_ordering == "th":
+            dims = (1, 1) + self.pool_size
+            strd = (1, 1) + self.strides
+        else:
+            dims = (1,) + self.pool_size + (1,)
+            strd = (1,) + self.strides + (1,)
+        if self.mode == "max":
+            return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, dims,
+                                         strd, pad)
+        out = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strd, pad)
+        if pad == "VALID":
+            return out / float(np.prod(self.pool_size))
+        counts = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add,
+                                       dims, strd, pad)
+        return out / counts
+
+    def compute_output_shape(self, s):
+        ps, ss = self.pool_size, self.strides
+        off = 2 if self.dim_ordering == "th" else 1
+        dims = tuple(_conv_out(s[off + i], ps[i], ss[i], self.border_mode)
+                     for i in range(3))
+        if self.dim_ordering == "th":
+            return (s[0], s[1]) + dims
+        return (s[0],) + dims + (s[4],)
+
+
+class AveragePooling3D(MaxPooling3D):
+    mode = "avg"
+
+
+class GlobalMaxPooling2D(KerasLayer):
+    def __init__(self, dim_ordering="th", input_shape=None, name=None, **kw):
+        super().__init__(input_shape=input_shape, name=name)
+        self.dim_ordering = dim_ordering
+
+    def call(self, params, x, training=False, **kw):
+        axes = (2, 3) if self.dim_ordering == "th" else (1, 2)
+        return jnp.max(x, axis=axes)
+
+    def compute_output_shape(self, s):
+        return (s[0], s[1] if self.dim_ordering == "th" else s[3])
+
+
+class GlobalAveragePooling2D(GlobalMaxPooling2D):
+    def call(self, params, x, training=False, **kw):
+        axes = (2, 3) if self.dim_ordering == "th" else (1, 2)
+        return jnp.mean(x, axis=axes)
+
+
+class GlobalMaxPooling1D(KerasLayer):
+    def call(self, params, x, training=False, **kw):
+        return jnp.max(x, axis=1)
+
+    def compute_output_shape(self, s):
+        return (s[0], s[2])
+
+
+class GlobalAveragePooling1D(GlobalMaxPooling1D):
+    def call(self, params, x, training=False, **kw):
+        return jnp.mean(x, axis=1)
+
+
+class GlobalMaxPooling3D(KerasLayer):
+    def __init__(self, dim_ordering="th", input_shape=None, name=None, **kw):
+        super().__init__(input_shape=input_shape, name=name)
+        self.dim_ordering = dim_ordering
+
+    def _axes(self):
+        return (2, 3, 4) if self.dim_ordering == "th" else (1, 2, 3)
+
+    def call(self, params, x, training=False, **kw):
+        return jnp.max(x, axis=self._axes())
+
+    def compute_output_shape(self, s):
+        return (s[0], s[1] if self.dim_ordering == "th" else s[4])
+
+
+class GlobalAveragePooling3D(GlobalMaxPooling3D):
+    def call(self, params, x, training=False, **kw):
+        return jnp.mean(x, axis=self._axes())

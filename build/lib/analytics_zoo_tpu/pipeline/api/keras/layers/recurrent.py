@@ -1,0 +1,319 @@
+"""Recurrent layers: SimpleRNN / LSTM / GRU / ConvLSTM2D / ConvLSTM3D.
+
+Parity: keras/layers/{SimpleRNN,LSTM,GRU,ConvLSTM2D,ConvLSTM3D}.scala with
+Keras-1 semantics (activation tanh, inner_activation hard_sigmoid for
+LSTM/GRU; return_sequences, go_backwards).
+
+TPU design: the time loop is a single ``lax.scan`` — one compiled loop body,
+with the input projection (x @ W for all timesteps) hoisted out of the scan as
+one big MXU matmul; only the small recurrent matmul stays inside the loop.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..engine.base import KerasLayer, get_activation_fn, init_tensor
+
+
+class _RNNBase(KerasLayer):
+    def __init__(self, output_dim, activation="tanh", return_sequences=False,
+                 go_backwards=False, W_regularizer=None, U_regularizer=None,
+                 b_regularizer=None, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.output_dim = int(output_dim)
+        self.activation = get_activation_fn(activation)
+        self.return_sequences = return_sequences
+        self.go_backwards = go_backwards
+
+    def compute_output_shape(self, s):
+        if self.return_sequences:
+            return (s[0], s[1], self.output_dim)
+        return (s[0], self.output_dim)
+
+    def _scan(self, cell, init_carry, xw):
+        # xw: (B, T, ...) pre-projected inputs; scan over T
+        xs = jnp.swapaxes(xw, 0, 1)
+        if self.go_backwards:
+            xs = jnp.flip(xs, axis=0)
+        carry, ys = jax.lax.scan(cell, init_carry, xs)
+        ys = jnp.swapaxes(ys, 0, 1)
+        if self.go_backwards and self.return_sequences:
+            ys = jnp.flip(ys, axis=1)
+        return carry, ys
+
+
+class SimpleRNN(_RNNBase):
+    def build(self, rng, input_shape):
+        d = int(input_shape[-1])
+        h = self.output_dim
+        r1, r2 = jax.random.split(rng)
+        return {"W": init_tensor(r1, (d, h)),
+                "U": init_tensor(r2, (h, h), "orthogonal"),
+                "b": jnp.zeros((h,))}
+
+    def call(self, params, x, training=False, **kw):
+        h = self.output_dim
+        xw = jnp.matmul(x, params["W"].astype(x.dtype)) + \
+            params["b"].astype(x.dtype)
+        U = params["U"].astype(x.dtype)
+
+        def cell(carry, xt):
+            ht = self.activation(xt + jnp.matmul(carry, U))
+            return ht, ht
+
+        init = jnp.zeros((x.shape[0], h), x.dtype)
+        carry, ys = self._scan(cell, init, xw)
+        return ys if self.return_sequences else carry
+
+
+class LSTM(_RNNBase):
+    """Gate order [i, f, c, o] (Keras-1 convention)."""
+
+    def __init__(self, output_dim, activation="tanh",
+                 inner_activation="hard_sigmoid", return_sequences=False,
+                 go_backwards=False, W_regularizer=None, U_regularizer=None,
+                 b_regularizer=None, input_shape=None, name=None, **kwargs):
+        super().__init__(output_dim, activation=activation,
+                         return_sequences=return_sequences,
+                         go_backwards=go_backwards, input_shape=input_shape,
+                         name=name)
+        self.inner_activation = get_activation_fn(inner_activation)
+
+    def build(self, rng, input_shape):
+        d = int(input_shape[-1])
+        h = self.output_dim
+        r1, r2 = jax.random.split(rng)
+        b = jnp.zeros((4 * h,))
+        # forget-gate bias 1.0 (standard; BigDL does the same)
+        b = b.at[h:2 * h].set(1.0)
+        return {"W": init_tensor(r1, (d, 4 * h)),
+                "U": init_tensor(r2, (h, 4 * h), "orthogonal"),
+                "b": b}
+
+    def call(self, params, x, training=False, **kw):
+        h = self.output_dim
+        xw = jnp.matmul(x, params["W"].astype(x.dtype)) + \
+            params["b"].astype(x.dtype)
+        U = params["U"].astype(x.dtype)
+        act, inner = self.activation, self.inner_activation
+
+        def cell(carry, xt):
+            h_prev, c_prev = carry
+            z = xt + jnp.matmul(h_prev, U)
+            i = inner(z[:, :h])
+            f = inner(z[:, h:2 * h])
+            g = act(z[:, 2 * h:3 * h])
+            o = inner(z[:, 3 * h:])
+            c = f * c_prev + i * g
+            ht = o * act(c)
+            return (ht, c), ht
+
+        init = (jnp.zeros((x.shape[0], h), x.dtype),
+                jnp.zeros((x.shape[0], h), x.dtype))
+        carry, ys = self._scan(cell, init, xw)
+        return ys if self.return_sequences else carry[0]
+
+
+class GRU(_RNNBase):
+    """Gate order [z, r, h] (Keras-1 convention)."""
+
+    def __init__(self, output_dim, activation="tanh",
+                 inner_activation="hard_sigmoid", return_sequences=False,
+                 go_backwards=False, W_regularizer=None, U_regularizer=None,
+                 b_regularizer=None, input_shape=None, name=None, **kwargs):
+        super().__init__(output_dim, activation=activation,
+                         return_sequences=return_sequences,
+                         go_backwards=go_backwards, input_shape=input_shape,
+                         name=name)
+        self.inner_activation = get_activation_fn(inner_activation)
+
+    def build(self, rng, input_shape):
+        d = int(input_shape[-1])
+        h = self.output_dim
+        r1, r2 = jax.random.split(rng)
+        return {"W": init_tensor(r1, (d, 3 * h)),
+                "U": init_tensor(r2, (h, 3 * h), "orthogonal"),
+                "b": jnp.zeros((3 * h,))}
+
+    def call(self, params, x, training=False, **kw):
+        h = self.output_dim
+        xw = jnp.matmul(x, params["W"].astype(x.dtype)) + \
+            params["b"].astype(x.dtype)
+        U = params["U"].astype(x.dtype)
+        act, inner = self.activation, self.inner_activation
+
+        def cell(h_prev, xt):
+            zr = xt[:, :2 * h] + jnp.matmul(h_prev, U[:, :2 * h])
+            z = inner(zr[:, :h])
+            r = inner(zr[:, h:])
+            hh = act(xt[:, 2 * h:] + jnp.matmul(r * h_prev, U[:, 2 * h:]))
+            ht = z * h_prev + (1.0 - z) * hh
+            return ht, ht
+
+        init = jnp.zeros((x.shape[0], h), x.dtype)
+        carry, ys = self._scan(cell, init, xw)
+        return ys if self.return_sequences else carry
+
+
+class ConvLSTM2D(KerasLayer):
+    """Convolutional LSTM over (B, T, C, H, W) ('th', parity with
+    ConvLSTM2D.scala which is CHANNEL_FIRST). Same-padded convs preserve
+    spatial dims."""
+
+    def __init__(self, nb_filter, nb_kernel, activation="tanh",
+                 inner_activation="hard_sigmoid", dim_ordering="th",
+                 subsample=1, return_sequences=False, go_backwards=False,
+                 border_mode="same", input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        if border_mode != "same":
+            raise ValueError(
+                "ConvLSTM supports border_mode='same' only (the recurrence "
+                "requires shape-preserving convs, matching the reference)")
+        self.nb_filter = int(nb_filter)
+        self.nb_kernel = int(nb_kernel)
+        self.activation = get_activation_fn(activation)
+        self.inner_activation = get_activation_fn(inner_activation)
+        self.return_sequences = return_sequences
+        self.go_backwards = go_backwards
+        self.subsample = int(subsample)
+
+    def build(self, rng, input_shape):
+        cin = int(input_shape[2])
+        k = self.nb_kernel
+        r1, r2 = jax.random.split(rng)
+        return {"W": init_tensor(r1, (k, k, cin, 4 * self.nb_filter)),
+                "U": init_tensor(r2, (k, k, self.nb_filter,
+                                      4 * self.nb_filter)),
+                "b": jnp.zeros((4 * self.nb_filter,))}
+
+    def _conv(self, x, kernel, stride=1):
+        return jax.lax.conv_general_dilated(
+            x, kernel, (stride, stride), "SAME",
+            dimension_numbers=("NCHW", "HWIO", "NCHW"))
+
+    def call(self, params, x, training=False, **kw):
+        b, t = x.shape[0], x.shape[1]
+        nf = self.nb_filter
+        W = params["W"].astype(x.dtype)
+        U = params["U"].astype(x.dtype)
+        bias = params["b"].astype(x.dtype)
+        # hoist the input conv out of the scan: fold T into batch
+        xt = x.reshape((b * t,) + x.shape[2:])
+        xw = self._conv(xt, W, self.subsample) + bias[None, :, None, None]
+        xw = xw.reshape((b, t) + xw.shape[1:])
+        xs = jnp.swapaxes(xw, 0, 1)
+        if self.go_backwards:
+            xs = jnp.flip(xs, axis=0)
+        h, w = xw.shape[-2:]
+        act, inner = self.activation, self.inner_activation
+
+        def cell(carry, zt):
+            h_prev, c_prev = carry
+            z = zt + self._conv(h_prev, U)
+            i = inner(z[:, :nf])
+            f = inner(z[:, nf:2 * nf])
+            g = act(z[:, 2 * nf:3 * nf])
+            o = inner(z[:, 3 * nf:])
+            c = f * c_prev + i * g
+            ht = o * act(c)
+            return (ht, c), ht
+
+        init = (jnp.zeros((b, nf, h, w), x.dtype),
+                jnp.zeros((b, nf, h, w), x.dtype))
+        carry, ys = jax.lax.scan(cell, init, xs)
+        if self.return_sequences:
+            ys = jnp.swapaxes(ys, 0, 1)
+            return jnp.flip(ys, axis=1) if self.go_backwards else ys
+        return carry[0]
+
+    def compute_output_shape(self, s):
+        h = None if s[3] is None else (s[3] + self.subsample - 1) // \
+            self.subsample
+        w = None if s[4] is None else (s[4] + self.subsample - 1) // \
+            self.subsample
+        if self.return_sequences:
+            return (s[0], s[1], self.nb_filter, h, w)
+        return (s[0], self.nb_filter, h, w)
+
+
+class ConvLSTM3D(KerasLayer):
+    """ConvLSTM over volumetric sequences (B, T, C, D, H, W)
+    (ConvLSTM3D.scala)."""
+
+    def __init__(self, nb_filter, nb_kernel, activation="tanh",
+                 inner_activation="hard_sigmoid", subsample=1,
+                 return_sequences=False, go_backwards=False, border_mode="same",
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        if border_mode != "same":
+            raise ValueError("ConvLSTM supports border_mode='same' only")
+        self.nb_filter = int(nb_filter)
+        self.nb_kernel = int(nb_kernel)
+        self.activation = get_activation_fn(activation)
+        self.inner_activation = get_activation_fn(inner_activation)
+        self.return_sequences = return_sequences
+        self.go_backwards = go_backwards
+        self.subsample = int(subsample)
+
+    def build(self, rng, input_shape):
+        cin = int(input_shape[2])
+        k = self.nb_kernel
+        r1, r2 = jax.random.split(rng)
+        return {"W": init_tensor(r1, (k, k, k, cin, 4 * self.nb_filter)),
+                "U": init_tensor(r2, (k, k, k, self.nb_filter,
+                                      4 * self.nb_filter)),
+                "b": jnp.zeros((4 * self.nb_filter,))}
+
+    def _conv(self, x, kernel, stride=1):
+        return jax.lax.conv_general_dilated(
+            x, kernel, (stride,) * 3, "SAME",
+            dimension_numbers=("NCDHW", "DHWIO", "NCDHW"))
+
+    def call(self, params, x, training=False, **kw):
+        b, t = x.shape[0], x.shape[1]
+        nf = self.nb_filter
+        W = params["W"].astype(x.dtype)
+        U = params["U"].astype(x.dtype)
+        bias = params["b"].astype(x.dtype)
+        xt = x.reshape((b * t,) + x.shape[2:])
+        xw = self._conv(xt, W, self.subsample) + \
+            bias[None, :, None, None, None]
+        xw = xw.reshape((b, t) + xw.shape[1:])
+        xs = jnp.swapaxes(xw, 0, 1)
+        if self.go_backwards:
+            xs = jnp.flip(xs, axis=0)
+        act, inner = self.activation, self.inner_activation
+        spatial = xw.shape[3:]
+
+        def cell(carry, zt):
+            h_prev, c_prev = carry
+            z = zt + self._conv(h_prev, U)
+            i = inner(z[:, :nf])
+            f = inner(z[:, nf:2 * nf])
+            g = act(z[:, 2 * nf:3 * nf])
+            o = inner(z[:, 3 * nf:])
+            c = f * c_prev + i * g
+            ht = o * act(c)
+            return (ht, c), ht
+
+        init = (jnp.zeros((b, nf) + spatial, x.dtype),
+                jnp.zeros((b, nf) + spatial, x.dtype))
+        carry, ys = jax.lax.scan(cell, init, xs)
+        if self.return_sequences:
+            ys = jnp.swapaxes(ys, 0, 1)
+            return jnp.flip(ys, axis=1) if self.go_backwards else ys
+        return carry[0]
+
+    def compute_output_shape(self, s):
+        def down(d):
+            return None if d is None else (d + self.subsample - 1) // \
+                self.subsample
+
+        dims = (down(s[3]), down(s[4]), down(s[5]))
+        if self.return_sequences:
+            return (s[0], s[1], self.nb_filter) + dims
+        return (s[0], self.nb_filter) + dims

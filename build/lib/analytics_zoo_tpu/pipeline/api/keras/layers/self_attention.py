@@ -1,0 +1,419 @@
+"""TransformerLayer and BERT.
+
+Parity surface: ``keras/layers/TransformerLayer.scala`` (279 LoC; GPT-style
+decoder blocks, post-LN, gelu, optional bidirectional) and
+``keras/layers/BERT.scala`` (402 LoC; 4 inputs — token ids, positions,
+segment ids, attention mask; outputs per-block sequence states + pooled
+output; erf-based gelu; extended mask = (1-mask)*-10000).
+
+TPU redesign: one KerasLayer owning all block params (pytree), attention via
+the Pallas flash kernel (ops/attention.py), dropout fused in-jit, params
+annotated with logical axes so ``parallel.sharding`` can lay them out over a
+('data','model') mesh (qkv/mlp-in column-parallel, proj/mlp-out row-parallel
+— Megatron layout, collectives inserted by XLA).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .....ops.attention import flash_attention
+from ..engine.base import KerasLayer, init_tensor
+
+
+def _normal(rng, shape, std):
+    return std * jax.random.normal(rng, shape, jnp.float32)
+
+
+def _dropout(x, p, rng, training):
+    if not training or rng is None or p <= 0.0:
+        return x
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+class TransformerLayer(KerasLayer):
+    """GPT-style transformer stack.
+
+    Inputs: token ids ``(B, L)`` (positions are implicit arange, parity with
+    the reference's position-offset embedding). Outputs
+    ``[sequence_states, pooled]`` (or all block states + pooled when
+    ``output_all_block``).
+    """
+
+    stochastic = True
+    gelu_approximate = True  # TransformerLayer.scala uses the tanh approx
+
+    def __init__(self, n_block, hidden_p_drop=0.1, attn_p_drop=0.1,
+                 n_head=12, initializer_range=0.02, bidirectional=False,
+                 output_all_block=False, intermediate_size=0,
+                 vocab=40990, seq_len=77, hidden_size=768,
+                 embedding_layer=None, moe_experts=0, moe_top_k=2,
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.n_block = int(n_block)
+        self.n_head = int(n_head)
+        self.hidden_p_drop = hidden_p_drop
+        self.attn_p_drop = attn_p_drop
+        self.initializer_range = initializer_range
+        self.bidirectional = bidirectional
+        self.output_all_block = output_all_block
+        self.vocab = int(vocab)
+        self.seq_len = int(seq_len)
+        self.hidden_size = int(hidden_size)
+        self.embedding_layer = embedding_layer
+        # moe_experts > 0 swaps each block's MLP for a SparseMoE (expert
+        # parallelism reachable from the model zoo, VERDICT r2 #8)
+        self.moe_experts = int(moe_experts)
+        self.moe_top_k = int(moe_top_k)
+        self._moe = None
+        if embedding_layer is not None:
+            # custom embedding (reference API): hidden size comes from its
+            # output shape; it consumes the non-mask inputs
+            out_shape = embedding_layer.compute_output_shape(
+                (None, self.seq_len))
+            self.hidden_size = int(out_shape[-1])
+        self.intermediate_size = int(intermediate_size) or \
+            4 * self.hidden_size
+        assert self.hidden_size % self.n_head == 0
+        self.num_outputs = (self.n_block if output_all_block else 1) + 1
+
+    # -- params --------------------------------------------------------
+    def _embedding_params(self, rng):
+        if self.embedding_layer is not None:
+            return {"embedding": self.embedding_layer.build(
+                rng, (None, self.seq_len))}
+        r1, r2 = jax.random.split(rng)
+        params = {
+            "tok_emb": _normal(r1, (self.vocab, self.hidden_size),
+                               self.initializer_range),
+            "pos_emb": _normal(r2, (self.seq_len, self.hidden_size),
+                               self.initializer_range),
+        }
+        self._annotate(tok_emb=("vocab", "embed"),
+                       pos_emb=(None, "embed"))
+        return params
+
+    def _block_params(self, rng):
+        h = self.hidden_size
+        m = self.intermediate_size
+        keys = jax.random.split(rng, 5)
+        std = self.initializer_range
+        p = {
+            "qkv_w": _normal(keys[0], (h, 3 * h), std),
+            "qkv_b": jnp.zeros((3 * h,)),
+            "proj_w": _normal(keys[1], (h, h), std),
+            "proj_b": jnp.zeros((h,)),
+            "ln1_g": jnp.ones((h,)), "ln1_b": jnp.zeros((h,)),
+            "ln2_g": jnp.ones((h,)), "ln2_b": jnp.zeros((h,)),
+        }
+        if self.moe_experts:
+            p["moe"] = self._moe.build(keys[2], (None, self.seq_len, h))
+        else:
+            p.update({
+                "mlp_in_w": _normal(keys[2], (h, m), std),
+                "mlp_in_b": jnp.zeros((m,)),
+                "mlp_out_w": _normal(keys[3], (m, h), std),
+                "mlp_out_b": jnp.zeros((h,)),
+            })
+        return p
+
+    def _block_axis_map(self):
+        """Logical axes per block param (Megatron TP layout)."""
+        axes = {
+            "qkv_w": ("embed", "heads"), "qkv_b": ("heads",),
+            "proj_w": ("heads", "embed"), "proj_b": (None,),
+            "ln1_g": (None,), "ln1_b": (None,),
+            "ln2_g": (None,), "ln2_b": (None,),
+        }
+        if self.moe_experts:
+            for k, v in self._moe.param_axes().items():
+                axes[f"moe/{k}"] = v
+        else:
+            axes.update({"mlp_in_w": ("embed", "mlp"),
+                         "mlp_in_b": ("mlp",),
+                         "mlp_out_w": ("mlp", "embed"),
+                         "mlp_out_b": (None,)})
+        return axes
+
+    def _pp_stages(self) -> int:
+        """Pipeline stages from the ambient context (0/1 = no pipelining).
+        Peeks the global context without creating one."""
+        from .....common import nncontext as _nn
+        ctx = _nn._global_context
+        if ctx is None:
+            return 1
+        return int(ctx.mesh.shape.get("pipe", 1))
+
+    def build(self, rng, input_shape):
+        if self.moe_experts and self._moe is None:
+            from .moe import SparseMoE
+            self._moe = SparseMoE(self.moe_experts,
+                                  self.intermediate_size,
+                                  top_k=self.moe_top_k)
+        rngs = jax.random.split(rng, self.n_block + 2)
+        params = self._embedding_params(rngs[0])
+        pp = self._pp_stages()
+        if pp > 1:
+            # GPipe layout: block params stacked on a leading 'stage'-
+            # annotated axis so each pipe rank holds only its blocks
+            # (parallel/pipeline.py schedule, reachable from Model.fit)
+            if self.n_block % pp:
+                raise ValueError(
+                    f"pipeline_parallel={pp} must divide n_block="
+                    f"{self.n_block}")
+            if self.output_all_block:
+                raise ValueError(
+                    "output_all_block=True is incompatible with "
+                    "pipeline_parallel > 1 (intermediate block states "
+                    "live on other pipe ranks); build with "
+                    "output_all_block=False")
+            per_block = [self._block_params(rngs[i + 1])
+                         for i in range(self.n_block)]
+            params["blocks"] = jax.tree.map(
+                lambda *ls: jnp.stack(ls), *per_block)
+            self._annotate(**{
+                f"blocks/{k}": ("stage",) + tuple(v)
+                for k, v in self._block_axis_map().items()})
+        else:
+            for i in range(self.n_block):
+                params[f"block{i}"] = self._block_params(rngs[i + 1])
+                self._annotate(**{
+                    f"block{i}/{k}": v
+                    for k, v in self._block_axis_map().items()})
+        params["pooler_w"] = _normal(rngs[-1],
+                                     (self.hidden_size, self.hidden_size),
+                                     self.initializer_range)
+        params["pooler_b"] = jnp.zeros((self.hidden_size,))
+        return params
+
+    # -- compute -------------------------------------------------------
+    def _ln(self, x, g, b, eps=1e-5):
+        xf = x.astype(jnp.float32)
+        mu = xf.mean(-1, keepdims=True)
+        var = jnp.square(xf - mu).mean(-1, keepdims=True)
+        return ((xf - mu) * jax.lax.rsqrt(var + eps) * g + b).astype(x.dtype)
+
+    def _gelu(self, x):
+        return jax.nn.gelu(x, approximate=self.gelu_approximate)
+
+    def _seq_parallel(self) -> int:
+        from .....common import nncontext as _nn
+        ctx = _nn._global_context
+        if ctx is None:
+            return 1
+        return int(ctx.mesh.shape.get("seq", 1))
+
+    def _attention(self, p, x, mask_bias, rng, training):
+        b, l, h = x.shape
+        nh = self.n_head
+        d = h // nh
+        qkv = jnp.matmul(x, p["qkv_w"].astype(x.dtype)) + \
+            p["qkv_b"].astype(x.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(b, l, nh, d).transpose(0, 2, 1, 3)
+
+        sp = self._seq_parallel()
+        if sp > 1 and l % sp == 0:
+            # sequence parallelism: the O(L^2) attention runs as a ring
+            # over the 'seq' mesh axis — per-device score memory O(L/sp)
+            # (parallel/ring_attention.py; key-padding bias rides the ring)
+            from .....common.nncontext import get_nncontext
+            from .....parallel.ring_attention import ring_attention_sharded
+
+            kb = None
+            if mask_bias is not None:
+                kb = jnp.broadcast_to(
+                    mask_bias.reshape(mask_bias.shape[0], l),
+                    (b, l)).astype(jnp.float32)
+            o = ring_attention_sharded(
+                heads(q), heads(k), heads(v), get_nncontext().mesh,
+                causal=not self.bidirectional, kbias=kb)
+        else:
+            o = flash_attention(heads(q), heads(k), heads(v),
+                                bias=mask_bias,
+                                causal=not self.bidirectional)
+        o = o.transpose(0, 2, 1, 3).reshape(b, l, h)
+        if rng is not None:
+            rng, sub = jax.random.split(rng)
+            o = _dropout(o, self.attn_p_drop, sub, training)
+        o = jnp.matmul(o, p["proj_w"].astype(x.dtype)) + \
+            p["proj_b"].astype(x.dtype)
+        return o
+
+    def _block(self, p, x, mask_bias, rng, training):
+        r1 = r2 = r3 = None
+        if rng is not None:
+            r1, r2, r3 = jax.random.split(rng, 3)
+        a = self._attention(p, x, mask_bias, r1, training)
+        a = _dropout(a, self.hidden_p_drop, r2, training)
+        n = self._ln(x + a, p["ln1_g"], p["ln1_b"])
+        if self.moe_experts:
+            m = self._moe.call(p["moe"], n, training=training)
+        else:
+            m = jnp.matmul(n, p["mlp_in_w"].astype(x.dtype)) + \
+                p["mlp_in_b"].astype(x.dtype)
+            m = self._gelu(m)
+            m = jnp.matmul(m, p["mlp_out_w"].astype(x.dtype)) + \
+                p["mlp_out_b"].astype(x.dtype)
+        m = _dropout(m, self.hidden_p_drop, r3, training)
+        return self._ln(n + m, p["ln2_g"], p["ln2_b"])
+
+    def _embed(self, params, inputs, rng, training):
+        if self.embedding_layer is not None:
+            x = inputs if not isinstance(inputs, (list, tuple)) or \
+                len(inputs) > 1 else inputs[0]
+            e = self.embedding_layer.call(params["embedding"], x,
+                                          training=training)
+            return e, None
+        tokens = (inputs[0] if isinstance(inputs, (list, tuple))
+                  else inputs).astype(jnp.int32)
+        e = jnp.take(params["tok_emb"], tokens, axis=0)
+        e = e + params["pos_emb"][None, :e.shape[1]]
+        return e, None
+
+    def _pooler(self, params, x):
+        first = x[:, 0]
+        return jnp.tanh(jnp.matmul(first, params["pooler_w"]
+                                   .astype(x.dtype)) +
+                        params["pooler_b"].astype(x.dtype))
+
+    def _call_pp(self, params, e, mask_bias, rng, training):
+        """Run the block trunk as a GPipe pipeline over the 'pipe' mesh
+        axis (parallel/pipeline.py): the stacked block params are already
+        sharded one stage per rank; activations + mask + dropout seed
+        rotate along the ring as one pytree."""
+        from .....common.nncontext import get_nncontext
+        from .....parallel.pipeline import pipeline_forward
+
+        ctx = get_nncontext()
+        mesh = ctx.mesh
+        S = int(mesh.shape["pipe"])
+        bps = self.n_block // S
+        n_micro = int(getattr(ctx.config, "pipeline_microbatches", 0)) or S
+        b = e.shape[0]
+        tree = {"x": e}
+        if mask_bias is not None:
+            tree["mask"] = jnp.broadcast_to(
+                mask_bias, (b,) + tuple(mask_bias.shape[1:]))
+        if rng is not None:
+            seed = jax.random.randint(rng, (), 0, np.iinfo(np.int32).max)
+            tree["seed"] = jnp.broadcast_to(seed, (b,))
+
+        blocks = jax.tree.map(
+            lambda l: l.reshape((S, bps) + l.shape[1:]), params["blocks"])
+
+        def stage(p_local, t):
+            x = t["x"]
+            mask = t.get("mask")
+            key = None
+            if "seed" in t:
+                key = jax.random.fold_in(
+                    jax.random.PRNGKey(0), t["seed"][0])
+                key = jax.random.fold_in(
+                    key, jax.lax.axis_index("pipe"))
+
+            def body(x, p_i):
+                bp, i = p_i
+                brng = (jax.random.fold_in(key, i)
+                        if key is not None else None)
+                return self._block(bp, x, mask, brng, training), None
+
+            x, _ = jax.lax.scan(body, x, (p_local, jnp.arange(bps)))
+            return dict(t, x=x)
+
+        out = pipeline_forward(stage, blocks, tree, mesh,
+                               n_microbatch=n_micro)
+        return out["x"]
+
+    def call(self, params, inputs, training=False, rng=None, **kw):
+        e, mask_bias = self._embed(params, inputs, rng, training)
+        if rng is not None:
+            rng, sub = jax.random.split(rng)
+            e = _dropout(e, self.hidden_p_drop, sub, training)
+        if "blocks" in params:         # GPipe layout (pipeline_parallel>1)
+            x = self._call_pp(params, e, mask_bias, rng, training)
+            return (x, self._pooler(params, x))
+        states = []
+        x = e
+        for i in range(self.n_block):
+            block_rng = None
+            if rng is not None:
+                rng, block_rng = jax.random.split(rng)
+            x = self._block(params[f"block{i}"], x, mask_bias, block_rng,
+                            training)
+            states.append(x)
+        pooled = self._pooler(params, x)
+        if self.output_all_block:
+            return tuple(states) + (pooled,)
+        return (x, pooled)
+
+    def compute_output_shape(self, input_shape):
+        first = input_shape[0] if isinstance(input_shape, list) \
+            else input_shape
+        seq_shape = (first[0], first[1], self.hidden_size)
+        pooled = (first[0], self.hidden_size)
+        if self.output_all_block:
+            return [seq_shape] * self.n_block + [pooled]
+        return [seq_shape, pooled]
+
+
+class BERT(TransformerLayer):
+    """BERT encoder (BERT.scala). Inputs: ``[token_ids (B,L),
+    position_ids (B,L), segment_ids (B,L), attention_mask (B,1,1,L)]``."""
+
+    gelu_approximate = False  # BERT.scala overrides gelu with the erf form
+
+    def __init__(self, vocab=40990, hidden_size=768, n_block=12, n_head=12,
+                 seq_len=512, intermediate_size=3072, hidden_p_drop=0.1,
+                 attn_p_drop=0.1, initializer_range=0.02,
+                 output_all_block=True, moe_experts=0, moe_top_k=2,
+                 input_shape=None, name=None, **kwargs):
+        super().__init__(
+            n_block=n_block, hidden_p_drop=hidden_p_drop,
+            attn_p_drop=attn_p_drop, n_head=n_head,
+            initializer_range=initializer_range, bidirectional=True,
+            output_all_block=output_all_block,
+            intermediate_size=intermediate_size, vocab=vocab,
+            seq_len=seq_len, hidden_size=hidden_size,
+            moe_experts=moe_experts, moe_top_k=moe_top_k,
+            input_shape=input_shape, name=name)
+
+    def _embedding_params(self, rng):
+        params = super()._embedding_params(rng)
+        r = jax.random.fold_in(rng, 7)
+        params["seg_emb"] = _normal(r, (2, self.hidden_size),
+                                    self.initializer_range)
+        params["emb_ln_g"] = jnp.ones((self.hidden_size,))
+        params["emb_ln_b"] = jnp.zeros((self.hidden_size,))
+        return params
+
+    def _embed(self, params, inputs, rng, training):
+        tokens, positions, segments, mask = inputs
+        tokens = tokens.astype(jnp.int32)
+        positions = positions.astype(jnp.int32)
+        segments = segments.astype(jnp.int32)
+        e = jnp.take(params["tok_emb"], tokens, axis=0)
+        e = e + jnp.take(params["pos_emb"], positions, axis=0)
+        e = e + jnp.take(params["seg_emb"], segments, axis=0)
+        e = self._ln(e, params["emb_ln_g"], params["emb_ln_b"], eps=1e-12)
+        # extended mask, parity with BERT.scala buildInput:
+        # (-mask + 1) * -10000
+        mask_bias = (1.0 - mask.astype(jnp.float32)) * -10000.0
+        return e, mask_bias
+
+    def compute_output_shape(self, input_shape):
+        first = input_shape[0]
+        seq_shape = (first[0], first[1], self.hidden_size)
+        pooled = (first[0], self.hidden_size)
+        if self.output_all_block:
+            return [seq_shape] * self.n_block + [pooled]
+        return [seq_shape, pooled]

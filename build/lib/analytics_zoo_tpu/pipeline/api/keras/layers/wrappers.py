@@ -1,0 +1,132 @@
+"""Wrapper layers: TimeDistributed, Bidirectional, KerasLayerWrapper.
+
+Parity: TimeDistributed.scala, Bidirectional.scala,
+KerasLayerWrapper.scala:111 (which wraps any BigDL module — here it wraps any
+function or KerasLayer).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..engine.base import KerasLayer
+
+
+class TimeDistributed(KerasLayer):
+    """Apply an inner layer to every temporal slice. TPU design: fold time
+    into batch (one big op) instead of scanning — same math, full MXU
+    utilization."""
+
+    def __init__(self, layer: KerasLayer, input_shape=None, name=None,
+                 **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.layer = layer
+
+    @property
+    def has_state(self):  # delegate statefulness
+        return self.layer.has_state
+
+    @property
+    def stochastic(self):
+        return self.layer.stochastic
+
+    def build(self, rng, input_shape):
+        inner_shape = (input_shape[0],) + tuple(input_shape[2:])
+        p = self.layer.build(rng, inner_shape)
+        return {"layer": p} if p else {}
+
+    def init_state(self, input_shape):
+        inner_shape = (input_shape[0],) + tuple(input_shape[2:])
+        s = self.layer.init_state(inner_shape)
+        return {"layer": s} if s else {}
+
+    def call(self, params, x, training=False, state=None, rng=None, **kw):
+        b, t = x.shape[0], x.shape[1]
+        flat = x.reshape((b * t,) + x.shape[2:])
+        # "layer" role key; pre-v1 checkpoints keyed by the wrapped
+        # layer's auto-generated name — fall back for those
+        p = (params.get("layer", params.get(self.layer.name, {}))
+             if params else {})
+        kwargs = {}
+        if self.layer.has_state:
+            kwargs["state"] = (state or {}).get("layer", {})
+        if self.layer.stochastic:
+            kwargs["rng"] = rng
+        out = self.layer.call(p, flat, training=training, **kwargs)
+        if self.layer.has_state:
+            out, s = out
+            return out.reshape((b, t) + out.shape[1:]), \
+                {"layer": s}
+        return out.reshape((b, t) + out.shape[1:])
+
+    def compute_output_shape(self, s):
+        inner = self.layer.compute_output_shape((s[0],) + tuple(s[2:]))
+        return (s[0], s[1]) + tuple(inner[1:])
+
+
+class Bidirectional(KerasLayer):
+    """Run a recurrent layer forward and backward, merging outputs
+    (Bidirectional.scala; merge modes concat/sum/mul/ave)."""
+
+    def __init__(self, layer, merge_mode="concat", input_shape=None,
+                 name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.forward = layer
+        self.backward = copy.deepcopy(layer)
+        self.backward.name = layer.name + "_bwd"
+        self.backward.go_backwards = not getattr(layer, "go_backwards", False)
+        self.merge_mode = merge_mode
+
+    def build(self, rng, input_shape):
+        # stable role keys, NOT the wrapped layer's auto-generated name:
+        # a definition-rebuilt wrapper (model_io) regenerates inner names,
+        # so name-keyed params would KeyError after load_model
+        r1, r2 = jax.random.split(rng)
+        return {"forward": self.forward.build(r1, input_shape),
+                "backward": self.backward.build(r2, input_shape)}
+
+    def call(self, params, x, training=False, **kw):
+        # role keys; pre-v1 checkpoints keyed by inner layer names
+        p_fwd = params.get("forward", params.get(self.forward.name))
+        p_bwd = params.get("backward", params.get(self.backward.name))
+        fwd = self.forward.call(p_fwd, x, training=training)
+        bwd = self.backward.call(p_bwd, x, training=training)
+        if self.merge_mode == "concat":
+            return jnp.concatenate([fwd, bwd], axis=-1)
+        if self.merge_mode == "sum":
+            return fwd + bwd
+        if self.merge_mode == "mul":
+            return fwd * bwd
+        if self.merge_mode == "ave":
+            return (fwd + bwd) / 2.0
+        raise ValueError(f"unknown merge_mode {self.merge_mode}")
+
+    def compute_output_shape(self, s):
+        inner = self.forward.compute_output_shape(s)
+        if self.merge_mode == "concat":
+            return tuple(inner[:-1]) + (inner[-1] * 2,)
+        return inner
+
+
+class KerasLayerWrapper(KerasLayer):
+    """Wrap an arbitrary function (or stateless layer) as a KerasLayer."""
+
+    def __init__(self, torch_layer=None, input_shape=None, name=None,
+                 function=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.function = function or torch_layer
+        if not callable(self.function):
+            raise ValueError("KerasLayerWrapper needs a callable")
+
+    def call(self, params, x, training=False, **kw):
+        return self.function(x)
+
+    def compute_output_shape(self, input_shape):
+        probe = jnp.zeros(tuple(2 if d is None else d
+                                for d in input_shape), jnp.float32)
+        out = jax.eval_shape(self.function, probe)
+        return (None,) + tuple(out.shape[1:])

@@ -1,0 +1,212 @@
+"""Validation metrics.
+
+Parity surface: ``zoo/.../pipeline/api/keras/metrics/`` (Accuracy, Top5Accuracy,
+AUC, MAE, MSE) + KerasUtils.toBigDLMetrics:229. Metrics are streaming: the
+jitted eval step emits per-batch ``(numerator, denominator)`` partial sums
+(device-side, psum-friendly) and the host accumulates across batches — no
+per-sample host round trips.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class Metric:
+    name = "metric"
+
+    def batch_stats(self, y_pred, y_true, sample_weight=None):
+        """Return (numerator, denominator) partial sums for one batch."""
+        raise NotImplementedError
+
+    def finalize(self, num, den):
+        return num / max(den, 1e-12)
+
+    def __repr__(self):
+        return self.name
+
+
+def _weights(y_pred, sample_weight):
+    if sample_weight is None:
+        return jnp.ones((y_pred.shape[0],), jnp.float32)
+    return sample_weight.astype(jnp.float32)
+
+
+def _labels_of(y_true, y_pred, zero_based_label=True):
+    if y_true.ndim == y_pred.ndim and y_true.shape[-1] == y_pred.shape[-1] \
+            and y_pred.shape[-1] > 1:
+        return jnp.argmax(y_true, axis=-1)  # one-hot targets
+    labels = y_true.astype(jnp.int32)
+    if labels.ndim == y_pred.ndim:
+        labels = labels.reshape(labels.shape[:-1])
+    if not zero_based_label:
+        labels = labels - 1
+    return labels
+
+
+class Accuracy(Metric):
+    """Top-1 accuracy; handles binary (sigmoid scalar output) and
+    categorical predictions like the reference's Accuracy metric."""
+
+    name = "accuracy"
+
+    def __init__(self, zero_based_label=True):
+        self.zero_based_label = zero_based_label
+
+    def batch_stats(self, y_pred, y_true, sample_weight=None):
+        w = _weights(y_pred, sample_weight)
+        if y_pred.ndim == 1 or y_pred.shape[-1] == 1:
+            pred = (y_pred.reshape(y_pred.shape[0]) > 0.5).astype(jnp.int32)
+            labels = y_true.reshape(y_true.shape[0]).astype(jnp.int32)
+        else:
+            pred = jnp.argmax(y_pred, axis=-1)
+            labels = _labels_of(y_true, y_pred, self.zero_based_label)
+            if pred.ndim > 1:  # sequence outputs: per-token accuracy
+                w = jnp.broadcast_to(w.reshape((-1,) + (1,) * (pred.ndim - 1)),
+                                     pred.shape)
+        correct = (pred == labels).astype(jnp.float32)
+        return jnp.sum(correct * w), jnp.sum(w * jnp.ones_like(correct))
+
+
+class SparseCategoricalAccuracy(Accuracy):
+    name = "sparse_categorical_accuracy"
+
+
+class BinaryAccuracy(Metric):
+    name = "binary_accuracy"
+
+    def batch_stats(self, y_pred, y_true, sample_weight=None):
+        w = _weights(y_pred, sample_weight)
+        pred = (y_pred.reshape(y_pred.shape[0], -1) > 0.5).astype(jnp.float32)
+        labels = y_true.reshape(y_true.shape[0], -1).astype(jnp.float32)
+        correct = (pred == labels).all(axis=-1).astype(jnp.float32)
+        return jnp.sum(correct * w), jnp.sum(w)
+
+
+class CategoricalAccuracy(Metric):
+    name = "categorical_accuracy"
+
+    def batch_stats(self, y_pred, y_true, sample_weight=None):
+        w = _weights(y_pred, sample_weight)
+        pred = jnp.argmax(y_pred, axis=-1)
+        labels = jnp.argmax(y_true, axis=-1)
+        correct = (pred == labels).astype(jnp.float32)
+        while correct.ndim > 1:
+            correct = correct.mean(axis=-1)
+        return jnp.sum(correct * w), jnp.sum(w)
+
+
+class Top5Accuracy(Metric):
+    name = "top5accuracy"
+
+    def __init__(self, zero_based_label=True):
+        self.zero_based_label = zero_based_label
+
+    def batch_stats(self, y_pred, y_true, sample_weight=None):
+        w = _weights(y_pred, sample_weight)
+        labels = _labels_of(y_true, y_pred, self.zero_based_label)
+        k = min(5, y_pred.shape[-1])
+        _, topk = jax.lax.top_k(y_pred, k)
+        correct = (topk == labels[..., None]).any(axis=-1).astype(jnp.float32)
+        while correct.ndim > 1:
+            correct = correct.mean(axis=-1)
+        return jnp.sum(correct * w), jnp.sum(w)
+
+
+class MAE(Metric):
+    name = "mae"
+
+    def batch_stats(self, y_pred, y_true, sample_weight=None):
+        w = _weights(y_pred, sample_weight)
+        err = jnp.abs(y_pred - y_true).reshape(y_pred.shape[0], -1).mean(-1)
+        return jnp.sum(err * w), jnp.sum(w)
+
+
+class MSE(Metric):
+    name = "mse"
+
+    def batch_stats(self, y_pred, y_true, sample_weight=None):
+        w = _weights(y_pred, sample_weight)
+        err = jnp.square(y_pred - y_true).reshape(y_pred.shape[0], -1).mean(-1)
+        return jnp.sum(err * w), jnp.sum(w)
+
+
+class AUC(Metric):
+    """Streaming AUC via fixed thresholds (reference: metrics wrapping BigDL
+    AUC with thresholdNum). num/den here are TPR/FPR histogram counts."""
+
+    name = "auc"
+
+    def __init__(self, threshold_num: int = 200):
+        self.threshold_num = threshold_num
+
+    def batch_stats(self, y_pred, y_true, sample_weight=None):
+        w = _weights(y_pred, sample_weight)
+        scores = y_pred.reshape(y_pred.shape[0], -1)[:, -1]
+        labels = y_true.reshape(y_true.shape[0], -1)[:, -1]
+        if y_pred.ndim > 1 and y_pred.shape[-1] == 2:
+            scores = y_pred[:, 1]
+        thresholds = jnp.linspace(0.0, 1.0, self.threshold_num)
+        pred_pos = scores[None, :] >= thresholds[:, None]  # (T, B)
+        pos = (labels > 0.5).astype(jnp.float32) * w
+        neg = (labels <= 0.5).astype(jnp.float32) * w
+        tp = jnp.sum(pred_pos * pos[None, :], axis=1)
+        fp = jnp.sum(pred_pos * neg[None, :], axis=1)
+        return jnp.stack([tp, fp]), jnp.stack(
+            [jnp.sum(pos) * jnp.ones(()), jnp.sum(neg) * jnp.ones(())])
+
+    def finalize(self, num, den):
+        tp, fp = num[0], num[1]
+        p, n = float(den[0]), float(den[1])
+        tpr = tp / max(p, 1e-12)
+        fpr = fp / max(n, 1e-12)
+        # thresholds descend fpr; integrate via trapezoid on sorted fpr
+        import numpy as np
+        fpr = np.asarray(fpr)[::-1]
+        tpr = np.asarray(tpr)[::-1]
+        trapezoid = getattr(np, "trapezoid", None) or np.trapz
+        return float(trapezoid(tpr, fpr))
+
+
+class Loss(Metric):
+    """Reports the loss function as a validation metric (reference: BigDL
+    ``Loss`` validation method)."""
+
+    name = "loss"
+
+    def __init__(self, loss_fn=None):
+        from .objectives import get_loss
+        self.loss_fn = get_loss(loss_fn) if loss_fn is not None else None
+
+    def batch_stats(self, y_pred, y_true, sample_weight=None):
+        w = _weights(y_pred, sample_weight)
+        losses = self.loss_fn.per_sample(y_pred, y_true)
+        return jnp.sum(losses * w), jnp.sum(w)
+
+
+_METRICS = {
+    "accuracy": Accuracy,
+    "acc": Accuracy,
+    "sparse_categorical_accuracy": SparseCategoricalAccuracy,
+    "categorical_accuracy": CategoricalAccuracy,
+    "binary_accuracy": BinaryAccuracy,
+    "top5accuracy": Top5Accuracy,
+    "top5acc": Top5Accuracy,
+    "mae": MAE,
+    "mse": MSE,
+    "auc": AUC,
+    "loss": Loss,
+}
+
+
+def get_metric(identifier, loss_fn=None):
+    if isinstance(identifier, Metric):
+        return identifier
+    name = identifier.lower()
+    if name == "loss":
+        return Loss(loss_fn)
+    try:
+        return _METRICS[name]()
+    except KeyError:
+        raise ValueError(f"Unknown metric: {identifier}")

@@ -1,0 +1,321 @@
+"""Loss objectives.
+
+Parity surface: ``zoo/.../pipeline/api/keras/objectives/`` (15 objectives) and
+the string mapping in ``KerasUtils.toBigDLCriterion``
+(keras/layers/utils/KerasUtils.scala:180). Each objective computes a
+per-sample loss vector so the training engine can apply sample weights /
+padding masks, then reduces by weighted mean. All math is jnp → fuses into the
+jitted train step.
+
+Note on labels: BigDL criterions default to 1-based class labels; this rebuild
+defaults to 0-based (``zero_based_label=True``) which is the convention of the
+surrounding JAX ecosystem. Pass ``zero_based_label=False`` for parity.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-7
+
+
+class LossFunction:
+    """Base: subclasses implement per_sample(y_pred, y_true) -> (batch,)."""
+
+    def per_sample(self, y_pred, y_true):
+        raise NotImplementedError
+
+    def __call__(self, y_pred, y_true, sample_weight=None):
+        losses = self.per_sample(y_pred, y_true)
+        if sample_weight is not None:
+            return jnp.sum(losses * sample_weight) / \
+                jnp.maximum(jnp.sum(sample_weight), _EPS)
+        return jnp.mean(losses)
+
+    def __repr__(self):
+        return type(self).__name__
+
+
+def _flat_mean(x):
+    """Mean over all non-batch dims -> (batch,)."""
+    return x.reshape(x.shape[0], -1).mean(axis=-1)
+
+
+def _flat_sum(x):
+    return x.reshape(x.shape[0], -1).sum(axis=-1)
+
+
+class MeanSquaredError(LossFunction):
+    def per_sample(self, y_pred, y_true):
+        return _flat_mean(jnp.square(y_pred - y_true))
+
+
+class MeanAbsoluteError(LossFunction):
+    def per_sample(self, y_pred, y_true):
+        return _flat_mean(jnp.abs(y_pred - y_true))
+
+
+class MeanAbsolutePercentageError(LossFunction):
+    def per_sample(self, y_pred, y_true):
+        diff = jnp.abs(y_true - y_pred) / jnp.maximum(jnp.abs(y_true), _EPS)
+        return 100.0 * _flat_mean(diff)
+
+
+class MeanSquaredLogarithmicError(LossFunction):
+    def per_sample(self, y_pred, y_true):
+        a = jnp.log(jnp.maximum(y_pred, _EPS) + 1.0)
+        b = jnp.log(jnp.maximum(y_true, _EPS) + 1.0)
+        return _flat_mean(jnp.square(a - b))
+
+
+class BinaryCrossEntropy(LossFunction):
+    """Expects probabilities in (0,1) (post-sigmoid), like the reference's
+    BCECriterion wrapper (objectives/BinaryCrossEntropy.scala)."""
+
+    def per_sample(self, y_pred, y_true):
+        p = jnp.clip(y_pred, _EPS, 1.0 - _EPS)
+        return _flat_mean(-(y_true * jnp.log(p) +
+                            (1.0 - y_true) * jnp.log(1.0 - p)))
+
+
+class CategoricalCrossEntropy(LossFunction):
+    """One-hot targets, probability predictions
+    (objectives/CategoricalCrossEntropy.scala)."""
+
+    def per_sample(self, y_pred, y_true):
+        p = jnp.clip(y_pred, _EPS, 1.0)
+        return -_flat_sum(y_true * jnp.log(p))
+
+
+class SparseCategoricalCrossEntropy(LossFunction):
+    """Integer targets, probability predictions (post-softmax), mirroring
+    objectives/SparseCategoricalCrossEntropy.scala (log_prob_as_input,
+    zero_based_label options)."""
+
+    def __init__(self, log_prob_as_input=False, zero_based_label=True):
+        self.log_prob_as_input = log_prob_as_input
+        self.zero_based_label = zero_based_label
+
+    def per_sample(self, y_pred, y_true):
+        labels = y_true.astype(jnp.int32)
+        if labels.ndim == y_pred.ndim:  # allow shape (B,1)
+            labels = labels.reshape(labels.shape[:-1])
+        if not self.zero_based_label:
+            labels = labels - 1
+        if self.log_prob_as_input:
+            logp = y_pred
+        else:
+            logp = jnp.log(jnp.clip(y_pred, _EPS, 1.0))
+        picked = jnp.take_along_axis(logp, labels[..., None],
+                                     axis=-1).squeeze(-1)
+        if picked.ndim > 1:
+            picked = picked.reshape(picked.shape[0], -1).mean(axis=-1)
+        return -picked
+
+
+class ClassNLLCriterion(LossFunction):
+    """Log-prob inputs + integer labels (objectives/ClassNLLCriterion.scala)."""
+
+    def __init__(self, logProbAsInput=True, zeroBasedLabel=True):
+        self.inner = SparseCategoricalCrossEntropy(
+            log_prob_as_input=logProbAsInput, zero_based_label=zeroBasedLabel)
+
+    def per_sample(self, y_pred, y_true):
+        return self.inner.per_sample(y_pred, y_true)
+
+
+class Hinge(LossFunction):
+    """Targets in {-1, 1} (objectives/Hinge.scala)."""
+
+    def __init__(self, margin: float = 1.0):
+        self.margin = margin
+
+    def per_sample(self, y_pred, y_true):
+        return _flat_mean(jnp.maximum(0.0, self.margin - y_true * y_pred))
+
+
+class SquaredHinge(LossFunction):
+    def __init__(self, margin: float = 1.0):
+        self.margin = margin
+
+    def per_sample(self, y_pred, y_true):
+        return _flat_mean(
+            jnp.square(jnp.maximum(0.0, self.margin - y_true * y_pred)))
+
+
+class Poisson(LossFunction):
+    def per_sample(self, y_pred, y_true):
+        return _flat_mean(y_pred - y_true * jnp.log(y_pred + _EPS))
+
+
+class CosineProximity(LossFunction):
+    def per_sample(self, y_pred, y_true):
+        t = y_true.reshape(y_true.shape[0], -1)
+        p = y_pred.reshape(y_pred.shape[0], -1)
+        t = t / jnp.maximum(jnp.linalg.norm(t, axis=-1, keepdims=True), _EPS)
+        p = p / jnp.maximum(jnp.linalg.norm(p, axis=-1, keepdims=True), _EPS)
+        return -jnp.sum(t * p, axis=-1)
+
+
+class KullbackLeiblerDivergence(LossFunction):
+    def per_sample(self, y_pred, y_true):
+        t = jnp.clip(y_true, _EPS, 1.0)
+        p = jnp.clip(y_pred, _EPS, 1.0)
+        return _flat_sum(t * jnp.log(t / p))
+
+
+class RankHinge(LossFunction):
+    """Pairwise ranking hinge for QA/ranking (objectives/RankHinge.scala):
+    consecutive (positive, negative) pairs within the batch."""
+
+    def __init__(self, margin: float = 1.0):
+        self.margin = margin
+
+    def per_sample(self, y_pred, y_true):
+        pos = y_pred[0::2]
+        neg = y_pred[1::2]
+        loss = jnp.maximum(0.0, self.margin - pos + neg)
+        return jnp.repeat(loss, 2, axis=0).reshape(y_pred.shape[0], -1)[:, 0]
+
+
+class SoftmaxCrossEntropyWithLogits(LossFunction):
+    """Logits + integer labels; the numerically-stable path a TPU program
+    should use (replaces softmax+NLL pairs in one fused op)."""
+
+    def __init__(self, zero_based_label=True):
+        self.zero_based_label = zero_based_label
+
+    def per_sample(self, y_pred, y_true):
+        labels = y_true.astype(jnp.int32)
+        if labels.ndim == y_pred.ndim:
+            labels = labels.reshape(labels.shape[:-1])
+        if not self.zero_based_label:
+            labels = labels - 1
+        logp = jax.nn.log_softmax(y_pred, axis=-1)
+        picked = jnp.take_along_axis(logp, labels[..., None],
+                                     axis=-1).squeeze(-1)
+        if picked.ndim > 1:
+            picked = picked.reshape(picked.shape[0], -1).mean(axis=-1)
+        return -picked
+
+
+class SigmoidCrossEntropyWithLogits(LossFunction):
+    def per_sample(self, y_pred, y_true):
+        z = y_pred
+        return _flat_mean(jnp.maximum(z, 0) - z * y_true +
+                          jnp.log1p(jnp.exp(-jnp.abs(z))))
+
+
+# String registry — mirrors KerasUtils.toBigDLCriterion:180.
+class Identity(LossFunction):
+    """The prediction IS the loss — used by TFPark's TFOptimizer, where an
+    imported graph computes its own scalar objective (tf_optimizer.py:422
+    from_loss parity)."""
+
+    def per_sample(self, y_pred, y_true):
+        if y_pred.ndim == 0:  # graph already reduced over the batch
+            batch = y_true.shape[0] if y_true is not None and \
+                getattr(y_true, "ndim", 0) > 0 else 1
+            return jnp.broadcast_to(y_pred, (batch,))
+        return _flat_mean(y_pred)
+
+
+class CRFLoss(LossFunction):
+    """Negative CRF log-likelihood over a ``CRF`` layer's output pair.
+
+    Expects ``y_pred = [unary (B,L,E), transitions (B,E,E)]`` (optionally a
+    third ``mask (B,L)`` output for 'pad'-style explicit lengths) and
+    ``y_true`` integer tags ``(B, L)``. Parity: the CRF objective inside
+    nlp_architect NERCRF, the head of the reference's NER
+    (pyzoo/zoo/tfpark/text/keras/ner.py:49)."""
+
+    def per_sample(self, y_pred, y_true):
+        from ....ops.crf import crf_log_likelihood
+
+        if not isinstance(y_pred, (list, tuple)) or len(y_pred) < 2:
+            raise ValueError("CRFLoss needs [unary, transitions] outputs "
+                             "(add a CRF layer as the model head)")
+        unary, trans = y_pred[0], y_pred[1]
+        mask = y_pred[2] if len(y_pred) > 2 else None
+        tags = (y_true[0] if isinstance(y_true, (list, tuple)) else y_true)
+        tags = tags.astype(jnp.int32)
+        if tags.ndim == unary.ndim:        # one-hot targets
+            tags = tags.argmax(-1)
+        return -crf_log_likelihood(unary, tags, trans[0], mask)
+
+
+_LOSSES = {
+    "identity": Identity,
+    "crf": CRFLoss,
+    "crf_nll": CRFLoss,
+    "binary_crossentropy": BinaryCrossEntropy,
+    "categorical_crossentropy": CategoricalCrossEntropy,
+    "mse": MeanSquaredError,
+    "mean_squared_error": MeanSquaredError,
+    "mae": MeanAbsoluteError,
+    "mean_absolute_error": MeanAbsoluteError,
+    "hinge": Hinge,
+    "mape": MeanAbsolutePercentageError,
+    "mean_absolute_percentage_error": MeanAbsolutePercentageError,
+    "msle": MeanSquaredLogarithmicError,
+    "mean_squared_logarithmic_error": MeanSquaredLogarithmicError,
+    "squared_hinge": SquaredHinge,
+    "sparse_categorical_crossentropy": SparseCategoricalCrossEntropy,
+    "kld": KullbackLeiblerDivergence,
+    "kullback_leibler_divergence": KullbackLeiblerDivergence,
+    "poisson": Poisson,
+    "cosine_proximity": CosineProximity,
+    "rank_hinge": RankHinge,
+    "softmax_crossentropy_with_logits": SoftmaxCrossEntropyWithLogits,
+    "sigmoid_crossentropy_with_logits": SigmoidCrossEntropyWithLogits,
+}
+
+
+class MultiLoss(LossFunction):
+    """Weighted sum of per-output losses for multi-output models (the
+    reference reaches this via multiple criteria on a Table output)."""
+
+    def __init__(self, losses, weights=None):
+        self.losses = [get_loss(l) for l in losses]
+        self.weights = list(weights) if weights is not None else \
+            [1.0] * len(self.losses)
+        if len(self.weights) != len(self.losses):
+            raise ValueError("loss_weights length mismatch")
+
+    def per_sample(self, y_pred, y_true):
+        if not isinstance(y_pred, (list, tuple)) or \
+                not isinstance(y_true, (list, tuple)) or \
+                len(y_pred) != len(self.losses) or \
+                len(y_true) != len(self.losses):
+            raise ValueError(
+                f"MultiLoss over {len(self.losses)} outputs needs matching "
+                "prediction/target tuples")
+        total = None
+        for loss, w, yp, yt in zip(self.losses, self.weights, y_pred,
+                                   y_true):
+            term = w * loss.per_sample(yp, yt)
+            total = term if total is None else total + term
+        return total
+
+
+def get_loss(identifier):
+    if identifier is None or isinstance(identifier, LossFunction):
+        return identifier
+    if isinstance(identifier, (list, tuple)):
+        return MultiLoss(identifier)
+    if callable(identifier):
+        fn = identifier
+
+        class _Wrapped(LossFunction):
+            def per_sample(self, y_pred, y_true):
+                out = fn(y_pred, y_true)
+                if out.ndim == 0:
+                    out = jnp.broadcast_to(out, (y_pred.shape[0],))
+                return out
+
+        return _Wrapped()
+    try:
+        return _LOSSES[identifier.lower()]()
+    except KeyError:
+        raise ValueError(f"Unknown loss: {identifier}")

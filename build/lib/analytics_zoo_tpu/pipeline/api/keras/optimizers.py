@@ -1,0 +1,248 @@
+"""Optimizers.
+
+Parity surface: the zoo's own optimizer variants
+(``zoo/.../keras/optimizers/`` — ``Adam`` with learning-rate schedules,
+``AdamWeightDecay`` with warmup + linear decay, used by BERT) plus the BigDL
+methods reachable through ``KerasUtils.toBigDLOptimMethod:206`` (SGD, Adagrad,
+Adadelta, AdaMax, RMSprop, Ftrl). Implementation is optax-based: each class
+carries Keras-style constructor args and lowers to an
+``optax.GradientTransformation`` so the update fuses into the jitted train
+step (no host-side optimizer loop, unlike the reference's driver-side
+parameter manager).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import optax
+
+
+class Schedule:
+    """Learning-rate schedule; lowers to an optax schedule fn."""
+
+    def to_optax(self, base_lr: float) -> Callable:
+        raise NotImplementedError
+
+
+class Default(Schedule):
+    def to_optax(self, base_lr):
+        return lambda step: base_lr
+
+
+class Plateau(Schedule):
+    """Placeholder for BigDL's Plateau — TPU rebuild uses cosine/poly
+    schedules; host-driven plateau detection can reset lr via set_lr."""
+
+    def to_optax(self, base_lr):
+        return lambda step: base_lr
+
+
+class PolyEpochDecay(Schedule):
+    def __init__(self, power: float, max_epochs: int, iters_per_epoch: int = 1):
+        self.power = power
+        self.max_iters = max_epochs * iters_per_epoch
+
+    def to_optax(self, base_lr):
+        return optax.polynomial_schedule(
+            init_value=base_lr, end_value=0.0, power=self.power,
+            transition_steps=self.max_iters)
+
+
+class Warmup(Schedule):
+    def __init__(self, delta: float):
+        self.delta = delta
+
+    def to_optax(self, base_lr):
+        return lambda step: base_lr + step * self.delta
+
+
+class ZooOptimizer:
+    """Base optimizer: Keras-style args -> optax transformation chain."""
+
+    def __init__(self, lr: float = 1e-3, schedule: Optional[Schedule] = None,
+                 decay: float = 0.0, clipnorm: Optional[float] = None,
+                 clipvalue: Optional[float] = None):
+        self.lr = lr
+        self.schedule = schedule
+        self.decay = decay
+        self.clipnorm = clipnorm
+        self.clipvalue = clipvalue
+
+    # -- subclass hook ---------------------------------------------------
+    def _core(self, lr_schedule) -> optax.GradientTransformation:
+        raise NotImplementedError
+
+    def lr_schedule(self) -> Callable:
+        if self.schedule is not None:
+            return self.schedule.to_optax(self.lr)
+        if self.decay > 0:
+            return lambda step: self.lr / (1.0 + self.decay * step)
+        return lambda step: self.lr
+
+    def to_optax(self) -> optax.GradientTransformation:
+        chain = []
+        if self.clipvalue is not None:
+            chain.append(optax.clip(self.clipvalue))
+        if self.clipnorm is not None:
+            chain.append(optax.clip_by_global_norm(self.clipnorm))
+        chain.append(self._core(self.lr_schedule()))
+        return optax.chain(*chain) if len(chain) > 1 else chain[0]
+
+    def __repr__(self):
+        return f"{type(self).__name__}(lr={self.lr})"
+
+
+class SGD(ZooOptimizer):
+    def __init__(self, lr=0.01, momentum=0.0, dampening=0.0, nesterov=False,
+                 weight_decay=0.0, **kw):
+        super().__init__(lr=lr, **kw)
+        self.momentum = momentum
+        self.nesterov = nesterov
+        self.weight_decay = weight_decay
+
+    def _core(self, sched):
+        chain = []
+        if self.weight_decay > 0:
+            chain.append(optax.add_decayed_weights(self.weight_decay))
+        if self.momentum > 0:
+            chain.append(optax.trace(decay=self.momentum,
+                                     nesterov=self.nesterov))
+        chain.append(optax.scale_by_learning_rate(sched))
+        return optax.chain(*chain)
+
+
+class Adam(ZooOptimizer):
+    """Zoo Adam (keras/optimizers/Adam.scala) — Adam with a pluggable
+    schedule."""
+
+    def __init__(self, lr=1e-3, beta_1=0.9, beta_2=0.999, epsilon=1e-8,
+                 schedule=None, **kw):
+        super().__init__(lr=lr, schedule=schedule, **kw)
+        self.beta_1 = beta_1
+        self.beta_2 = beta_2
+        self.epsilon = epsilon
+
+    def _core(self, sched):
+        return optax.chain(
+            optax.scale_by_adam(b1=self.beta_1, b2=self.beta_2,
+                                eps=self.epsilon),
+            optax.scale_by_learning_rate(sched))
+
+
+class AdamWeightDecay(ZooOptimizer):
+    """BERT-style AdamW with linear warmup + linear decay
+    (keras/optimizers/AdamWeightDecay.scala)."""
+
+    def __init__(self, lr=1e-3, warmup_portion=-1.0, total=-1, schedule="linear",
+                 beta_1=0.9, beta_2=0.999, epsilon=1e-6, weight_decay=0.01,
+                 **kw):
+        super().__init__(lr=lr, **kw)
+        self.warmup_portion = warmup_portion
+        self.total = total
+        self.beta_1 = beta_1
+        self.beta_2 = beta_2
+        self.epsilon = epsilon
+        self.weight_decay = weight_decay
+
+    def lr_schedule(self):
+        if self.total <= 0:
+            return lambda step: self.lr
+        warmup_steps = int(max(self.warmup_portion, 0.0) * self.total)
+        return optax.schedules.warmup_linear_schedule(
+            init_value=0.0, peak_value=self.lr,
+            warmup_steps=max(warmup_steps, 1),
+            decay_steps=self.total) if hasattr(optax.schedules,
+                                               "warmup_linear_schedule") else \
+            optax.linear_onecycle_schedule(self.total, self.lr)
+
+    def _core(self, sched):
+        return optax.chain(
+            optax.scale_by_adam(b1=self.beta_1, b2=self.beta_2,
+                                eps=self.epsilon),
+            optax.add_decayed_weights(self.weight_decay),
+            optax.scale_by_learning_rate(sched))
+
+
+class RMSprop(ZooOptimizer):
+    def __init__(self, lr=0.001, decay_rate=0.9, epsilon=1e-8, **kw):
+        super().__init__(lr=lr, **kw)
+        self.decay_rate = decay_rate
+        self.epsilon = epsilon
+
+    def _core(self, sched):
+        return optax.chain(
+            optax.scale_by_rms(decay=self.decay_rate, eps=self.epsilon),
+            optax.scale_by_learning_rate(sched))
+
+
+class Adagrad(ZooOptimizer):
+    def __init__(self, lr=0.01, epsilon=1e-10, **kw):
+        super().__init__(lr=lr, **kw)
+        self.epsilon = epsilon
+
+    def _core(self, sched):
+        return optax.chain(optax.scale_by_rss(eps=self.epsilon),
+                           optax.scale_by_learning_rate(sched))
+
+
+class Adadelta(ZooOptimizer):
+    def __init__(self, lr=1.0, rho=0.95, epsilon=1e-8, **kw):
+        super().__init__(lr=lr, **kw)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def _core(self, sched):
+        return optax.chain(
+            optax.scale_by_adadelta(rho=self.rho, eps=self.epsilon),
+            optax.scale_by_learning_rate(sched))
+
+
+class Adamax(ZooOptimizer):
+    def __init__(self, lr=0.002, beta_1=0.9, beta_2=0.999, epsilon=1e-8, **kw):
+        super().__init__(lr=lr, **kw)
+        self.beta_1, self.beta_2, self.epsilon = beta_1, beta_2, epsilon
+
+    def _core(self, sched):
+        return optax.chain(
+            optax.scale_by_adamax(b1=self.beta_1, b2=self.beta_2,
+                                  eps=self.epsilon),
+            optax.scale_by_learning_rate(sched))
+
+
+class Ftrl(ZooOptimizer):
+    def __init__(self, lr=0.001, learning_rate_power=-0.5,
+                 initial_accumulator_value=0.1, l1_regularization_strength=0.0,
+                 l2_regularization_strength=0.0, **kw):
+        super().__init__(lr=lr, **kw)
+
+    def _core(self, sched):
+        # optax has no ftrl; approximate with adagrad-style scaling.
+        return optax.chain(optax.scale_by_rss(),
+                           optax.scale_by_learning_rate(sched))
+
+
+_OPTIMIZERS = {
+    "sgd": SGD,
+    "adam": Adam,
+    "adamax": Adamax,
+    "rmsprop": RMSprop,
+    "adadelta": Adadelta,
+    "adagrad": Adagrad,
+    "adamweightdecay": AdamWeightDecay,
+    "ftrl": Ftrl,
+}
+
+
+def get_optimizer(identifier) -> ZooOptimizer:
+    if isinstance(identifier, ZooOptimizer):
+        return identifier
+    if isinstance(identifier, optax.GradientTransformation):
+        opt = ZooOptimizer()
+        opt._core = lambda sched: identifier  # noqa
+        opt.to_optax = lambda: identifier  # type: ignore
+        return opt
+    try:
+        return _OPTIMIZERS[identifier.lower()]()
+    except KeyError:
+        raise ValueError(f"Unknown optimizer: {identifier}")

@@ -1,0 +1,6 @@
+"""Keras-2 model entry points — same engine as keras-1 (keras2 parity:
+the reference's keras2 Sequential/Model reuse the keras topology)."""
+
+from ..keras.models import Model, Sequential
+
+__all__ = ["Model", "Sequential"]

@@ -1,0 +1,16 @@
+"""Interop nets (reference: ``zoo/.../pipeline/api/net/``).
+
+Foreign-runtime models — TF graphs, PyTorch modules, ONNX files — imported
+into the TPU framework, preferring *translation to jax* (compiled into the
+XLA program) over the reference's in-process JNI execution.
+"""
+
+from .net_load import Net
+from .tf_graph import TFGraphFunction, UnsupportedTFGraph
+from .tfnet import TFNet
+from .torch_fx import TorchFxConverter, UnsupportedTorchGraph
+from .torchnet import TorchCriterion, TorchNet
+
+__all__ = ["Net", "TFNet", "TorchNet", "TorchCriterion",
+           "TFGraphFunction", "TorchFxConverter",
+           "UnsupportedTFGraph", "UnsupportedTorchGraph"]

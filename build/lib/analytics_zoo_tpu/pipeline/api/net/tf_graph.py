@@ -1,0 +1,592 @@
+"""TF GraphDef → jax converter.
+
+Parity: ``zoo/.../pipeline/api/net/TFNet.scala`` executes frozen TF graphs
+through an in-process libtensorflow JNI session (CPU). TPU-native redesign:
+the GraphDef is *translated* node-by-node into jax ops so the imported graph
+compiles into the surrounding XLA program (MXU matmuls, fused elementwise),
+instead of bouncing to a foreign CPU runtime every call. Variables must be
+frozen into Consts first (`tf.python.framework.convert_to_constants`), which
+is exactly the reference's expectation for TFNet ("frozen graph").
+
+TensorFlow is used only to *parse* protos here — never to execute.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+TF_REGISTRY: Dict[str, Callable] = {}
+
+
+class UnsupportedTFGraph(Exception):
+    pass
+
+
+def tf_op(*names):
+    def deco(fn):
+        for n in names:
+            TF_REGISTRY[n] = fn
+        return fn
+    return deco
+
+
+def _attrs(node) -> Dict[str, Any]:
+    from tensorflow.python.framework import tensor_util
+
+    out = {}
+    for key, av in node.attr.items():
+        kind = av.WhichOneof("value")
+        if kind == "i":
+            out[key] = av.i
+        elif kind == "f":
+            out[key] = av.f
+        elif kind == "b":
+            out[key] = av.b
+        elif kind == "s":
+            out[key] = av.s.decode("utf-8", "replace")
+        elif kind == "type":
+            out[key] = av.type
+        elif kind == "tensor":
+            out[key] = tensor_util.MakeNdarray(av.tensor)
+        elif kind == "shape":
+            out[key] = [d.size for d in av.shape.dim]
+        elif kind == "list":
+            lst = av.list
+            for field in ("i", "f", "b", "s"):
+                vals = list(getattr(lst, field))
+                if vals:
+                    out[key] = vals
+                    break
+            else:
+                out[key] = []
+    return out
+
+
+def _nhwc_pool_args(attrs):
+    fmt = attrs.get("data_format", "NHWC")
+    ks, st = attrs["ksize"], attrs["strides"]
+    if fmt == "NHWC":
+        return (ks[1], ks[2]), (st[1], st[2]), fmt
+    return (ks[2], ks[3]), (st[2], st[3]), fmt
+
+
+# -- structural ------------------------------------------------------------
+
+
+@tf_op("Identity", "StopGradient", "PreventGradient", "CheckNumerics",
+       "EnsureShape", "Snapshot", "ReadVariableOp")
+def _identity(attrs, ins):
+    # ReadVariableOp: the resource placeholder's env entry IS the value
+    # (capture-based lowering feeds variable arrays straight in).
+    return [ins[0]]
+
+
+@tf_op("IdentityN")
+def _identity_n(attrs, ins):
+    return list(ins)
+
+
+@tf_op("NoOp")
+def _noop(attrs, ins):
+    return []
+
+
+# -- math ------------------------------------------------------------------
+
+_BINOPS = {
+    "Add": jnp.add, "AddV2": jnp.add, "Sub": jnp.subtract,
+    "Mul": jnp.multiply, "Div": jnp.divide, "RealDiv": jnp.divide,
+    "FloorDiv": jnp.floor_divide, "Maximum": jnp.maximum,
+    "Minimum": jnp.minimum, "Pow": jnp.power,
+    "SquaredDifference": lambda a, b: jnp.square(a - b),
+    "Greater": jnp.greater, "GreaterEqual": jnp.greater_equal,
+    "Less": jnp.less, "LessEqual": jnp.less_equal, "Equal": jnp.equal,
+    "NotEqual": jnp.not_equal, "LogicalAnd": jnp.logical_and,
+    "LogicalOr": jnp.logical_or, "Mod": jnp.mod,
+}
+for _n, _f in _BINOPS.items():
+    TF_REGISTRY[_n] = (lambda attrs, ins, _f=_f: [_f(ins[0], ins[1])])
+
+_UNOPS = {
+    "Relu": jax.nn.relu, "Relu6": jax.nn.relu6, "Elu": jax.nn.elu,
+    "Selu": jax.nn.selu, "Sigmoid": jax.nn.sigmoid, "Tanh": jnp.tanh,
+    "Softplus": jax.nn.softplus, "Softsign": jax.nn.soft_sign,
+    "Exp": jnp.exp, "Log": jnp.log, "Log1p": jnp.log1p, "Neg": jnp.negative,
+    "Abs": jnp.abs, "Sqrt": jnp.sqrt, "Rsqrt": lax.rsqrt,
+    "Square": jnp.square, "Sign": jnp.sign, "Floor": jnp.floor,
+    "Ceil": jnp.ceil, "Round": jnp.round, "Erf": jax.scipy.special.erf,
+    "Sin": jnp.sin, "Cos": jnp.cos, "LogicalNot": jnp.logical_not,
+    "Reciprocal": lambda x: 1.0 / x, "ZerosLike": jnp.zeros_like,
+    "OnesLike": jnp.ones_like, "Tan": jnp.tan, "Atan": jnp.arctan,
+}
+for _n, _f in _UNOPS.items():
+    TF_REGISTRY[_n] = (lambda attrs, ins, _f=_f: [_f(ins[0])])
+
+
+@tf_op("LeakyRelu")
+def _leaky_relu(attrs, ins):
+    return [jax.nn.leaky_relu(ins[0], attrs.get("alpha", 0.2))]
+
+
+@tf_op("AddN")
+def _addn(attrs, ins):
+    out = ins[0]
+    for x in ins[1:]:
+        out = out + x
+    return [out]
+
+
+@tf_op("MatMul")
+def _matmul(attrs, ins):
+    a, b = ins
+    if attrs.get("transpose_a"):
+        a = jnp.swapaxes(a, -1, -2)
+    if attrs.get("transpose_b"):
+        b = jnp.swapaxes(b, -1, -2)
+    return [jnp.matmul(a, b)]
+
+
+@tf_op("BatchMatMul", "BatchMatMulV2")
+def _batch_matmul(attrs, ins):
+    a, b = ins
+    if attrs.get("adj_x"):
+        a = jnp.swapaxes(a, -1, -2)
+    if attrs.get("adj_y"):
+        b = jnp.swapaxes(b, -1, -2)
+    return [jnp.matmul(a, b)]
+
+
+@tf_op("BiasAdd")
+def _bias_add(attrs, ins):
+    x, b = ins
+    if attrs.get("data_format", "NHWC") == "NCHW" and x.ndim > 2:
+        return [x + b.reshape((1, -1) + (1,) * (x.ndim - 2))]
+    return [x + b]
+
+
+@tf_op("Softmax")
+def _softmax(attrs, ins):
+    return [jax.nn.softmax(ins[0], axis=-1)]
+
+
+@tf_op("LogSoftmax")
+def _log_softmax(attrs, ins):
+    return [jax.nn.log_softmax(ins[0], axis=-1)]
+
+
+@tf_op("Select", "SelectV2")
+def _select(attrs, ins):
+    return [jnp.where(ins[0], ins[1], ins[2])]
+
+
+@tf_op("SparseSoftmaxCrossEntropyWithLogits")
+def _sparse_softmax_xent(attrs, ins):
+    logits, labels = ins
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(
+        logp, jnp.asarray(labels, jnp.int32)[..., None], axis=-1)[..., 0]
+    backprop = jax.nn.softmax(logits, axis=-1) - jax.nn.one_hot(
+        jnp.asarray(labels, jnp.int32), logits.shape[-1],
+        dtype=logits.dtype)
+    return [-picked, backprop]
+
+
+@tf_op("SoftmaxCrossEntropyWithLogits")
+def _softmax_xent(attrs, ins):
+    logits, labels = ins
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    loss = -jnp.sum(labels * logp, axis=-1)
+    backprop = jax.nn.softmax(logits, axis=-1) - labels
+    return [loss, backprop]
+
+
+@tf_op("Cast")
+def _cast(attrs, ins):
+    import tensorflow as tf
+    dt = tf.dtypes.as_dtype(attrs["DstT"]).as_numpy_dtype
+    x = ins[0]
+    return [x.astype(dt) if hasattr(x, "astype") else jnp.asarray(x, dt)]
+
+
+# -- reductions ------------------------------------------------------------
+
+
+def _tf_reduce(fn):
+    def impl(attrs, ins):
+        axes = [int(a) for a in np.asarray(ins[1]).reshape(-1)]
+        keep = bool(attrs.get("keep_dims", attrs.get("keepdims", False)))
+        return [fn(ins[0], axis=tuple(axes) if axes else None,
+                   keepdims=keep)]
+    return impl
+
+
+TF_REGISTRY["Mean"] = _tf_reduce(jnp.mean)
+TF_REGISTRY["Sum"] = _tf_reduce(jnp.sum)
+TF_REGISTRY["Max"] = _tf_reduce(jnp.max)
+TF_REGISTRY["Min"] = _tf_reduce(jnp.min)
+TF_REGISTRY["Prod"] = _tf_reduce(jnp.prod)
+TF_REGISTRY["All"] = _tf_reduce(jnp.all)
+TF_REGISTRY["Any"] = _tf_reduce(jnp.any)
+
+
+@tf_op("ArgMax")
+def _argmax(attrs, ins):
+    return [jnp.argmax(ins[0], axis=int(np.asarray(ins[1])))]
+
+
+@tf_op("ArgMin")
+def _argmin(attrs, ins):
+    return [jnp.argmin(ins[0], axis=int(np.asarray(ins[1])))]
+
+
+# -- conv / pool -----------------------------------------------------------
+
+
+def _tf_padding(attrs):
+    pad = attrs.get("padding", "VALID")
+    if pad == "EXPLICIT":
+        ep = attrs.get("explicit_paddings", [])
+        # layout follows data_format: spatial pads at H,W positions
+        if attrs.get("data_format", "NHWC") == "NCHW":
+            idx = (4, 6)
+        else:
+            idx = (2, 4)
+        return [(int(ep[i]), int(ep[i + 1])) for i in idx]
+    return pad
+
+
+@tf_op("Conv2D")
+def _conv2d(attrs, ins):
+    x, w = ins  # w: HWIO
+    fmt = attrs.get("data_format", "NHWC")
+    strides = attrs["strides"]
+    dil = attrs.get("dilations", [1, 1, 1, 1])
+    if fmt == "NHWC":
+        st, dl = (strides[1], strides[2]), (dil[1], dil[2])
+    else:
+        st, dl = (strides[2], strides[3]), (dil[2], dil[3])
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    (fmt, "HWIO", fmt))
+    return [lax.conv_general_dilated(
+        x, w, window_strides=st, padding=_tf_padding(attrs),
+        rhs_dilation=dl, dimension_numbers=dn)]
+
+
+@tf_op("DepthwiseConv2dNative")
+def _depthwise(attrs, ins):
+    x, w = ins  # w: (H, W, C_in, mult)
+    fmt = attrs.get("data_format", "NHWC")
+    strides = attrs["strides"]
+    st = (strides[1], strides[2]) if fmt == "NHWC" \
+        else (strides[2], strides[3])
+    h, w_, cin, mult = w.shape
+    kernel = jnp.reshape(jnp.transpose(w, (0, 1, 3, 2)), (h, w_, 1,
+                                                          cin * mult))
+    dn = lax.conv_dimension_numbers(x.shape, kernel.shape,
+                                    (fmt, "HWIO", fmt))
+    return [lax.conv_general_dilated(
+        x, kernel, window_strides=st, padding=_tf_padding(attrs),
+        dimension_numbers=dn, feature_group_count=cin)]
+
+
+def _tf_pool(attrs, x, reducer, init, avg=False):
+    (kh, kw), (sh, sw), fmt = _nhwc_pool_args(attrs)
+    if fmt == "NHWC":
+        window, strides = (1, kh, kw, 1), (1, sh, sw, 1)
+    else:
+        window, strides = (1, 1, kh, kw), (1, 1, sh, sw)
+    pad = attrs.get("padding", "VALID")
+    if pad == "SAME":
+        pads = lax.padtype_to_pads(x.shape, window, strides, "SAME")
+    else:
+        pads = [(0, 0)] * 4
+    out = lax.reduce_window(x, init, reducer, window, strides, pads)
+    if avg:
+        if pad == "SAME":
+            ones = jnp.ones(x.shape, x.dtype)
+            cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides,
+                                    pads)
+            out = out / cnt
+        else:
+            out = out / (kh * kw)
+    return out
+
+
+@tf_op("MaxPool")
+def _maxpool(attrs, ins):
+    return [_tf_pool(attrs, ins[0], lax.max, -jnp.inf)]
+
+
+@tf_op("AvgPool")
+def _avgpool(attrs, ins):
+    return [_tf_pool(attrs, ins[0], lax.add, 0.0, avg=True)]
+
+
+@tf_op("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3")
+def _fused_bn(attrs, ins):
+    x, scale, offset, mean, var = ins[:5]
+    eps = attrs.get("epsilon", 1e-3)
+    fmt = attrs.get("data_format", "NHWC")
+    shape = (1, -1, 1, 1) if fmt == "NCHW" else (1, 1, 1, -1)
+    inv = lax.rsqrt(var + eps) * scale
+    out = x * inv.reshape(shape) + (offset - mean * inv).reshape(shape)
+    return [out, mean, var, mean, var, mean]  # aux outputs rarely consumed
+
+
+# -- shape manipulation ----------------------------------------------------
+
+
+@tf_op("Shape")
+def _shape(attrs, ins):
+    return [np.asarray(ins[0].shape, np.int32)]
+
+
+@tf_op("Rank")
+def _rank(attrs, ins):
+    return [np.asarray(ins[0].ndim, np.int32)]
+
+
+@tf_op("Size")
+def _size(attrs, ins):
+    return [np.asarray(int(np.prod(ins[0].shape)), np.int32)]
+
+
+@tf_op("Reshape")
+def _reshape(attrs, ins):
+    shape = [int(s) for s in np.asarray(ins[1]).reshape(-1)]
+    return [jnp.reshape(ins[0], shape)]
+
+
+@tf_op("Squeeze")
+def _squeeze(attrs, ins):
+    dims = attrs.get("squeeze_dims") or attrs.get("axis") or None
+    return [jnp.squeeze(ins[0],
+                        axis=tuple(int(d) for d in dims) if dims else None)]
+
+
+@tf_op("ExpandDims")
+def _expand_dims(attrs, ins):
+    return [jnp.expand_dims(ins[0], int(np.asarray(ins[1])))]
+
+
+@tf_op("ConcatV2")
+def _concat(attrs, ins):
+    axis = int(np.asarray(ins[-1]))
+    return [jnp.concatenate(ins[:-1], axis=axis)]
+
+
+@tf_op("Pack")
+def _pack(attrs, ins):
+    return [jnp.stack(ins, axis=int(attrs.get("axis", 0)))]
+
+
+@tf_op("Unpack")
+def _unpack(attrs, ins):
+    axis = int(attrs.get("axis", 0))
+    num = int(attrs["num"])
+    parts = jnp.split(ins[0], num, axis=axis)
+    return [jnp.squeeze(p, axis=axis) for p in parts]
+
+
+@tf_op("Split")
+def _split(attrs, ins):
+    axis = int(np.asarray(ins[0]))
+    return list(jnp.split(ins[1], int(attrs["num_split"]), axis=axis))
+
+
+@tf_op("SplitV")
+def _splitv(attrs, ins):
+    sizes = [int(s) for s in np.asarray(ins[1]).reshape(-1)]
+    axis = int(np.asarray(ins[2]))
+    points = np.cumsum(sizes)[:-1]
+    return list(jnp.split(ins[0], points, axis=axis))
+
+
+@tf_op("Transpose")
+def _transpose(attrs, ins):
+    return [jnp.transpose(ins[0],
+                          [int(p) for p in np.asarray(ins[1]).reshape(-1)])]
+
+
+@tf_op("Pad", "PadV2", "MirrorPad")
+def _pad(attrs, ins):
+    pads = [tuple(int(v) for v in row) for row in np.asarray(ins[1])]
+    if attrs.get("mode") in ("REFLECT", "SYMMETRIC"):
+        return [jnp.pad(ins[0], pads,
+                        mode="reflect" if attrs["mode"] == "REFLECT"
+                        else "symmetric")]
+    cval = float(np.asarray(ins[2])) if len(ins) > 2 else 0.0
+    return [jnp.pad(ins[0], pads, constant_values=cval)]
+
+
+@tf_op("StridedSlice")
+def _strided_slice(attrs, ins):
+    x = ins[0]
+    begin = [int(v) for v in np.asarray(ins[1]).reshape(-1)]
+    end = [int(v) for v in np.asarray(ins[2]).reshape(-1)]
+    strides = [int(v) for v in np.asarray(ins[3]).reshape(-1)]
+    bm = int(attrs.get("begin_mask", 0))
+    em = int(attrs.get("end_mask", 0))
+    ellipsis = int(attrs.get("ellipsis_mask", 0))
+    new_axis = int(attrs.get("new_axis_mask", 0))
+    shrink = int(attrs.get("shrink_axis_mask", 0))
+    if ellipsis or new_axis:
+        raise UnsupportedTFGraph("StridedSlice ellipsis/new_axis mask")
+    slices: List[Any] = []
+    for i in range(len(begin)):
+        if shrink & (1 << i):
+            slices.append(begin[i])
+            continue
+        b = None if bm & (1 << i) else begin[i]
+        e = None if em & (1 << i) else end[i]
+        slices.append(slice(b, e, strides[i]))
+    return [x[tuple(slices)]]
+
+
+@tf_op("Slice")
+def _slice(attrs, ins):
+    begin = [int(v) for v in np.asarray(ins[1]).reshape(-1)]
+    size = [int(v) for v in np.asarray(ins[2]).reshape(-1)]
+    x = ins[0]
+    slices = tuple(
+        slice(b, x.shape[i] if s == -1 else b + s)
+        for i, (b, s) in enumerate(zip(begin, size)))
+    return [x[slices]]
+
+
+@tf_op("GatherV2", "Gather")
+def _gather(attrs, ins):
+    axis = int(np.asarray(ins[2])) if len(ins) > 2 else 0
+    idx = ins[1]
+    if isinstance(idx, np.ndarray):
+        idx = idx.astype(np.int64)
+    return [jnp.take(ins[0], idx, axis=axis)]
+
+
+@tf_op("Tile")
+def _tile(attrs, ins):
+    return [jnp.tile(ins[0], [int(v) for v in np.asarray(ins[1])])]
+
+
+@tf_op("Fill")
+def _fill(attrs, ins):
+    shape = [int(v) for v in np.asarray(ins[0]).reshape(-1)]
+    return [jnp.full(shape, ins[1])]
+
+
+@tf_op("Range")
+def _range(attrs, ins):
+    s, l, d = (np.asarray(v).item() for v in ins)
+    return [np.arange(s, l, d)]
+
+
+@tf_op("BroadcastTo")
+def _broadcast_to(attrs, ins):
+    return [jnp.broadcast_to(ins[0],
+                             [int(v) for v in np.asarray(ins[1])])]
+
+
+# ---------------------------------------------------------------------------
+# GraphDef interpreter
+# ---------------------------------------------------------------------------
+
+
+class TFGraphFunction:
+    """A frozen GraphDef as ``fn(consts, *inputs) -> outputs``.
+
+    Const tensors are exposed as a (trainable) pytree keyed by node name, so
+    a converted graph can be fine-tuned exactly like the reference's
+    TFTrainingHelper path — except gradients come from jax AD instead of a
+    TF session.
+    """
+
+    def __init__(self, graph_def, input_names: List[str],
+                 output_names: List[str],
+                 captures: Dict[str, np.ndarray] = None,
+                 trainable_captures: List[str] = None):
+        """``captures``: placeholder-name → value for tensors captured from
+        outside the graph (tf.function variable reads). When given, *they*
+        are the trainable params (exact tf.Variable correspondence) and
+        Const nodes stay baked; otherwise float Consts are trainable (the
+        frozen-graph path)."""
+        self.input_names = [n.split(":")[0] for n in input_names]
+        self.output_names = list(output_names)
+        self.nodes = list(graph_def.node)
+        byname = {n.name: n for n in self.nodes}
+        self.captures = dict(captures or {})
+        self.consts: Dict[str, np.ndarray] = {}
+        unsupported = set()
+        for n in self.nodes:
+            if n.op == "Const":
+                self.consts[n.name] = _attrs(n)["value"]
+            elif n.op not in ("Placeholder", "PlaceholderWithDefault") \
+                    and n.op not in TF_REGISTRY:
+                unsupported.add(n.op)
+        if unsupported:
+            raise UnsupportedTFGraph(
+                f"unsupported TF ops: {sorted(unsupported)}")
+        if captures:
+            self.param_names = list(
+                trainable_captures if trainable_captures is not None
+                else captures)
+        else:
+            # trainable = float consts; ints/bools stay baked (shapes)
+            self.param_names = [k for k, v in self.consts.items()
+                                if np.issubdtype(v.dtype, np.floating)]
+        self._byname = byname
+
+    def init_params(self):
+        src = self.captures if self.captures else self.consts
+        return {k: jnp.asarray(src[k]) for k in self.param_names}
+
+    def __call__(self, params, *inputs):
+        env: Dict[str, Any] = {k: v for k, v in self.consts.items()
+                               if k not in params}
+        for k, v in self.captures.items():
+            if k not in params:
+                env[k] = v
+        env.update(params)
+        for name, x in zip(self.input_names, inputs):
+            env[name] = x
+        for node in self.nodes:
+            if node.op in ("Const", "Placeholder",
+                           "PlaceholderWithDefault"):
+                if node.op == "PlaceholderWithDefault" \
+                        and node.name not in env:
+                    src = node.input[0].split(":")[0]
+                    env[node.name] = env[src]
+                continue
+            ins = []
+            for ref in node.input:
+                if ref.startswith("^"):
+                    continue  # control edge
+                name, _, idx = ref.partition(":")
+                val = env[name]
+                if idx and isinstance(val, list):
+                    val = val[int(idx)]
+                elif isinstance(val, list):
+                    val = val[0]
+                ins.append(val)
+            attrs = _attrs(node)
+            if ins and all(
+                    isinstance(v, (np.ndarray, np.generic, int, float))
+                    for v in ins):
+                with jax.ensure_compile_time_eval():
+                    outs = TF_REGISTRY[node.op](attrs, ins)
+                outs = [np.asarray(o) for o in outs]
+            else:
+                outs = TF_REGISTRY[node.op](attrs, ins)
+            env[node.name] = outs if len(outs) != 1 else outs[0]
+        results = []
+        for ref in self.output_names:
+            name, _, idx = ref.partition(":")
+            val = env[name]
+            if isinstance(val, list):
+                val = val[int(idx) if idx else 0]
+            results.append(val)
+        return results

@@ -1,0 +1,292 @@
+"""TFNet — TensorFlow models inside the TPU framework.
+
+Parity: ``zoo/.../pipeline/api/net/TFNet.scala:53`` (frozen graph as module,
+factories :568-620 from folder/pb/saved-model) and ``TFNetForInference``
+(saved-model path), which execute through libtensorflow JNI on host CPU.
+
+TPU-native redesign, two tiers (mirrors torchnet.py):
+
+1. **Translation (primary):** the frozen GraphDef is converted op-by-op to
+   jax (``tf_graph.TFGraphFunction``) so it fuses into the surrounding XLA
+   program and runs on the MXU; float consts import as a trainable pytree
+   (the TFTrainingHelper training path without a TF session).
+2. **Host callback (fallback):** graphs with untranslatable ops execute via
+   ``tf.function`` on the host CPU behind ``jax.pure_callback``, with
+   ``tf.GradientTape`` supplying input gradients through ``jax.custom_vjp``
+   — functionally the reference's JNI session, minus the JVM.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..keras.engine.base import KerasLayer
+from .tf_graph import TFGraphFunction, UnsupportedTFGraph
+
+
+def _tf():
+    import tensorflow as tf
+    return tf
+
+
+def _freeze_concrete(concrete):
+    from tensorflow.python.framework.convert_to_constants import \
+        convert_variables_to_constants_v2
+
+    frozen = convert_variables_to_constants_v2(concrete)
+    graph_def = frozen.graph.as_graph_def()
+    inputs = [t.name for t in frozen.inputs
+              if "unknown" not in t.name.lower()] or \
+             [t.name for t in frozen.inputs]
+    outputs = [t.name for t in frozen.outputs]
+    return graph_def, inputs, outputs, frozen
+
+
+class TFNet(KerasLayer):
+    """A TF graph as a zoo layer / inference model."""
+
+    def __init__(self, graph_fn: Optional[TFGraphFunction] = None,
+                 callback_fn=None, name: Optional[str] = None, **kwargs):
+        super().__init__(name=name, **kwargs)
+        self.graph_fn = graph_fn
+        self._callback = callback_fn
+        self.mode = "jax" if graph_fn is not None else "callback"
+
+    # ------------------------------------------------------------------
+    # factories (TFNet.scala:568-620, TFNet.from_export_folder /
+    # from_session / from_saved_model python mirrors)
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_path(cls, path: str, **kw) -> "TFNet":
+        if os.path.isdir(path):
+            if os.path.exists(os.path.join(path, "saved_model.pb")):
+                return cls.from_saved_model(path, **kw)
+            for fname in os.listdir(path):
+                if fname.endswith((".h5", ".keras")):
+                    return cls.from_keras(os.path.join(path, fname), **kw)
+            raise IOError(f"no TF model found under {path}")
+        if path.endswith((".h5", ".keras")):
+            return cls.from_keras(path, **kw)
+        return cls.from_frozen(path, **kw)
+
+    @classmethod
+    def from_frozen(cls, pb_path: str,
+                    input_names: Optional[Sequence[str]] = None,
+                    output_names: Optional[Sequence[str]] = None,
+                    **kw) -> "TFNet":
+        tf = _tf()
+        graph_def = tf.compat.v1.GraphDef()
+        with open(pb_path, "rb") as f:
+            graph_def.ParseFromString(f.read())
+        if input_names is None:
+            input_names = [n.name for n in graph_def.node
+                           if n.op == "Placeholder"]
+        if output_names is None:
+            consumed = {ref.partition(":")[0].lstrip("^")
+                        for n in graph_def.node for ref in n.input}
+            output_names = [n.name for n in graph_def.node
+                            if n.name not in consumed
+                            and n.op not in ("Const", "NoOp")]
+        return cls._from_graph_def(graph_def, list(input_names),
+                                   list(output_names), **kw)
+
+    @classmethod
+    def from_saved_model(cls, path: str, signature: str = "serving_default",
+                         tag: str = "serve", **kw) -> "TFNet":
+        tf = _tf()
+        loaded = tf.saved_model.load(path)
+        sigs = getattr(loaded, "signatures", {})
+        if signature in sigs:
+            concrete = sigs[signature]
+        elif sigs:
+            concrete = next(iter(sigs.values()))
+        else:
+            raise IOError(f"saved model at {path} has no signatures")
+        graph_def, inputs, outputs, frozen = _freeze_concrete(concrete)
+        return cls._from_graph_def(graph_def, inputs, outputs,
+                                   keepalive=loaded, **kw)
+
+    @classmethod
+    def from_keras(cls, h5_path: str, **kw) -> "TFNet":
+        tf = _tf()
+        model = tf.keras.models.load_model(h5_path, compile=False)
+        spec = [tf.TensorSpec((None,) + tuple(i.shape[1:]), i.dtype)
+                for i in model.inputs]
+        fn = tf.function(lambda *xs: model(list(xs) if len(xs) > 1
+                                           else xs[0]))
+        concrete = fn.get_concrete_function(*spec)
+        graph_def, inputs, outputs, frozen = _freeze_concrete(concrete)
+        return cls._from_graph_def(graph_def, inputs, outputs,
+                                   keepalive=model, **kw)
+
+    @classmethod
+    def _from_graph_def(cls, graph_def, input_names, output_names,
+                        keepalive=None, lower: bool = True) -> "TFNet":
+        if lower:
+            try:
+                gfn = TFGraphFunction(graph_def, input_names, output_names)
+                net = cls(graph_fn=gfn)
+                net._imported = gfn.init_params()
+                return net
+            except UnsupportedTFGraph:
+                pass
+        net = cls(callback_fn=_CallbackTF(graph_def, input_names,
+                                          output_names))
+        net._imported = {}
+        net._keepalive = keepalive
+        return net
+
+    # -- KerasLayer surface ---------------------------------------------
+    def build(self, rng, input_shape):
+        return dict(getattr(self, "_imported", {}))
+
+    def call(self, params, inputs, training=False, **kwargs):
+        xs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        if self.mode == "jax":
+            outs = self.graph_fn(params, *xs)
+        else:
+            outs = self._callback(xs)
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    @property
+    def num_outputs(self):
+        if self.mode == "jax":
+            return len(self.graph_fn.output_names)
+        return self._callback.num_outputs
+
+    @num_outputs.setter
+    def num_outputs(self, v):  # base class sets a default; ignore
+        pass
+
+    def compute_output_shape(self, input_shape):
+        shapes = input_shape if isinstance(input_shape, list) \
+            else [input_shape]
+        xs = [np.zeros(tuple(2 if d is None else d for d in s),
+                       np.float32) for s in shapes]
+        outs = self.predict(xs)
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        result = [(None,) + tuple(np.shape(o)[1:]) for o in outs]
+        return result[0] if len(result) == 1 else result
+
+    # -- AbstractModel surface ------------------------------------------
+    def predict(self, inputs):
+        xs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        xs = [np.asarray(x) for x in xs]
+        out = self.call(getattr(self, "_imported", {}), xs)
+        return jax.tree_util.tree_map(np.asarray, out)
+
+    def release(self):
+        pass
+
+
+class _CallbackTF:
+    """Host-CPU TF execution behind pure_callback.
+
+    Input gradients come from ``tf.GradientTape`` through a ``custom_vjp``
+    backward callback, so a callback-mode TFNet placed inside a model keeps
+    the chain rule intact (the reference's TFNet trains the same way: the
+    foreign graph computes its own grads, TFNet.scala backward meta).
+    Graph consts are frozen — matching TFNet's "fixed weights" semantics.
+    """
+
+    def __init__(self, graph_def, input_names, output_names):
+        tf = _tf()
+        self.tf = tf
+        self.input_names = [n if ":" in n else n + ":0"
+                            for n in input_names]
+        self.output_names = [n if ":" in n else n + ":0"
+                             for n in output_names]
+        self.graph_def = graph_def
+        self._fn = None
+        self.num_outputs = len(output_names)
+        self._shape_cache = {}
+
+        @jax.custom_vjp
+        def apply(xs):
+            shapes = self._result_shapes(xs)
+            out = jax.pure_callback(
+                lambda *a: self.host_run(*a), tuple(shapes), *xs,
+                vmap_method="sequential")
+            return tuple(out)
+
+        def fwd(xs):
+            return apply(xs), xs
+
+        def bwd(xs, gs):
+            from .torchnet import _is_int, _zero_cotangent
+
+            shapes = [jax.ShapeDtypeStruct(np.shape(x), np.float32)
+                      for x in xs]
+            out = jax.pure_callback(
+                lambda a, g: tuple(
+                    np.asarray(v, np.float32)
+                    for v in self.host_grad(list(a), list(g))),
+                tuple(shapes), tuple(xs), tuple(gs),
+                vmap_method="sequential")
+            gx = tuple(
+                _zero_cotangent(x) if _is_int(x)
+                else g.astype(getattr(x, "dtype", np.float32))
+                for x, g in zip(xs, out))
+            return (gx,)
+
+        apply.defvjp(fwd, bwd)
+        self._apply = apply
+
+    def _ensure(self):
+        if self._fn is not None:
+            return
+        tf = self.tf
+
+        def import_and_run(*xs):
+            fetches = tf.graph_util.import_graph_def(
+                self.graph_def,
+                input_map=dict(zip(self.input_names, xs)),
+                return_elements=self.output_names)
+            return fetches
+        self._fn = tf.function(import_and_run)
+
+    def _result_shapes(self, xs):
+        key = tuple((tuple(np.shape(x)), str(getattr(x, "dtype", "f4")))
+                    for x in xs)
+        if key not in self._shape_cache:
+            probe = [np.zeros(np.shape(x),
+                              np.asarray(x).dtype
+                              if not hasattr(x, "dtype") else x.dtype)
+                     for x in xs]
+            self._shape_cache[key] = [
+                jax.ShapeDtypeStruct(o.shape, o.dtype)
+                for o in self.host_run(*probe)]
+        return self._shape_cache[key]
+
+    def host_run(self, *xs):
+        self._ensure()
+        tf = self.tf
+        with tf.device("/CPU:0"):
+            outs = self._fn(*[tf.constant(np.asarray(x)) for x in xs])
+        return tuple(np.asarray(o) for o in outs)
+
+    def host_grad(self, xs, gs):
+        self._ensure()
+        tf = self.tf
+        with tf.device("/CPU:0"):
+            ts = [tf.constant(np.asarray(x)) for x in xs]
+            with tf.GradientTape() as tape:
+                for t in ts:
+                    tape.watch(t)
+                outs = self._fn(*ts)
+                target = tf.add_n([
+                    tf.reduce_sum(o * tf.constant(np.asarray(g)))
+                    for o, g in zip(outs, gs)])
+            grads = tape.gradient(target, ts)
+        return tuple(
+            np.zeros(np.shape(x), np.float32) if g is None
+            else np.asarray(g, np.float32)
+            for x, g in zip(xs, grads))
+
+    def __call__(self, xs):
+        return list(self._apply(tuple(xs)))

@@ -1,0 +1,353 @@
+"""torch.fx graph → jax converter.
+
+The reference runs TorchScript modules in-process through a JNI shim
+(``zoo/.../pipeline/api/net/TorchNet.scala:39``, ``PytorchModelWrapper.java``)
+— i.e. the foreign runtime executes on the host CPU. On TPU that would leave
+the MXU idle, so the primary path *translates* the module into jax: we
+symbolically trace with ``torch.fx`` and map each module/function call onto
+``jax.numpy``/``lax`` ops, with the state_dict imported as a trainable pytree.
+Anything fx can't trace or we can't map falls back to the host-callback
+executor in ``torchnet.py`` (the moral equivalent of the reference's JNI
+path).
+"""
+
+from __future__ import annotations
+
+import math
+import operator
+from typing import Any, Callable, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+class UnsupportedTorchGraph(Exception):
+    pass
+
+
+def _np(t):
+    return np.asarray(t.detach().cpu().numpy())
+
+
+def _flatten_mid(x, start, end):
+    end = end % x.ndim
+    shape = x.shape[:start] + (-1,) + x.shape[end + 1:]
+    return jnp.reshape(x, shape)
+
+
+def _torch_mean(x, dim=None, keepdim=False, **kw):
+    return jnp.mean(x, axis=dim, keepdims=keepdim)
+
+
+def _torch_sum(x, dim=None, keepdim=False, **kw):
+    return jnp.sum(x, axis=dim, keepdims=keepdim)
+
+
+def _torch_expand(x, *sizes):
+    if len(sizes) == 1 and isinstance(sizes[0], (tuple, list)):
+        sizes = tuple(sizes[0])
+    # torch aligns trailing dims; -1 keeps the existing size
+    offset = len(sizes) - x.ndim
+    shape = tuple(
+        x.shape[i - offset] if d == -1 else d
+        for i, d in enumerate(sizes))
+    return jnp.broadcast_to(x, shape)
+
+
+# ---------------------------------------------------------------------------
+# module converters: (module, params_prefix) -> fn(params, x)
+# ---------------------------------------------------------------------------
+
+
+def _conv_nd(x, w, b, stride, padding, dilation, groups, spatial):
+    sp = "XYZ"[:spatial]
+    dn = lax.conv_dimension_numbers(
+        x.shape, w.shape, ("NC" + sp, "OI" + sp, "NC" + sp))
+    if isinstance(padding, str):
+        pads = padding.upper()
+    else:
+        pads = [(int(p), int(p)) for p in padding]
+    out = lax.conv_general_dilated(
+        x, w, window_strides=[int(s) for s in stride], padding=pads,
+        rhs_dilation=[int(d) for d in dilation], dimension_numbers=dn,
+        feature_group_count=groups)
+    if b is not None:
+        out = out + b.reshape((1, -1) + (1,) * spatial)
+    return out
+
+
+def _pool_nd(x, kernel, stride, padding, spatial, mode):
+    kernel = [int(k) for k in kernel]
+    stride = [int(s) for s in (stride or kernel)]
+    padding = [int(p) for p in padding]
+    window = (1, 1) + tuple(kernel)
+    strides = (1, 1) + tuple(stride)
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in padding)
+    if mode == "max":
+        return lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pads)
+    out = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+    return out / np.prod(kernel)
+
+
+class TorchFxConverter:
+    """Convert an fx-traceable ``nn.Module`` to (fn, params)."""
+
+    def __init__(self, module):
+        import torch.fx as fx
+        import torch.nn as tnn
+
+        self.tnn = tnn
+        self.module = module
+        try:
+            self.gm = fx.symbolic_trace(module)
+        except Exception as e:  # fx refuses dynamic control flow
+            raise UnsupportedTorchGraph(str(e)) from e
+        self.params: Dict[str, Any] = {}
+
+    # -- leaf module lowering -------------------------------------------
+    def _lower_module(self, path: str, mod) -> Callable:
+        tnn = self.tnn
+        p = path.replace(".", "_")
+
+        def param(name, tensor, train=True):
+            if tensor is None:
+                return None
+            key = f"{p}_{name}"
+            self.params[key] = jnp.asarray(_np(tensor))
+            return key
+
+        if isinstance(mod, tnn.Linear):
+            w, b = param("w", mod.weight), param("b", mod.bias)
+            return lambda P, x: (x @ P[w].T + (P[b] if b else 0.0))
+        if isinstance(mod, (tnn.Conv1d, tnn.Conv2d, tnn.Conv3d)):
+            spatial = {tnn.Conv1d: 1, tnn.Conv2d: 2, tnn.Conv3d: 3}[type(mod)]
+            w, b = param("w", mod.weight), param("b", mod.bias)
+            stride, pad, dil, groups = (mod.stride, mod.padding,
+                                        mod.dilation, mod.groups)
+            return lambda P, x: _conv_nd(
+                x, P[w], P[b] if b else None, stride, pad, dil, groups,
+                spatial)
+        if isinstance(mod, (tnn.BatchNorm1d, tnn.BatchNorm2d,
+                            tnn.BatchNorm3d)):
+            g = param("w", mod.weight)
+            b = param("b", mod.bias)
+            rm = param("rm", mod.running_mean)
+            rv = param("rv", mod.running_var)
+            eps = mod.eps
+
+            def bn(P, x):
+                shape = (1, -1) + (1,) * (x.ndim - 2)
+                inv = lax.rsqrt(P[rv].reshape(shape) + eps)
+                out = (x - P[rm].reshape(shape)) * inv
+                if g:
+                    out = out * P[g].reshape(shape)
+                if b:
+                    out = out + P[b].reshape(shape)
+                return out
+            return bn
+        if isinstance(mod, tnn.LayerNorm):
+            g = param("w", mod.weight)
+            b = param("b", mod.bias)
+            eps, nshape = mod.eps, tuple(mod.normalized_shape)
+
+            def ln(P, x):
+                axes = tuple(range(x.ndim - len(nshape), x.ndim))
+                mu = jnp.mean(x, axis=axes, keepdims=True)
+                var = jnp.var(x, axis=axes, keepdims=True)
+                out = (x - mu) * lax.rsqrt(var + eps)
+                if g:
+                    out = out * P[g]
+                if b:
+                    out = out + P[b]
+                return out
+            return ln
+        if isinstance(mod, tnn.Embedding):
+            w = param("w", mod.weight)
+            return lambda P, x: jnp.take(P[w], x.astype(jnp.int32), axis=0)
+        if isinstance(mod, (tnn.MaxPool1d, tnn.MaxPool2d, tnn.MaxPool3d,
+                            tnn.AvgPool1d, tnn.AvgPool2d, tnn.AvgPool3d)):
+            spatial = {"1d": 1, "2d": 2, "3d": 3}[type(mod).__name__[-2:]]
+            mode = "max" if "Max" in type(mod).__name__ else "avg"
+
+            def to_list(v):
+                return [v] * spatial if isinstance(v, int) else list(v)
+            kernel = to_list(mod.kernel_size)
+            stride = to_list(mod.stride) if mod.stride else kernel
+            padding = to_list(mod.padding)
+            return lambda P, x: _pool_nd(x, kernel, stride, padding,
+                                         spatial, mode)
+        if isinstance(mod, (tnn.AdaptiveAvgPool1d, tnn.AdaptiveAvgPool2d,
+                            tnn.AdaptiveAvgPool3d)):
+            out_size = mod.output_size
+            sizes = [out_size] if isinstance(out_size, int) else list(out_size)
+            if any(s not in (1, None) for s in sizes):
+                raise UnsupportedTorchGraph(
+                    f"AdaptiveAvgPool output_size {out_size}")
+            return lambda P, x: jnp.mean(
+                x, axis=tuple(range(2, x.ndim)), keepdims=True)
+        if isinstance(mod, tnn.Flatten):
+            start, end = mod.start_dim, mod.end_dim
+            return lambda P, x: _flatten_mid(x, start, end)
+        if isinstance(mod, tnn.Dropout):
+            return lambda P, x: x
+        if isinstance(mod, tnn.Identity):
+            return lambda P, x: x
+        simple = {
+            tnn.ReLU: jax.nn.relu, tnn.ReLU6: jax.nn.relu6,
+            tnn.GELU: jax.nn.gelu, tnn.SiLU: jax.nn.silu,
+            tnn.Sigmoid: jax.nn.sigmoid, tnn.Tanh: jnp.tanh,
+            tnn.Softplus: jax.nn.softplus, tnn.Mish: jax.nn.mish,
+            tnn.ELU: jax.nn.elu, tnn.Hardswish: jax.nn.hard_swish,
+        }
+        for klass, fn in simple.items():
+            if isinstance(mod, klass):
+                return lambda P, x, fn=fn: fn(x)
+        if isinstance(mod, tnn.LeakyReLU):
+            slope = mod.negative_slope
+            return lambda P, x: jax.nn.leaky_relu(x, slope)
+        if isinstance(mod, tnn.Softmax):
+            dim = mod.dim if mod.dim is not None else -1
+            return lambda P, x: jax.nn.softmax(x, axis=dim)
+        raise UnsupportedTorchGraph(f"module {type(mod).__name__} at {path}")
+
+    # -- function-call lowering -----------------------------------------
+    def _lower_function(self, target) -> Callable:
+        import torch
+        import torch.nn.functional as F
+
+        table = {
+            operator.add: jnp.add, operator.sub: jnp.subtract,
+            operator.mul: jnp.multiply, operator.truediv: jnp.divide,
+            operator.matmul: jnp.matmul, operator.neg: jnp.negative,
+            operator.getitem: lambda x, idx: x[idx],
+            torch.add: jnp.add, torch.sub: jnp.subtract,
+            torch.mul: jnp.multiply, torch.div: jnp.divide,
+            torch.matmul: jnp.matmul, torch.mm: jnp.matmul,
+            torch.bmm: jnp.matmul, torch.tanh: jnp.tanh,
+            torch.sigmoid: jax.nn.sigmoid, torch.relu: jax.nn.relu,
+            torch.exp: jnp.exp, torch.log: jnp.log, torch.abs: jnp.abs,
+            torch.sqrt: jnp.sqrt, torch.sin: jnp.sin, torch.cos: jnp.cos,
+            F.relu: jax.nn.relu, F.gelu: jax.nn.gelu,
+            F.silu: jax.nn.silu, F.sigmoid: jax.nn.sigmoid,
+            F.tanh: jnp.tanh, F.softplus: jax.nn.softplus,
+            F.leaky_relu: jax.nn.leaky_relu,
+            F.softmax: lambda x, dim=-1: jax.nn.softmax(x, axis=dim),
+            F.log_softmax: lambda x, dim=-1: jax.nn.log_softmax(x, axis=dim),
+            F.dropout: lambda x, *a, **k: x,
+            torch.flatten: lambda x, start_dim=0, end_dim=-1:
+                jnp.reshape(x, x.shape[:start_dim] + (-1,))
+                if end_dim in (-1, x.ndim - 1) else _flatten_mid(
+                    x, start_dim, end_dim),
+            torch.cat: lambda xs, dim=0: jnp.concatenate(xs, axis=dim),
+            torch.stack: lambda xs, dim=0: jnp.stack(xs, axis=dim),
+            torch.transpose: lambda x, a, b: jnp.swapaxes(x, a, b),
+            torch.permute: lambda x, dims: jnp.transpose(x, dims),
+            torch.mean: _torch_mean, torch.sum: _torch_sum,
+            torch.unsqueeze: lambda x, d: jnp.expand_dims(x, d),
+            torch.squeeze: lambda x, d=None: jnp.squeeze(x, d),
+            torch.pow: jnp.power, torch.erf: jax.scipy.special.erf,
+            torch.clamp: lambda x, min=None, max=None: jnp.clip(x, min, max),
+            torch.where: jnp.where, torch.maximum: jnp.maximum,
+            torch.minimum: jnp.minimum,
+            math.sqrt: math.sqrt,
+        }
+        if target in table:
+            return table[target]
+        raise UnsupportedTorchGraph(f"function {target}")
+
+    _METHOD_MAP = {
+        "view": lambda x, *shape: jnp.reshape(
+            x, shape[0] if len(shape) == 1 and isinstance(shape[0], tuple)
+            else shape),
+        "reshape": lambda x, *shape: jnp.reshape(
+            x, shape[0] if len(shape) == 1 and isinstance(shape[0], tuple)
+            else shape),
+        "permute": lambda x, *dims: jnp.transpose(
+            x, dims[0] if len(dims) == 1 and isinstance(dims[0], tuple)
+            else dims),
+        "transpose": lambda x, a, b: jnp.swapaxes(x, a, b),
+        "contiguous": lambda x: x,
+        "flatten": lambda x, start_dim=0: jnp.reshape(
+            x, x.shape[:start_dim] + (-1,)),
+        "size": lambda x, d=None: x.shape if d is None else x.shape[d],
+        "mean": _torch_mean, "sum": _torch_sum,
+        "squeeze": lambda x, d=None: jnp.squeeze(x, d),
+        "unsqueeze": lambda x, d: jnp.expand_dims(x, d),
+        "float": lambda x: x.astype(jnp.float32),
+        "t": lambda x: x.T,
+        "chunk": lambda x, n, dim=0: tuple(jnp.split(x, n, axis=dim)),
+        "split": lambda x, size, dim=0: tuple(
+            jnp.split(x, range(size, x.shape[dim], size), axis=dim)),
+        "softmax": lambda x, dim=-1: jax.nn.softmax(x, axis=dim),
+        "masked_fill": lambda x, mask, v: jnp.where(mask, v, x),
+        "expand": _torch_expand,
+        "pow": jnp.power,
+        "clamp": lambda x, min=None, max=None: jnp.clip(x, min, max),
+    }
+
+    # -- graph interpretation -------------------------------------------
+    def convert(self) -> Tuple[Callable, Dict[str, Any]]:
+        modules = dict(self.gm.named_modules())
+        lowered: Dict[str, Callable] = {}
+        for node in self.gm.graph.nodes:
+            if node.op == "call_module":
+                lowered[node.target] = self._lower_module(
+                    node.target, modules[node.target])
+        # free parameters referenced via get_attr
+        attr_keys: Dict[str, str] = {}
+        for node in self.gm.graph.nodes:
+            if node.op == "get_attr":
+                t = self.gm
+                for part in node.target.split("."):
+                    t = getattr(t, part)
+                key = node.target.replace(".", "_")
+                self.params[key] = jnp.asarray(_np(t))
+                attr_keys[node.target] = key
+
+        graph = self.gm.graph
+        fn_table = {n.name: self._lower_function(n.target)
+                    for n in graph.nodes if n.op == "call_function"}
+
+        def run(P, *args):
+            env: Dict[str, Any] = {}
+
+            def lookup(v):
+                import torch.fx as fx
+                if isinstance(v, fx.Node):
+                    return env[v.name]
+                if isinstance(v, (list, tuple)):
+                    return type(v)(lookup(x) for x in v)
+                if isinstance(v, dict):
+                    return {k: lookup(x) for k, x in v.items()}
+                return v
+
+            placeholder_idx = 0
+            for node in graph.nodes:
+                if node.op == "placeholder":
+                    env[node.name] = args[placeholder_idx]
+                    placeholder_idx += 1
+                elif node.op == "get_attr":
+                    env[node.name] = P[attr_keys[node.target]]
+                elif node.op == "call_module":
+                    x = lookup(node.args[0])
+                    env[node.name] = lowered[node.target](P, x)
+                elif node.op == "call_function":
+                    a = lookup(node.args)
+                    kw = lookup(dict(node.kwargs))
+                    env[node.name] = fn_table[node.name](*a, **kw)
+                elif node.op == "call_method":
+                    a = lookup(node.args)
+                    kw = lookup(dict(node.kwargs))
+                    try:
+                        fn = self._METHOD_MAP[node.target]
+                    except KeyError:
+                        raise UnsupportedTorchGraph(
+                            f"method .{node.target}()") from None
+                    env[node.name] = fn(*a, **kw)
+                elif node.op == "output":
+                    return lookup(node.args[0])
+            raise UnsupportedTorchGraph("graph has no output node")
+
+        return run, dict(self.params)

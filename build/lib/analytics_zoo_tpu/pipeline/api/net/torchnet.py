@@ -1,0 +1,314 @@
+"""TorchNet / TorchCriterion — PyTorch modules inside the TPU framework.
+
+Parity: ``zoo/.../pipeline/api/net/TorchNet.scala:39`` + ``TorchCriterion``
++ ``pyzoo/zoo/pipeline/api/net/torch_net.py:46`` (``TorchNet.from_pytorch``),
+which run TorchScript through a JNI CPU runtime with native
+forward/backward/getGradient/updateWeight calls.
+
+TPU-native redesign, two tiers:
+
+1. **Lowering (primary).** ``torch.fx`` traces the module and
+   ``torch_fx.TorchFxConverter`` maps it onto jax ops with the state_dict as
+   a trainable pytree — the module becomes part of the XLA program, runs on
+   the MXU, shards like any other layer. No torch at execution time.
+2. **Host callback (fallback).** Mirrors the reference's JNI design: forward
+   runs the real torch module on the host CPU via ``jax.pure_callback``; a
+   ``jax.custom_vjp`` backward callback runs ``torch.autograd.grad`` w.r.t.
+   both inputs and parameters, so the module is *still trainable* from the
+   jax side — gradients flow into the same SPMD update/psum machinery.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..keras.engine.base import KerasLayer
+from .torch_fx import TorchFxConverter, UnsupportedTorchGraph
+
+
+def _to_numpy_tree(params):
+    return jax.tree_util.tree_map(lambda a: np.asarray(a), params)
+
+
+class _CallbackRunner:
+    """Host-side torch executor shared by forward/backward callbacks."""
+
+    def __init__(self, module):
+        import torch
+
+        self.torch = torch
+        self.module = module.eval()
+        self.param_names = [n for n, _ in module.named_parameters()]
+
+    def numpy_params(self) -> Dict[str, np.ndarray]:
+        return {n.replace(".", "_"): p.detach().cpu().numpy()
+                for n, p in self.module.named_parameters()}
+
+    def _load(self, flat_params: List[np.ndarray]):
+        torch = self.torch
+        with torch.no_grad():
+            for name, arr in zip(self.param_names, flat_params):
+                obj = self.module
+                *path, leaf = name.split(".")
+                for part in path:
+                    obj = getattr(obj, part)
+                getattr(obj, leaf).copy_(
+                    torch.from_numpy(np.array(arr, copy=True)))
+
+    def forward(self, flat_params, xs):
+        torch = self.torch
+        self._load(flat_params)
+        tensors = [torch.from_numpy(np.ascontiguousarray(x)) for x in xs]
+        with torch.no_grad():
+            out = self.module(*tensors)
+        return [o.detach().cpu().numpy().astype(np.float32)
+                for o in (out if isinstance(out, (list, tuple)) else [out])]
+
+    def backward(self, flat_params, xs, gs):
+        torch = self.torch
+        self._load(flat_params)
+        tensors = [torch.from_numpy(np.ascontiguousarray(x))
+                   .requires_grad_(np.issubdtype(x.dtype, np.floating))
+                   for x in xs]
+        params = list(self.module.parameters())
+        out = self.module(*tensors)
+        outs = list(out) if isinstance(out, (list, tuple)) else [out]
+        grads_out = [torch.from_numpy(np.ascontiguousarray(g))
+                     for g in gs]
+        leaves = [t for t in tensors if t.requires_grad] + params
+        grads = torch.autograd.grad(outs, leaves, grads_out,
+                                    allow_unused=True)
+        grads = list(grads)
+        gx = []
+        for t, x in zip(tensors, xs):
+            if t.requires_grad:
+                g = grads.pop(0)
+                gx.append(np.zeros_like(x) if g is None
+                          else g.cpu().numpy().astype(x.dtype))
+            else:
+                gx.append(np.zeros_like(x))
+        gp = [np.zeros(p.shape, np.float32) if g is None
+              else g.cpu().numpy().astype(np.float32)
+              for p, g in zip(params, grads)]
+        return gx + gp
+
+
+class TorchNet(KerasLayer):
+    """A PyTorch ``nn.Module`` as a zoo layer / inference model."""
+
+    def __init__(self, module=None, lower: bool = True,
+                 name: Optional[str] = None, **kwargs):
+        super().__init__(name=name, **kwargs)
+        self.module = module
+        self.mode = None
+        self._fn: Optional[Callable] = None
+        self._imported: Dict[str, Any] = {}
+        if module is not None:
+            self._build_backend(lower)
+
+    # -- construction ---------------------------------------------------
+    @classmethod
+    def from_pytorch(cls, module, lossFunc=None, lower: bool = True, **kw):
+        """Reference factory (torch_net.py:46). ``lossFunc`` kept for
+        signature parity; wrap it with :class:`TorchCriterion` instead."""
+        net = cls(module, lower=lower)
+        if lossFunc is not None:
+            net.criterion = TorchCriterion.from_loss_fn(lossFunc)
+        return net
+
+    def _build_backend(self, lower: bool):
+        if lower:
+            try:
+                fn, params = TorchFxConverter(self.module).convert()
+                self.mode = "jax"
+                self._fn = fn
+                self._imported = params
+                return
+            except UnsupportedTorchGraph:
+                pass
+        self.mode = "callback"
+        self._runner = _CallbackRunner(self.module)
+        self._imported = {k: jnp.asarray(v)
+                          for k, v in self._runner.numpy_params().items()}
+        self._fn = self._make_callback_fn()
+
+    def _make_callback_fn(self):
+        runner = self._runner
+        shape_cache: Dict[Any, Any] = {}
+
+        def result_shapes(xs):
+            key = tuple((tuple(np.shape(x)), str(_dt(x))) for x in xs)
+            if key not in shape_cache:
+                shape_cache[key] = _torch_result_shapes(runner, xs)
+            return shape_cache[key]
+
+        @functools.partial(jax.custom_vjp, nondiff_argnums=())
+        def apply(flat_params, xs):
+            shapes = result_shapes(xs)
+            out = jax.pure_callback(
+                lambda p, x: tuple(runner.forward(list(p), list(x))),
+                tuple(shapes), tuple(flat_params), tuple(xs),
+                vmap_method="sequential")
+            return tuple(out)
+
+        def fwd(flat_params, xs):
+            return apply(flat_params, xs), (flat_params, xs)
+
+        def bwd(res, gs):
+            flat_params, xs = res
+            # callbacks can't emit float0; fetch float32 grads for all
+            # inputs, then swap integer-primal slots to float0 zeros
+            shapes = [jax.ShapeDtypeStruct(np.shape(x), np.float32)
+                      for x in xs] + \
+                     [jax.ShapeDtypeStruct(np.shape(p), np.float32)
+                      for p in flat_params]
+            out = jax.pure_callback(
+                lambda p, x, g: tuple(
+                    np.asarray(a, np.float32) for a in
+                    runner.backward(list(p), list(x), list(g))),
+                tuple(shapes), tuple(flat_params), tuple(xs), tuple(gs),
+                vmap_method="sequential")
+            n_x = len(xs)
+            gx = tuple(
+                _zero_cotangent(x) if _is_int(x) else g.astype(_dt(x))
+                for x, g in zip(xs, out[:n_x]))
+            gp = out[n_x:]
+            return tuple(gp), gx
+
+        apply.defvjp(fwd, bwd)
+        # flat param order MUST match named_parameters(): forward's _load and
+        # backward's grad list both use that order.
+        param_keys = [n.replace(".", "_") for n in runner.param_names]
+
+        def fn(P, *xs):
+            flat = tuple(P[k] for k in param_keys)
+            out = apply(flat, tuple(xs))
+            return out[0] if len(out) == 1 else out
+        return fn
+
+    # -- KerasLayer surface ----------------------------------------------
+    def build(self, rng, input_shape):
+        return dict(self._imported)
+
+    def call(self, params, inputs, training=False, **kwargs):
+        xs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        return self._fn(params, *xs)
+
+    def compute_output_shape(self, input_shape):
+        shapes = input_shape if isinstance(input_shape, list) \
+            else [input_shape]
+        xs = [np.zeros(tuple(2 if d is None else d for d in s), np.float32)
+              for s in shapes]
+        if self.mode == "callback":
+            outs = self._runner.forward(
+                [np.asarray(self._imported[n.replace(".", "_")])
+                 for n in self._runner.param_names], xs)
+        else:
+            outs = jax.eval_shape(
+                lambda P, xs: self._fn(P, *xs), self._imported, xs)
+            outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        result = [(None,) + tuple(np.shape(o)[1:]) for o in outs]
+        return result[0] if len(result) == 1 else result
+
+    # -- AbstractModel surface (InferenceModel queue) --------------------
+    def predict(self, inputs):
+        xs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        xs = [np.asarray(x) for x in xs]
+        out = self.call(self._imported, xs)
+        return jax.tree_util.tree_map(np.asarray, out)
+
+    def release(self):
+        pass
+
+
+def _dt(x):
+    return np.asarray(x).dtype if not hasattr(x, "dtype") else x.dtype
+
+
+def _is_int(x):
+    dt = _dt(x)
+    return np.issubdtype(dt, np.integer) or dt == np.bool_
+
+
+def _zero_cotangent(primal):
+    """Zero cotangent with the dtype custom_vjp demands: float0 for
+    integer/bool primals, zeros otherwise."""
+    dt = _dt(primal)
+    if np.issubdtype(dt, np.integer) or dt == np.bool_:
+        return np.zeros(np.shape(primal), jax.dtypes.float0)
+    return jnp.zeros(np.shape(primal), dt)
+
+
+def _torch_result_shapes(runner, xs):
+    probe = [np.zeros(np.shape(x), _dt(x)) for x in xs]
+    outs = runner.forward(
+        [p.detach().cpu().numpy() for p in runner.module.parameters()],
+        probe)
+    return [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs]
+
+
+class TorchCriterion:
+    """A torch loss as a zoo criterion (TorchCriterion.scala parity).
+
+    Callable as ``loss(y_true, y_pred)`` matching the framework's objective
+    signature; gradients flow to ``y_pred`` through a host callback into
+    ``torch.autograd``.
+    """
+
+    def __init__(self, loss_fn):
+        import torch
+
+        self.torch = torch
+        self.loss_fn = loss_fn
+
+        @jax.custom_vjp
+        def apply(y_true, y_pred):
+            return jax.pure_callback(
+                self._host_loss, jax.ShapeDtypeStruct((), np.float32),
+                y_true, y_pred, vmap_method="sequential")
+
+        def fwd(y_true, y_pred):
+            return apply(y_true, y_pred), (y_true, y_pred)
+
+        def bwd(res, g):
+            y_true, y_pred = res
+            gp = jax.pure_callback(
+                self._host_grad,
+                jax.ShapeDtypeStruct(np.shape(y_pred), np.float32),
+                y_true, y_pred, vmap_method="sequential")
+            return _zero_cotangent(y_true), g * gp
+
+        apply.defvjp(fwd, bwd)
+        self._apply = apply
+
+    @classmethod
+    def from_loss_fn(cls, loss_fn):
+        return cls(loss_fn)
+
+    @classmethod
+    def from_pytorch(cls, loss_fn):
+        return cls(loss_fn)
+
+    def _host_loss(self, y_true, y_pred):
+        torch = self.torch
+        t = torch.from_numpy(np.ascontiguousarray(y_true))
+        p = torch.from_numpy(np.ascontiguousarray(y_pred))
+        # torch criteria take (input, target)
+        return np.float32(self.loss_fn(p, t).item())
+
+    def _host_grad(self, y_true, y_pred):
+        torch = self.torch
+        t = torch.from_numpy(np.ascontiguousarray(y_true))
+        p = torch.from_numpy(
+            np.ascontiguousarray(y_pred)).requires_grad_(True)
+        loss = self.loss_fn(p, t)
+        loss.backward()
+        return p.grad.cpu().numpy().astype(np.float32)
+
+    def __call__(self, y_true, y_pred):
+        return self._apply(y_true, y_pred)

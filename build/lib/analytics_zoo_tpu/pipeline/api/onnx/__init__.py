@@ -1,0 +1,5 @@
+"""ONNX import (reference: ``pyzoo/zoo/pipeline/api/onnx``)."""
+
+from .onnx_loader import GraphModule, OnnxIR, OnnxLoader, load_onnx
+
+__all__ = ["OnnxLoader", "OnnxIR", "GraphModule", "load_onnx"]

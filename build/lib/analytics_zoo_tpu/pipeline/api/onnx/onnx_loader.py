@@ -1,0 +1,184 @@
+"""ONNX graph → zoo Keras ``Model``.
+
+Parity: ``pyzoo/zoo/pipeline/api/onnx/onnx_loader.py`` (``OnnxLoader``) +
+the 43-file mapper registry, which convert an ONNX graph into a zoo Keras
+model. Here the graph becomes a single :class:`GraphModule` layer — a pure
+jax interpreter over the node list — wrapped in a functional ``Model`` so it
+gets the full ``compile/fit/evaluate/predict`` surface and jits into one XLA
+program. Weight initializers import as *trainable* params (fine-tuning an
+imported graph works); shape-machinery initializers (Reshape targets, axes,
+pad amounts) are constant-folded out at trace time.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..keras.engine.base import Input, KerasLayer
+from ..keras.models import Model
+from . import proto
+from .ops import REGISTRY, STATIC_ARGS
+
+
+class OnnxIR:
+    """Decoded + classified ONNX graph."""
+
+    def __init__(self, model: proto.Msg):
+        self.model = model
+        graph = model["graph"]
+        self.graph = graph
+        self.nodes = list(graph.get("node", []))
+        self.initializers: Dict[str, np.ndarray] = {
+            t["name"]: proto.tensor_to_numpy(t)
+            for t in graph.get("initializer", [])}
+        self.input_infos = [vi for vi in graph.get("input", [])
+                            if vi["name"] not in self.initializers]
+        self.output_names = [vi["name"] for vi in graph.get("output", [])]
+
+        # names that must stay host constants (consumed at a static position)
+        static = set()
+        for node in self.nodes:
+            for idx in STATIC_ARGS.get(node.get("op_type", ""), ()):
+                ins = node.get("input", [])
+                if idx < len(ins) and ins[idx] in self.initializers:
+                    static.add(ins[idx])
+        # integer/bool initializers are shape machinery, never weights —
+        # they must stay host constants so downstream shape ops can fold.
+        for name, arr in self.initializers.items():
+            if not np.issubdtype(arr.dtype, np.floating):
+                static.add(name)
+        self.static_names = static
+        self.param_names = [n for n in self.initializers if n not in static]
+
+        unsupported = sorted({n.get("op_type", "?") for n in self.nodes
+                              if n.get("op_type") not in REGISTRY})
+        if unsupported:
+            raise NotImplementedError(
+                f"unsupported ONNX ops: {unsupported}")
+
+    def input_shapes(self) -> List[tuple]:
+        shapes = []
+        for vi in self.input_infos:
+            dims = vi["type"]["tensor_type"].get(
+                "shape", {}).get("dim", [])
+            shape = tuple(
+                None if ("dim_param" in d or "dim_value" not in d)
+                else int(d["dim_value"]) for d in dims)
+            shapes.append(shape)
+        return shapes
+
+    def input_dtypes(self) -> List[Any]:
+        return [proto.DTYPES.get(
+            vi["type"]["tensor_type"].get("elem_type", 1), np.float32)
+            for vi in self.input_infos]
+
+
+class GraphModule(KerasLayer):
+    """A whole foreign graph as one zoo layer (pure jax interpreter)."""
+
+    def __init__(self, ir: OnnxIR, name: Optional[str] = None, **kwargs):
+        super().__init__(name=name, **kwargs)
+        self.ir = ir
+        self.num_outputs = len(ir.output_names)
+
+    def build(self, rng, input_shape):
+        return {n: jnp.asarray(self.ir.initializers[n])
+                for n in self.ir.param_names}
+
+    def call(self, params, inputs, training=False, **kwargs):
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        ir = self.ir
+        env: Dict[str, Any] = {n: ir.initializers[n]
+                               for n in ir.static_names}
+        env.update(params)
+        for vi, x, dt in zip(ir.input_infos, inputs, ir.input_dtypes()):
+            if np.issubdtype(dt, np.integer) and not np.issubdtype(
+                    np.asarray(x).dtype if isinstance(x, np.ndarray)
+                    else x.dtype, np.integer):
+                x = x.astype(dt)
+            env[vi["name"]] = x
+        for node in ir.nodes:
+            op_type = node["op_type"]
+            attrs = {a["name"]: proto.attr_value(a)
+                     for a in node.get("attribute", [])}
+            ins = [env[n] if n else None for n in node.get("input", [])]
+            if all(v is None or isinstance(v, (np.ndarray, np.generic,
+                                               int, float))
+                   for v in ins):
+                # constant inputs: fold now (jnp would stage into the
+                # jaxpr under omnistaging, killing shape-arg concreteness)
+                import jax
+                with jax.ensure_compile_time_eval():
+                    outs = REGISTRY[op_type](attrs, ins)
+                outs = [np.asarray(o) for o in outs]
+            else:
+                outs = REGISTRY[op_type](attrs, ins)
+            for name, val in zip(node.get("output", []), outs):
+                if name:
+                    env[name] = val
+        results = [env[n] for n in ir.output_names]
+        return results[0] if self.num_outputs == 1 else tuple(results)
+
+    def compute_output_shape(self, input_shape):
+        import jax
+        shapes = input_shape if isinstance(input_shape, list) \
+            else [input_shape]
+        dtypes = self.ir.input_dtypes()
+        concrete = [jax.ShapeDtypeStruct(
+            tuple(1 if d is None else d for d in s), dt)
+            for s, dt in zip(shapes, dtypes)]
+        params = jax.eval_shape(
+            lambda: self.build(jax.random.PRNGKey(0), input_shape))
+        out = jax.eval_shape(
+            lambda p, xs: self.call(p, xs), params, concrete)
+        def unbatch(s):
+            return (None,) + tuple(s.shape[1:])
+        if self.num_outputs == 1:
+            return unbatch(out)
+        return [unbatch(o) for o in out]
+
+    # GraphModule serializes by re-encoding the onnx bytes
+    def get_config(self):
+        return {"onnx_bytes": proto.encode(self.model_dict())}
+
+    def model_dict(self):
+        return self.ir.model
+
+    @classmethod
+    def from_config(cls, config):
+        return cls(OnnxIR(proto.decode(config["onnx_bytes"])))
+
+
+class OnnxLoader:
+    """Reference API: ``OnnxLoader(model_proto).to_keras()`` /
+    ``OnnxLoader.from_path(path)`` (pyzoo onnx_loader.py)."""
+
+    def __init__(self, model: proto.Msg):
+        self.ir = OnnxIR(model)
+
+    @classmethod
+    def from_path(cls, path: str) -> Model:
+        with open(path, "rb") as f:
+            return cls.from_bytes(f.read())
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> Model:
+        return cls(proto.decode(data)).to_keras()
+
+    def to_keras(self) -> Model:
+        module = GraphModule(self.ir)
+        in_vars = [Input(shape=tuple(s[1:]) if len(s) > 1 else (1,),
+                         name=vi["name"])
+                   for s, vi in zip(self.ir.input_shapes(),
+                                    self.ir.input_infos)]
+        outs = module(in_vars if len(in_vars) > 1 else in_vars[0])
+        return Model(in_vars, list(outs) if isinstance(outs, tuple)
+                     else outs)
+
+
+def load_onnx(path: str) -> Model:
+    return OnnxLoader.from_path(path)

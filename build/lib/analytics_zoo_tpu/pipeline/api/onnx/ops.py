@@ -1,0 +1,569 @@
+"""ONNX operator registry → jax.
+
+Parity: the reference maps 43 ONNX ops onto zoo Keras layers via an
+``OperatorMapper`` registry (``pyzoo/zoo/pipeline/api/onnx/mapper/*``). Here
+each op lowers straight to ``jax.numpy``/``lax`` — XLA:TPU fuses and tiles
+them, so there is no layer object in between. The loader (onnx_loader.py)
+constant-folds any op whose inputs are all host constants, which is how
+shape-computation subgraphs (Shape→Gather→Concat→Reshape) disappear at
+trace time.
+
+Each impl has signature ``fn(attrs: dict, inputs: list) -> list``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+REGISTRY: Dict[str, Callable[[Dict[str, Any], List[Any]], List[Any]]] = {}
+
+# input positions that must be trace-time constants (shapes, axes, pads...)
+STATIC_ARGS: Dict[str, tuple] = {
+    "Reshape": (1,), "Expand": (1,), "Tile": (1,),
+    "Slice": (1, 2, 3, 4), "Pad": (1, 2), "ConstantOfShape": (0,),
+    "Unsqueeze": (1,), "Squeeze": (1,), "ReduceSum": (1,),
+    "ReduceMean": (1,), "ReduceMax": (1,), "ReduceMin": (1,),
+    "Split": (1,), "TopK": (1,), "Upsample": (1,), "Resize": (1, 2, 3),
+}
+
+
+def op(name):
+    def deco(fn):
+        REGISTRY[name] = fn
+        return fn
+    return deco
+
+
+def _ints(x):
+    return [int(v) for v in np.asarray(x).reshape(-1)]
+
+
+def _axis_list(attrs, inputs, idx=1):
+    if "axes" in attrs:
+        return list(attrs["axes"])
+    if len(inputs) > idx and inputs[idx] is not None:
+        return _ints(inputs[idx])
+    return None
+
+
+# -- elementwise -----------------------------------------------------------
+
+for _name, _fn in [
+    ("Add", jnp.add), ("Sub", jnp.subtract), ("Mul", jnp.multiply),
+    ("Div", jnp.divide), ("Pow", jnp.power), ("Max", jnp.maximum),
+    ("Min", jnp.minimum), ("Equal", jnp.equal), ("Greater", jnp.greater),
+    ("Less", jnp.less), ("And", jnp.logical_and), ("Or", jnp.logical_or),
+]:
+    def _bin(attrs, inputs, _fn=_fn):
+        out = _fn(inputs[0], inputs[1])
+        for extra in inputs[2:]:
+            out = _fn(out, extra)
+        return [out]
+    REGISTRY[_name] = _bin
+
+for _name, _fn in [
+    ("Abs", jnp.abs), ("Neg", jnp.negative), ("Exp", jnp.exp),
+    ("Log", jnp.log), ("Sqrt", jnp.sqrt), ("Floor", jnp.floor),
+    ("Ceil", jnp.ceil), ("Sin", jnp.sin), ("Cos", jnp.cos),
+    ("Tanh", jnp.tanh), ("Erf", jax.scipy.special.erf),
+    ("Sigmoid", jax.nn.sigmoid), ("Relu", jax.nn.relu),
+    ("Softplus", jax.nn.softplus), ("Sign", jnp.sign),
+    ("Not", jnp.logical_not), ("Reciprocal", lambda x: 1.0 / x),
+    ("Softsign", jax.nn.soft_sign), ("Identity", lambda x: x),
+]:
+    REGISTRY[_name] = (lambda attrs, inputs, _fn=_fn: [_fn(inputs[0])])
+
+
+@op("Sum")
+def _sum(attrs, inputs):
+    out = inputs[0]
+    for x in inputs[1:]:
+        out = jnp.add(out, x)
+    return [out]
+
+
+@op("Mean")
+def _mean(attrs, inputs):
+    return [sum(inputs[1:], inputs[0]) / len(inputs)]
+
+
+@op("Clip")
+def _clip(attrs, inputs):
+    lo = attrs.get("min", inputs[1] if len(inputs) > 1 else None)
+    hi = attrs.get("max", inputs[2] if len(inputs) > 2 else None)
+    return [jnp.clip(inputs[0], lo, hi)]
+
+
+@op("LeakyRelu")
+def _leaky(attrs, inputs):
+    return [jax.nn.leaky_relu(inputs[0], attrs.get("alpha", 0.01))]
+
+
+@op("Elu")
+def _elu(attrs, inputs):
+    return [jax.nn.elu(inputs[0], attrs.get("alpha", 1.0))]
+
+
+@op("Selu")
+def _selu(attrs, inputs):
+    return [jax.nn.selu(inputs[0])]
+
+
+@op("PRelu")
+def _prelu(attrs, inputs):
+    x, slope = inputs
+    return [jnp.where(x >= 0, x, slope * x)]
+
+
+@op("HardSigmoid")
+def _hard_sigmoid(attrs, inputs):
+    a, b = attrs.get("alpha", 0.2), attrs.get("beta", 0.5)
+    return [jnp.clip(a * inputs[0] + b, 0.0, 1.0)]
+
+
+@op("Gelu")
+def _gelu(attrs, inputs):
+    approx = attrs.get("approximate", "none") == "tanh"
+    return [jax.nn.gelu(inputs[0], approximate=approx)]
+
+
+@op("Softmax")
+def _softmax(attrs, inputs):
+    return [jax.nn.softmax(inputs[0], axis=int(attrs.get("axis", -1)))]
+
+
+@op("LogSoftmax")
+def _log_softmax(attrs, inputs):
+    return [jax.nn.log_softmax(inputs[0], axis=int(attrs.get("axis", -1)))]
+
+
+@op("Cast")
+def _cast(attrs, inputs):
+    from .proto import DTYPES
+    return [inputs[0].astype(DTYPES[int(attrs["to"])])
+            if hasattr(inputs[0], "astype")
+            else jnp.asarray(inputs[0], DTYPES[int(attrs["to"])])]
+
+
+@op("Where")
+def _where(attrs, inputs):
+    return [jnp.where(inputs[0], inputs[1], inputs[2])]
+
+
+# -- matmul / gemm ---------------------------------------------------------
+
+
+@op("MatMul")
+def _matmul(attrs, inputs):
+    return [jnp.matmul(inputs[0], inputs[1])]
+
+
+@op("Gemm")
+def _gemm(attrs, inputs):
+    a, b = inputs[0], inputs[1]
+    if attrs.get("transA", 0):
+        a = jnp.swapaxes(a, -1, -2)
+    if attrs.get("transB", 0):
+        b = jnp.swapaxes(b, -1, -2)
+    out = attrs.get("alpha", 1.0) * jnp.matmul(a, b)
+    if len(inputs) > 2 and inputs[2] is not None:
+        out = out + attrs.get("beta", 1.0) * inputs[2]
+    return [out]
+
+
+# -- conv / pool (ONNX is NCHW; lowered directly, XLA relayouts for TPU) ---
+
+
+def _conv_pads(attrs, spatial, kernel, strides, dilations, in_sizes):
+    auto = attrs.get("auto_pad", "NOTSET")
+    if auto in ("SAME_UPPER", "SAME_LOWER"):
+        pads = []
+        for i in range(spatial):
+            eff = (kernel[i] - 1) * dilations[i] + 1
+            out = -(-in_sizes[i] // strides[i])  # ceil div
+            total = max((out - 1) * strides[i] + eff - in_sizes[i], 0)
+            lo = total // 2
+            hi = total - lo
+            pads.append((hi, lo) if auto == "SAME_LOWER" else (lo, hi))
+        return pads
+    p = attrs.get("pads", [0] * (2 * spatial))
+    return [(int(p[i]), int(p[i + spatial])) for i in range(spatial)]
+
+
+def _conv_dn(x, w, spatial):
+    sp = "XYZ"[:spatial]
+    return lax.conv_dimension_numbers(
+        x.shape, w.shape, ("NC" + sp, "OI" + sp, "NC" + sp))
+
+
+@op("Conv")
+def _conv(attrs, inputs):
+    x, w = inputs[0], inputs[1]
+    spatial = x.ndim - 2
+    kernel = attrs.get("kernel_shape", list(w.shape[2:]))
+    strides = [int(s) for s in attrs.get("strides", [1] * spatial)]
+    dil = [int(d) for d in attrs.get("dilations", [1] * spatial)]
+    groups = int(attrs.get("group", 1))
+    pads = _conv_pads(attrs, spatial, kernel, strides, dil, x.shape[2:])
+    dn = _conv_dn(x, w, spatial)
+    out = lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=pads, rhs_dilation=dil,
+        dimension_numbers=dn, feature_group_count=groups)
+    if len(inputs) > 2 and inputs[2] is not None:
+        out = out + inputs[2].reshape((1, -1) + (1,) * spatial)
+    return [out]
+
+
+@op("ConvTranspose")
+def _conv_transpose(attrs, inputs):
+    x, w = inputs[0], inputs[1]
+    spatial = x.ndim - 2
+    strides = [int(s) for s in attrs.get("strides", [1] * spatial)]
+    kernel = attrs.get("kernel_shape", list(w.shape[2:]))
+    if "output_shape" in attrs:
+        raise NotImplementedError(
+            "ConvTranspose with explicit output_shape is not supported; "
+            "re-export with pads/output_padding instead")
+    out_pad = [int(v) for v in
+               attrs.get("output_padding", [0] * spatial)]
+    auto = attrs.get("auto_pad", "NOTSET")
+    if auto in ("SAME_UPPER", "SAME_LOWER"):
+        # deconv SAME: output = input * stride, total pad = eff - stride
+        pads = []
+        for i in range(spatial):
+            total = max(kernel[i] - strides[i], 0)
+            lo = total // 2
+            hi = total - lo
+            pads.append((hi, lo) if auto == "SAME_LOWER" else (lo, hi))
+    else:
+        p = attrs.get("pads", [0] * (2 * spatial))
+        pads = [(int(p[i]), int(p[i + spatial])) for i in range(spatial)]
+    # ONNX deconv kernel layout is (C_in, C_out, ...spatial) = IO + spatial
+    sp = "XYZ"[:spatial]
+    dims = ("NC" + sp, "IO" + sp, "NC" + sp)
+    # output_padding adds rows/cols on the high side only (ONNX spec)
+    out = lax.conv_transpose(
+        x, w, strides=strides,
+        padding=[(k - 1 - p[0], k - 1 - p[1] + op_)
+                 for k, p, op_ in zip(kernel, pads, out_pad)],
+        dimension_numbers=dims, transpose_kernel=True)
+    if len(inputs) > 2 and inputs[2] is not None:
+        out = out + inputs[2].reshape((1, -1) + (1,) * spatial)
+    return [out]
+
+
+def _pool(attrs, x, reducer, init, is_avg=False):
+    spatial = x.ndim - 2
+    kernel = [int(k) for k in attrs["kernel_shape"]]
+    strides = [int(s) for s in attrs.get("strides", [1] * spatial)]
+    pads = _conv_pads(attrs, spatial, kernel, strides, [1] * spatial,
+                      x.shape[2:])
+    window = (1, 1) + tuple(kernel)
+    strd = (1, 1) + tuple(strides)
+    pad = ((0, 0), (0, 0)) + tuple(pads)
+    out = lax.reduce_window(x, init, reducer, window, strd, pad)
+    if is_avg:
+        if attrs.get("count_include_pad", 0) or not any(
+                p != (0, 0) for p in pads):
+            out = out / np.prod(kernel)
+        else:
+            ones = jnp.ones(x.shape, x.dtype)
+            counts = lax.reduce_window(ones, 0.0, lax.add, window, strd, pad)
+            out = out / counts
+    return out
+
+
+@op("MaxPool")
+def _maxpool(attrs, inputs):
+    return [_pool(attrs, inputs[0], lax.max, -jnp.inf)]
+
+
+@op("AveragePool")
+def _avgpool(attrs, inputs):
+    return [_pool(attrs, inputs[0], lax.add, 0.0, is_avg=True)]
+
+
+@op("GlobalAveragePool")
+def _gap(attrs, inputs):
+    x = inputs[0]
+    return [jnp.mean(x, axis=tuple(range(2, x.ndim)), keepdims=True)]
+
+
+@op("GlobalMaxPool")
+def _gmp(attrs, inputs):
+    x = inputs[0]
+    return [jnp.max(x, axis=tuple(range(2, x.ndim)), keepdims=True)]
+
+
+@op("BatchNormalization")
+def _bn(attrs, inputs):
+    x, scale, bias, mean, var = inputs[:5]
+    eps = attrs.get("epsilon", 1e-5)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    inv = lax.rsqrt(var + eps)
+    return [(x - mean.reshape(shape)) * (scale * inv).reshape(shape)
+            + bias.reshape(shape)]
+
+
+@op("InstanceNormalization")
+def _instancenorm(attrs, inputs):
+    x, scale, bias = inputs
+    eps = attrs.get("epsilon", 1e-5)
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return [(x - mean) * lax.rsqrt(var + eps) * scale.reshape(shape)
+            + bias.reshape(shape)]
+
+
+@op("LayerNormalization")
+def _layernorm(attrs, inputs):
+    x = inputs[0]
+    axis = int(attrs.get("axis", -1))
+    eps = attrs.get("epsilon", 1e-5)
+    mean = jnp.mean(x, axis=axis, keepdims=True)
+    var = jnp.var(x, axis=axis, keepdims=True)
+    out = (x - mean) * lax.rsqrt(var + eps)
+    if len(inputs) > 1:
+        out = out * inputs[1]
+    if len(inputs) > 2:
+        out = out + inputs[2]
+    return [out]
+
+
+@op("LRN")
+def _lrn(attrs, inputs):
+    x = inputs[0]
+    size = int(attrs["size"])
+    alpha = attrs.get("alpha", 1e-4)
+    beta = attrs.get("beta", 0.75)
+    bias = attrs.get("bias", 1.0)
+    half = (size - 1) // 2  # ONNX: floor((size-1)/2) before, rest after
+    sq = x * x
+    pads = ((0, 0), (half, size - 1 - half)) + ((0, 0),) * (x.ndim - 2)
+    window = (1, size) + (1,) * (x.ndim - 2)
+    acc = lax.reduce_window(sq, 0.0, lax.add, window,
+                            (1,) * x.ndim, pads)
+    return [x / jnp.power(bias + alpha / size * acc, beta)]
+
+
+@op("Dropout")
+def _dropout(attrs, inputs):
+    # inference semantics (the trainer re-wires training-mode dropout)
+    return [inputs[0]]
+
+
+# -- shape ops -------------------------------------------------------------
+
+
+@op("Shape")
+def _shape(attrs, inputs):
+    return [np.asarray(inputs[0].shape, np.int64)]
+
+
+@op("Size")
+def _size(attrs, inputs):
+    return [np.asarray(int(np.prod(inputs[0].shape)), np.int64)]
+
+
+@op("Reshape")
+def _reshape(attrs, inputs):
+    x = inputs[0]
+    target = attrs.get("shape") or _ints(inputs[1])
+    shape = [x.shape[i] if d == 0 and attrs.get("allowzero", 0) == 0 else d
+             for i, d in enumerate(target)]
+    return [jnp.reshape(x, shape)]
+
+
+@op("Flatten")
+def _flatten(attrs, inputs):
+    x = inputs[0]
+    axis = int(attrs.get("axis", 1))
+    lead = int(np.prod(x.shape[:axis])) if axis else 1
+    return [jnp.reshape(x, (lead, -1))]
+
+
+@op("Transpose")
+def _transpose(attrs, inputs):
+    perm = attrs.get("perm")
+    return [jnp.transpose(inputs[0], perm)]
+
+
+@op("Concat")
+def _concat(attrs, inputs):
+    return [jnp.concatenate(inputs, axis=int(attrs.get("axis", 0)))]
+
+
+@op("Split")
+def _split(attrs, inputs):
+    x = inputs[0]
+    axis = int(attrs.get("axis", 0))
+    splits = attrs.get("split") or (
+        _ints(inputs[1]) if len(inputs) > 1 else None)
+    if splits:
+        points = np.cumsum(splits)[:-1]
+        return list(jnp.split(x, points, axis=axis))
+    num = int(attrs.get("num_outputs", 2))
+    return list(jnp.split(x, num, axis=axis))
+
+
+@op("Squeeze")
+def _squeeze(attrs, inputs):
+    axes = _axis_list(attrs, inputs)
+    return [jnp.squeeze(inputs[0], axis=tuple(axes) if axes else None)]
+
+
+@op("Unsqueeze")
+def _unsqueeze(attrs, inputs):
+    x = inputs[0]
+    for ax in sorted(_axis_list(attrs, inputs)):
+        x = jnp.expand_dims(x, int(ax))
+    return [x]
+
+
+@op("Expand")
+def _expand(attrs, inputs):
+    target = _ints(inputs[1])
+    x = inputs[0]
+    # ONNX Expand = bidirectional broadcast
+    shape = list(np.broadcast_shapes(tuple(x.shape), tuple(target)))
+    return [jnp.broadcast_to(x, shape)]
+
+
+@op("Tile")
+def _tile(attrs, inputs):
+    return [jnp.tile(inputs[0], _ints(inputs[1]))]
+
+
+@op("Gather")
+def _gather(attrs, inputs):
+    axis = int(attrs.get("axis", 0))
+    idx = inputs[1]
+    if isinstance(idx, np.ndarray):
+        idx = idx.astype(np.int64)
+    return [jnp.take(inputs[0], idx, axis=axis)]
+
+
+@op("GatherElements")
+def _gather_elems(attrs, inputs):
+    axis = int(attrs.get("axis", 0))
+    return [jnp.take_along_axis(inputs[0],
+                                jnp.asarray(inputs[1], jnp.int32), axis)]
+
+
+@op("Slice")
+def _slice(attrs, inputs):
+    x = inputs[0]
+    if "starts" in attrs:  # opset-1 style
+        starts, ends = attrs["starts"], attrs["ends"]
+        axes = attrs.get("axes", list(range(len(starts))))
+        steps = [1] * len(starts)
+    else:
+        starts, ends = _ints(inputs[1]), _ints(inputs[2])
+        axes = _ints(inputs[3]) if len(inputs) > 3 and inputs[3] is not None \
+            else list(range(len(starts)))
+        steps = _ints(inputs[4]) if len(inputs) > 4 and inputs[4] is not None \
+            else [1] * len(starts)
+    slices = [slice(None)] * x.ndim
+    for st, en, ax, sp in zip(starts, ends, axes, steps):
+        dim = x.shape[ax]
+        en = min(en, dim) if en >= 0 else en
+        slices[ax] = slice(st, en, sp)
+    return [x[tuple(slices)]]
+
+
+@op("Pad")
+def _pad(attrs, inputs):
+    x = inputs[0]
+    pads = attrs.get("pads") or _ints(inputs[1])
+    mode = attrs.get("mode", "constant")
+    value = attrs.get("value", 0.0)
+    if len(inputs) > 2 and inputs[2] is not None:
+        value = float(np.asarray(inputs[2]))
+    n = x.ndim
+    widths = [(int(pads[i]), int(pads[i + n])) for i in range(n)]
+    if mode == "constant":
+        return [jnp.pad(x, widths, constant_values=value)]
+    return [jnp.pad(x, widths, mode={"reflect": "reflect",
+                                     "edge": "edge"}[mode])]
+
+
+@op("Constant")
+def _constant(attrs, inputs):
+    for key in ("value", "value_float", "value_int", "value_floats",
+                "value_ints"):
+        if key in attrs:
+            return [np.asarray(attrs[key])]
+    raise ValueError("Constant node without value")
+
+
+@op("ConstantOfShape")
+def _constant_of_shape(attrs, inputs):
+    shape = _ints(inputs[0])
+    val = attrs.get("value", np.zeros(1, np.float32))
+    val = np.asarray(val).reshape(-1)[0]
+    return [np.full(shape, val, dtype=np.asarray(val).dtype)]
+
+
+@op("Range")
+def _range(attrs, inputs):
+    start, limit, delta = (np.asarray(v).item() for v in inputs)
+    return [np.arange(start, limit, delta)]
+
+
+# -- reductions ------------------------------------------------------------
+
+
+def _reduce(fn):
+    def impl(attrs, inputs):
+        axes = _axis_list(attrs, inputs)
+        keep = bool(attrs.get("keepdims", 1))
+        return [fn(inputs[0], axis=tuple(axes) if axes else None,
+                   keepdims=keep)]
+    return impl
+
+
+REGISTRY["ReduceSum"] = _reduce(jnp.sum)
+REGISTRY["ReduceMean"] = _reduce(jnp.mean)
+REGISTRY["ReduceMax"] = _reduce(jnp.max)
+REGISTRY["ReduceMin"] = _reduce(jnp.min)
+REGISTRY["ReduceProd"] = _reduce(jnp.prod)
+REGISTRY["ReduceL2"] = _reduce(
+    lambda x, axis, keepdims: jnp.sqrt(jnp.sum(x * x, axis=axis,
+                                               keepdims=keepdims)))
+
+
+@op("ArgMax")
+def _argmax(attrs, inputs):
+    axis = int(attrs.get("axis", 0))
+    keep = bool(attrs.get("keepdims", 1))
+    out = jnp.argmax(inputs[0], axis=axis)
+    return [jnp.expand_dims(out, axis) if keep else out]
+
+
+@op("ArgMin")
+def _argmin(attrs, inputs):
+    axis = int(attrs.get("axis", 0))
+    keep = bool(attrs.get("keepdims", 1))
+    out = jnp.argmin(inputs[0], axis=axis)
+    return [jnp.expand_dims(out, axis) if keep else out]
+
+
+@op("TopK")
+def _topk(attrs, inputs):
+    k = int(attrs.get("k", _ints(inputs[1])[0] if len(inputs) > 1 else 1))
+    axis = int(attrs.get("axis", -1))
+    largest = int(attrs.get("largest", 1))
+    x = inputs[0]
+    moved = jnp.moveaxis(x, axis, -1)
+    vals, idx = lax.top_k(-moved if not largest else moved, k)
+    if not largest:
+        vals = -vals
+    return [jnp.moveaxis(vals, -1, axis),
+            jnp.moveaxis(idx, -1, axis).astype(jnp.int64)]

@@ -1,0 +1,7 @@
+"""Parity module path: ``zoo.pipeline.estimator``."""
+
+from .estimator import AbstractEstimator, Estimator, MultiOptimizer
+from .local_estimator import LocalEstimator
+
+__all__ = ["AbstractEstimator", "Estimator", "LocalEstimator",
+           "MultiOptimizer"]

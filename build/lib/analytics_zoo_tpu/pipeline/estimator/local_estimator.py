@@ -1,0 +1,74 @@
+"""LocalEstimator: single-host training without the mesh context.
+
+Parity: ``zoo/.../pipeline/estimator/LocalEstimator.scala:39-260`` — the
+reference's dev-mode trainer that runs its own SGD loop over in-memory
+MiniBatch seqs with a thread pool per core.  On TPU there is no host-thread
+replica concept: the "local" path is simply the same jitted step on however
+many local devices exist, so this class is a convenience wrapper that
+accepts raw arrays and runs epochs eagerly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ...common.zoo_trigger import MaxEpoch
+from ...feature.feature_set import ArrayFeatureSet
+from ..api.keras.metrics import get_metric
+from ..api.keras.objectives import get_loss
+from ..api.keras.optimizers import get_optimizer
+from ..engine import SPMDTrainer
+
+
+class LocalEstimator:
+    """``LocalEstimator(model, criterion, validation_methods, optim_method,
+    thread_num)`` — thread_num is accepted for parity and ignored (XLA owns
+    host threading)."""
+
+    def __init__(self, model, criterion, validation_methods=None,
+                 optim_method="sgd", thread_num: Optional[int] = None):
+        self.model = model
+        self.criterion = get_loss(criterion)
+        self.validation_methods = [get_metric(m, self.criterion)
+                                   for m in (validation_methods or [])]
+        self.optim_method = get_optimizer(optim_method)
+        self.thread_num = thread_num
+        graph = model.graph_function()
+
+        def apply_fn(params, inputs, state, training, rng):
+            return graph.apply(params, inputs, state=state, training=training,
+                               rng=rng, collect_state=True)
+
+        self.trainer = SPMDTrainer(apply_fn, graph.init, self.criterion,
+                                   self.optim_method,
+                                   metrics=self.validation_methods)
+        if getattr(model, "_built_params", None) is not None:
+            self.trainer.set_params(*model._built_params)
+
+    def fit(self, train_data, train_labels=None, validation_data=None,
+            validation_labels=None, epoch: int = 1, batch_size: int = 32):
+        """Parity: LocalEstimator.fit (LocalEstimator.scala:89-135)."""
+        train_set = train_data if not isinstance(
+            train_data, (np.ndarray, list, tuple)) else \
+            ArrayFeatureSet(train_data, train_labels)
+        val_set = None
+        if validation_data is not None:
+            val_set = validation_data if not isinstance(
+                validation_data, (np.ndarray, list, tuple)) else \
+                ArrayFeatureSet(validation_data, validation_labels)
+        self.trainer.train(train_set, batch_size=batch_size,
+                           end_trigger=MaxEpoch(self.trainer.epoch + epoch),
+                           validation_set=val_set)
+        self.model._built_params = (self.trainer.params,
+                                    self.trainer.net_state)
+        return self
+
+    def validate(self, data, labels=None, batch_size: int = 32):
+        dset = data if not isinstance(data, (np.ndarray, list, tuple)) else \
+            ArrayFeatureSet(data, labels)
+        return self.trainer.evaluate(dset, batch_size=batch_size)
+
+    def predict(self, data, batch_size: int = 128):
+        return self.trainer.predict(data, batch_size=batch_size)

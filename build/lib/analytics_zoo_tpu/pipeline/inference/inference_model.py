@@ -1,0 +1,303 @@
+"""InferenceModel: multi-backend, thread-safe inference holder.
+
+Parity: ``zoo/.../pipeline/inference/InferenceModel.scala:30`` — a blocking
+``LinkedBlockingQueue[AbstractModel]`` of model copies (queue :67), loaders
+``doLoad*`` :80-442 (BigDL / Caffe / TF frozen graph / TF saved model /
+PyTorch / OpenVINO incl. int8 calibration), ``doPredict`` :622-656, and the
+autoscaling ``retrieveModel`` :710; python mirror
+``pyzoo/zoo/pipeline/inference/inference_model.py:23``.
+
+TPU redesign:
+- a backend is a function ``inputs -> outputs`` AOT-compiled by XLA per
+  input signature (``jax.jit(...).lower(...).compile()``) — the OpenVINO /
+  libtensorflow / PyTorch JNI runtimes all collapse into the XLA runtime;
+- jitted executables and jax arrays are immutable and thread-safe, so
+  "model copies" become concurrency *permits*: the blocking queue holds
+  tokens bounding in-flight predicts, with the same autoscale-on-demand
+  behavior, while weights are shared (no per-copy duplication in HBM);
+- int8 arrives as weight-only quantization of matmul/conv kernels
+  (per-output-channel scales, dequantized in the kernel) instead of the
+  OpenVINO calibration subprocess — see :class:`QuantizedModel`;
+- foreign formats (TF saved model / TorchScript) load through the interop
+  importers in ``pipeline.api.net`` and then compile like any native model.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class AbstractModel:
+    """One loaded backend: ``predict(inputs) -> outputs`` on host numpy."""
+
+    def predict(self, inputs):
+        raise NotImplementedError
+
+    def release(self):
+        pass
+
+
+class FloatModel(AbstractModel):
+    """A native zoo model (KerasNet or any object exposing
+    ``graph_function`` + built params) compiled per input signature.
+
+    Parity: ``FloatModel`` (InferenceModelFactory path for BigDL models).
+    """
+
+    def __init__(self, model, compute_dtype: Optional[str] = None):
+        self.model = model
+        self.compute_dtype = compute_dtype
+        graph = model.graph_function()
+        params, state = model._params_tuple() \
+            if hasattr(model, "_params_tuple") \
+            else getattr(model, "_built_params")
+        self._params = params
+        self._state = state
+
+        def fwd(params, state, *inputs):
+            params = _dequantize(params)  # no-op for float trees; XLA
+            # fuses the int8->f32 upcast into consumers for quantized ones
+            out, _ = graph.apply(params, list(inputs), state=state,
+                                 training=False, rng=None,
+                                 collect_state=True)
+            return out
+
+        self._fwd = fwd
+        self._compiled: Dict[Tuple, Any] = {}
+        self._lock = threading.Lock()
+
+    def _signature(self, inputs):
+        return tuple((tuple(x.shape), str(x.dtype)) for x in inputs)
+
+    def predict(self, inputs):
+        inputs = [np.asarray(x) for x in (
+            inputs if isinstance(inputs, (list, tuple)) else [inputs])]
+        sig = self._signature(inputs)
+        fn = self._compiled.get(sig)
+        if fn is None:
+            with self._lock:
+                fn = self._compiled.get(sig)
+                if fn is None:
+                    # AOT compile for this signature (XLA serving
+                    # executable; replaces the OpenVINO IR compile step)
+                    fn = jax.jit(self._fwd).lower(
+                        self._params, self._state, *inputs).compile()
+                    self._compiled[sig] = fn
+        out = fn(self._params, self._state, *inputs)
+        return jax.tree.map(np.asarray, out)
+
+
+class QuantizedModel(FloatModel):
+    """Weight-only int8 PTQ: kernels of matmul-bearing params are stored as
+    int8 with per-output-channel scales and dequantized inside the compiled
+    program.  Replaces the reference's OpenVINO int8 calibration pipeline
+    (OpenVinoInferenceSupportive.scala:151-343) with an XLA-native path:
+    ~4x smaller weights (HBM-bandwidth-bound serving speedup), no
+    calibration data needed for weight-only mode.
+    """
+
+    #: param leaf names treated as quantizable 2D+ kernels
+    KERNEL_KEYS = ("kernel", "w", "qkv_w", "proj_w", "embedding")
+
+    def __init__(self, model, compute_dtype=None):
+        super().__init__(model, compute_dtype)
+        self._params = self._quantize_tree(self._params)
+
+    @classmethod
+    def _quantize_tree(cls, params):
+        def quant(path, leaf):
+            name = str(path[-1].key) if path and hasattr(path[-1], "key") \
+                else ""
+            if leaf.ndim >= 2 and any(k in name.lower()
+                                      for k in cls.KERNEL_KEYS):
+                scale = np.max(np.abs(leaf), axis=tuple(
+                    range(leaf.ndim - 1)), keepdims=True) / 127.0
+                scale = np.maximum(scale, 1e-12).astype(np.float32)
+                q = np.clip(np.round(np.asarray(leaf) / scale), -127,
+                            127).astype(np.int8)
+                return _QuantizedLeaf(q, scale)
+            return leaf
+
+        return jax.tree_util.tree_map_with_path(quant, params)
+
+
+@jax.tree_util.register_pytree_node_class
+class _QuantizedLeaf:
+    """int8 weights + f32 per-channel scale, dequantized inside the
+    compiled program (weights live in HBM as int8)."""
+
+    def __init__(self, q, scale):
+        self.q = q
+        self.scale = scale
+
+    def dequantize(self):
+        return jnp.asarray(self.q, jnp.float32) * self.scale
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def _dequantize(params):
+    return jax.tree.map(
+        lambda p: p.dequantize() if isinstance(p, _QuantizedLeaf) else p,
+        params, is_leaf=lambda p: isinstance(p, _QuantizedLeaf))
+
+
+class InferenceModel:
+    """Thread-safe inference holder with bounded concurrency + autoscale.
+
+    ``supported_concurrent_num``: number of concurrent predicts admitted
+    (the reference's model-copy count, InferenceModel.scala:30,67).
+    """
+
+    def __init__(self, supported_concurrent_num: int = 1):
+        self.supported_concurrent_num = int(supported_concurrent_num)
+        self.model: Optional[AbstractModel] = None
+        self._permits: "queue.Queue" = queue.Queue()
+        self._autoscale = self.supported_concurrent_num <= 0
+        self._granted = 0
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    # loaders (doLoad* parity)
+    # ------------------------------------------------------------------
+    def _install(self, model: AbstractModel):
+        self.model = model
+        self._permits = queue.Queue()
+        n = max(self.supported_concurrent_num, 1)
+        for _ in range(n):
+            self._permits.put(object())
+        self._granted = n
+
+    @staticmethod
+    def _resolve_model_dir(model_path: str) -> str:
+        """Zoo-model wrapper dirs (``ZooModel.save_model``: zoo_model.pkl
+        meta + ``keras/`` subdir) resolve to their inner KerasNet save."""
+        if os.path.exists(os.path.join(model_path, "zoo_model.pkl")):
+            return os.path.join(model_path, "keras")
+        return model_path
+
+    def load(self, model_path: str, weight_path: Optional[str] = None):
+        """Load a native zoo model directory (doLoad parity: BigDL path).
+
+        Accepts either a raw KerasNet save or a zoo-model wrapper
+        directory."""
+        from ..api.keras.models import KerasNet
+
+        self._install(FloatModel(
+            KerasNet.load_model(self._resolve_model_dir(model_path))))
+        return self
+
+    load_bigdl = load
+    do_load = load
+
+    def load_keras_net(self, net, quantize: bool = False):
+        """Load an in-memory KerasNet/ZooModel."""
+        if hasattr(net, "model") and not hasattr(net, "graph_function"):
+            net = net.model
+        self._install(QuantizedModel(net) if quantize else FloatModel(net))
+        return self
+
+    def load_tf(self, model_path: str, backend: str = "auto", **kw):
+        """TF saved model / frozen pb / keras h5 (doLoadTF parity) via the
+        interop importer (pipeline.api.net.TFNet)."""
+        from ..api.net import TFNet
+
+        net = TFNet.from_path(model_path, **kw)
+        self._install(net)
+        return self
+
+    do_load_tf = load_tf
+
+    def load_torch(self, module_or_path, **kw):
+        """PyTorch module / TorchScript file (doLoadPyTorch parity) via
+        pipeline.api.net.TorchNet."""
+        from ..api.net import TorchNet
+
+        net = module_or_path if isinstance(module_or_path, AbstractModel) \
+            else TorchNet.from_pytorch(module_or_path, **kw)
+        self._install(net)
+        return self
+
+    do_load_pytorch = load_torch
+
+    def load_caffe(self, def_path: str, model_path: str,
+                   quantize: bool = False):
+        """Caffe prototxt + caffemodel (doLoadCaffe parity,
+        InferenceModel.scala) via pipeline.api.caffe."""
+        from ..api.caffe import load_caffe
+
+        net = load_caffe(def_path, model_path)
+        self._install(QuantizedModel(net) if quantize else FloatModel(net))
+        return self
+
+    do_load_caffe = load_caffe
+
+    def load_onnx(self, model_path: str, quantize: bool = False):
+        """ONNX file via pipeline.api.onnx (the reference reaches ONNX
+        through OpenVINO model-optimizer conversion)."""
+        from ..api.onnx import load_onnx
+
+        net = load_onnx(model_path)
+        self._install(QuantizedModel(net) if quantize else FloatModel(net))
+        return self
+
+    def load_quantized(self, model_path: str):
+        """int8 weight-only PTQ of a native model directory — the XLA
+        stand-in for doLoadOpenVINO int8 IRs."""
+        from ..api.keras.models import KerasNet
+
+        self._install(QuantizedModel(
+            KerasNet.load_model(self._resolve_model_dir(model_path))))
+        return self
+
+    do_load_openvino = load_quantized
+
+    # ------------------------------------------------------------------
+    # predict (doPredict :622-656 + retrieveModel :710)
+    # ------------------------------------------------------------------
+    def _acquire(self):
+        if self._autoscale:
+            try:
+                return self._permits.get_nowait()
+            except queue.Empty:
+                with self._lock:
+                    self._granted += 1
+                return object()
+        return self._permits.get()
+
+    def predict(self, inputs):
+        if self.model is None:
+            raise RuntimeError("no model loaded; call load*() first")
+        permit = self._acquire()
+        try:
+            return self.model.predict(inputs)
+        finally:
+            self._permits.put(permit)
+
+    do_predict = predict
+
+    def release(self):
+        if self.model is not None:
+            self.model.release()
+            self.model = None
+
+    @property
+    def concurrent_num(self):
+        return self._granted

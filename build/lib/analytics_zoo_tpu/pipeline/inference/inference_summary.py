@@ -1,0 +1,70 @@
+"""InferenceSummary: throughput/latency scalars for serving.
+
+Parity: ``zoo/.../pipeline/inference/InferenceSummary.scala:46`` (wired by
+``ClusterServing.scala:96-97``) — TensorBoard scalars via the event-writer
+in ``utils.tensorboard``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from ...utils import tensorboard
+
+
+class InferenceSummary:
+    def __init__(self, log_dir: str, app_name: str):
+        self.writer = tensorboard.FileWriter(
+            os.path.join(log_dir, app_name, "inference"))
+        self._step = 0
+        self._lock = threading.Lock()
+
+    def _next_step(self) -> int:
+        # serving predicts run concurrently (permits > 1); the step
+        # counter must not interleave
+        with self._lock:
+            self._step += 1
+            return self._step
+
+    def add_scalar(self, tag: str, value: float, step: int = None):
+        if step is None:
+            step = self._next_step()
+        else:
+            # keep the shared auto-step counter monotonic past explicit
+            # steps, so mixing both never emits duplicate/out-of-order
+            # steps for one tag (ADVICE r3 #5)
+            with self._lock:
+                self._step = max(self._step, step)
+        self.writer.add_scalar(tag, value, step)
+
+    def record_batch(self, batch_size: int, latency_s: float):
+        step = self._next_step()
+        self.writer.add_scalar("Throughput",
+                               batch_size / max(latency_s, 1e-9), step)
+        self.writer.add_scalar("LatencyMs", latency_s * 1e3, step)
+
+    def close(self):
+        self.writer.close()
+
+
+class Timer:
+    """``InferenceSupportive.timing`` parity: context manager measuring a
+    predict call for the summary."""
+
+    def __init__(self, summary: InferenceSummary = None,
+                 batch_size: int = 1):
+        self.summary = summary
+        self.batch_size = batch_size
+        self.elapsed = 0.0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.elapsed = time.perf_counter() - self._t0
+        if self.summary is not None:
+            self.summary.record_batch(self.batch_size, self.elapsed)
+        return False

@@ -1,0 +1,95 @@
+"""NNImageReader / NNImageSchema: images as DataFrame rows.
+
+Parity: ``zoo/.../pipeline/nnframes/NNImageReader.scala`` (readImages →
+DataFrame with an ``image`` struct column {origin, height, width,
+nChannels, mode, data}) and ``pyzoo/zoo/pipeline/nnframes/nn_image_reader.py``
+/ ``nn_image_schema.py``.
+
+TPU redesign: the DataFrame is pandas; the image row is a plain dict with
+the same struct fields (data = raw BGR uint8 bytes, mode = OpenCV type
+code), so NNEstimator feature chains built for the reference schema apply
+unchanged.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Optional
+
+import numpy as np
+
+try:
+    import cv2
+except Exception:  # pragma: no cover
+    cv2 = None
+
+from ...feature.image.image_feature import ImageFeature
+
+_IMAGE_EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+# OpenCV type code for 8UC3 (the reference stores CvType), 8UC1
+_CV_8UC3 = 16
+_CV_8UC1 = 0
+
+
+class NNImageSchema:
+    """Row <-> ImageFeature codecs (NNImageSchema.scala parity)."""
+
+    @staticmethod
+    def to_row(img: np.ndarray, origin: str = "") -> dict:
+        img = np.asarray(img)
+        if img.ndim == 2:
+            img = img[..., None]
+        h, w, c = img.shape
+        return {"origin": origin, "height": int(h), "width": int(w),
+                "nChannels": int(c),
+                "mode": _CV_8UC3 if c == 3 else _CV_8UC1,
+                "data": np.ascontiguousarray(
+                    img.astype(np.uint8)).tobytes()}
+
+    @staticmethod
+    def to_ndarray(row: dict) -> np.ndarray:
+        arr = np.frombuffer(row["data"], np.uint8)
+        return arr.reshape(row["height"], row["width"],
+                           row["nChannels"]).astype(np.float32)
+
+    @staticmethod
+    def to_image_feature(row: dict) -> ImageFeature:
+        feat = ImageFeature(NNImageSchema.to_ndarray(row),
+                            uri=row.get("origin", ""))
+        return feat
+
+
+class NNImageReader:
+    """``NNImageReader.readImages(path)`` -> pandas DataFrame with an
+    ``image`` column of schema rows."""
+
+    @staticmethod
+    def readImages(path: str, sc=None, minPartitions: int = 1,
+                   resizeH: int = -1, resizeW: int = -1,
+                   image_codec: int = -1):
+        import pandas as pd
+
+        if os.path.isfile(path):
+            paths = [path]
+        elif os.path.isdir(path):
+            paths = sorted(
+                p for p in glob.glob(os.path.join(path, "**", "*"),
+                                     recursive=True)
+                if p.lower().endswith(_IMAGE_EXTS))
+        else:
+            paths = sorted(p for p in glob.glob(path)
+                           if p.lower().endswith(_IMAGE_EXTS))
+        rows = []
+        for p in paths:
+            buf = np.fromfile(p, np.uint8)
+            img = cv2.imdecode(buf, cv2.IMREAD_COLOR)
+            if img is None:
+                continue
+            if resizeH > 0 and resizeW > 0:
+                img = cv2.resize(img, (resizeW, resizeH))
+            rows.append(NNImageSchema.to_row(img, origin=p))
+        return pd.DataFrame({"image": rows})
+
+    read_images = readImages

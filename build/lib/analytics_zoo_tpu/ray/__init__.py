@@ -1,0 +1,7 @@
+from .raycontext import (ActorClass, ActorHandle, ObjectRef, RayContext,
+                         RemoteFunction, RemoteTaskError, get_ray_context)
+from .process import ProcessMonitor, ProcessGuard
+
+__all__ = ["RayContext", "RemoteFunction", "ActorClass", "ActorHandle",
+           "ObjectRef", "RemoteTaskError", "get_ray_context",
+           "ProcessMonitor", "ProcessGuard"]

@@ -1,0 +1,27 @@
+"""``python -m analytics_zoo_tpu.ray.worker_host --connect HOST:PORT``
+
+Joins a cross-host RayContext as a worker host (the raylet role; reference:
+the non-zero barrier partitions running ``ray start`` in
+``raycontext.py:166-186``).
+"""
+
+import argparse
+
+from .cluster import worker_host_main
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--connect", required=True, help="head HOST:PORT")
+    p.add_argument("--workers", type=int, default=2)
+    p.add_argument("--platform", default="cpu")
+    p.add_argument("--authkey", required=True,
+                   help="the head's RayContext.cluster_authkey")
+    args = p.parse_args()
+    host, port = args.connect.rsplit(":", 1)
+    worker_host_main((host, int(port)), num_workers=args.workers,
+                     authkey=args.authkey.encode(), platform=args.platform)
+
+
+if __name__ == "__main__":
+    main()

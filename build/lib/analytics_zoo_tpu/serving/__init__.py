@@ -1,0 +1,14 @@
+"""Cluster Serving: always-on streaming inference service.
+
+Parity: ``zoo/.../serving/ClusterServing.scala`` + client
+``pyzoo/zoo/serving/client.py``.
+"""
+
+from .client import API, InputQueue, OutputQueue
+from .cluster_serving import ClusterServing, ClusterServingHelper
+from .queue_backend import (FileStreamQueue, InProcessStreamQueue,
+                            StreamQueue, get_queue_backend)
+
+__all__ = ["InputQueue", "OutputQueue", "API", "ClusterServing",
+           "ClusterServingHelper", "StreamQueue", "InProcessStreamQueue",
+           "FileStreamQueue", "get_queue_backend"]

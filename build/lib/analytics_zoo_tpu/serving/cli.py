@@ -1,0 +1,229 @@
+"""Cluster Serving lifecycle CLI (ops tier).
+
+Parity: ``/root/reference/scripts/cluster-serving/cluster-serving-{init,
+start,stop,restart,shutdown}`` — the reference's scripts prepare a working
+directory with ``config.yaml``, spark-submit the serving job, and manage a
+``running`` flag file. TPU-native equivalent: one Python CLI (the shell
+wrappers in ``scripts/`` exec it) that writes a config template (``init``),
+runs the serve loop as a daemonized process with a pidfile (``start``),
+signals it (``stop``/``restart``), and cleans the working dir
+(``shutdown``). No Spark, no Redis requirement — the transport comes from
+``data.src`` in the config (``file:<dir>`` for multi-process on one host,
+``host:port`` for redis, in-process for tests/embedding).
+
+Usage::
+
+    python -m analytics_zoo_tpu.serving.cli init   [--dir DIR]
+    python -m analytics_zoo_tpu.serving.cli start  [--dir DIR] [--foreground]
+    python -m analytics_zoo_tpu.serving.cli status [--dir DIR]
+    python -m analytics_zoo_tpu.serving.cli stop   [--dir DIR]
+    python -m analytics_zoo_tpu.serving.cli restart [--dir DIR]
+    python -m analytics_zoo_tpu.serving.cli shutdown [--dir DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import time
+
+PIDFILE = "cluster-serving.pid"
+LOGFILE = "cluster-serving.log"
+CONFIG = "config.yaml"
+
+CONFIG_TEMPLATE = """\
+## Analytics-Zoo-TPU Cluster Serving configuration
+## (schema parity: reference scripts/cluster-serving/config.yaml)
+
+model:
+  # directory of a saved zoo model (KerasNet.save_model output)
+  path: /opt/work/model
+
+data:
+  # transport: "file:<dir>" | "<redis-host>:<port>" | empty for in-process
+  src: file:/tmp/zoo-serving-stream
+  # C, H, W of the decoded image tensor
+  image_shape: 3, 224, 224
+
+params:
+  batch_size: 32
+  top_n: 5
+  stream_maxlen: 10000
+"""
+
+
+def _paths(workdir: str):
+    return (os.path.join(workdir, CONFIG), os.path.join(workdir, PIDFILE),
+            os.path.join(workdir, LOGFILE))
+
+
+def _read_pid(pidfile: str):
+    try:
+        with open(pidfile) as f:
+            pid = int(f.read().strip())
+    except (OSError, ValueError):
+        return None
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return None
+    except PermissionError:
+        pass
+    return pid
+
+
+def cmd_init(workdir: str) -> int:
+    os.makedirs(workdir, exist_ok=True)
+    cfg, _, _ = _paths(workdir)
+    if os.path.exists(cfg):
+        print(f"{cfg} already exists; not overwriting")
+        return 1
+    with open(cfg, "w") as f:
+        f.write(CONFIG_TEMPLATE)
+    print(f"wrote {cfg}; edit model.path/data.src then "
+          f"`cluster-serving-start`")
+    return 0
+
+
+def _serve(cfg: str):
+    # honor JAX_PLATFORMS even when a TPU plugin is registered (the env
+    # var alone is ignored then; the config update is authoritative)
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        try:
+            import jax
+            jax.config.update("jax_platforms", plat)
+        except Exception:  # noqa: BLE001 - serving may not need jax yet
+            pass
+    from .cluster_serving import ClusterServing
+
+    serving = ClusterServing(config_path=cfg)
+
+    def _term(_sig, _frm):
+        serving._stop.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    serving.serve_forever()
+
+
+def cmd_start(workdir: str, foreground: bool = False) -> int:
+    cfg, pidfile, logfile = _paths(workdir)
+    if not os.path.exists(cfg):
+        print(f"no {cfg}; run `cluster-serving-init` first",
+              file=sys.stderr)
+        return 1
+    if _read_pid(pidfile) is not None:
+        print("Serving is already running!", file=sys.stderr)
+        return 1
+    if foreground:
+        _serve(cfg)
+        return 0
+    # double-fork daemonization, pidfile written by the grandchild
+    pid = os.fork()
+    if pid > 0:
+        # parent: wait for the pidfile so `start && stop` can't race
+        for _ in range(100):
+            if _read_pid(pidfile) is not None:
+                print(f"cluster serving started (pid "
+                      f"{_read_pid(pidfile)}), log: {logfile}")
+                return 0
+            time.sleep(0.1)
+        print("serving process did not come up; check " + logfile,
+              file=sys.stderr)
+        return 1
+    os.setsid()
+    if os.fork() > 0:
+        os._exit(0)
+    with open(logfile, "ab", buffering=0) as log:
+        os.dup2(log.fileno(), 1)
+        os.dup2(log.fileno(), 2)
+    with open(pidfile, "w") as f:
+        f.write(str(os.getpid()))
+    try:
+        _serve(cfg)
+    finally:
+        try:
+            os.remove(pidfile)
+        except OSError:
+            pass
+    os._exit(0)
+
+
+def cmd_status(workdir: str) -> int:
+    _, pidfile, _ = _paths(workdir)
+    pid = _read_pid(pidfile)
+    if pid is None:
+        print("not running")
+        return 3
+    print(f"running (pid {pid})")
+    return 0
+
+
+def cmd_stop(workdir: str, timeout: float = 10.0) -> int:
+    _, pidfile, _ = _paths(workdir)
+    pid = _read_pid(pidfile)
+    if pid is None:
+        print("not running")
+        return 0
+    os.kill(pid, signal.SIGTERM)
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            break
+        time.sleep(0.1)
+    else:
+        os.kill(pid, signal.SIGKILL)
+    try:
+        os.remove(pidfile)
+    except OSError:
+        pass
+    print("stopped")
+    return 0
+
+
+def cmd_restart(workdir: str) -> int:
+    cmd_stop(workdir)
+    return cmd_start(workdir)
+
+
+def cmd_shutdown(workdir: str) -> int:
+    rc = cmd_stop(workdir)
+    _, _, logfile = _paths(workdir)
+    for path in (logfile,):
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+    print("shut down")
+    return rc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="cluster-serving")
+    ap.add_argument("command", choices=["init", "start", "status", "stop",
+                                        "restart", "shutdown"])
+    ap.add_argument("--dir", default=".", help="serving working directory")
+    ap.add_argument("--foreground", action="store_true",
+                    help="start: run in the foreground (containers)")
+    args = ap.parse_args(argv)
+    workdir = os.path.abspath(args.dir)
+    if args.command == "init":
+        return cmd_init(workdir)
+    if args.command == "start":
+        return cmd_start(workdir, foreground=args.foreground)
+    if args.command == "status":
+        return cmd_status(workdir)
+    if args.command == "stop":
+        return cmd_stop(workdir)
+    if args.command == "restart":
+        return cmd_restart(workdir)
+    return cmd_shutdown(workdir)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,72 @@
+"""Serving clients: InputQueue / OutputQueue.
+
+Parity: ``pyzoo/zoo/serving/client.py`` — ``InputQueue.enqueue_image``
+(:83, base64-encoded jpg into the stream), ``OutputQueue.dequeue``/``query``
+(:131,142).  The transport is pluggable (§queue_backend) instead of
+hard-coded Redis.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from typing import Optional
+
+import numpy as np
+
+from .queue_backend import StreamQueue, get_queue_backend
+
+
+class API:
+    """Shared client base (client.py:25)."""
+
+    def __init__(self, backend: Optional[StreamQueue] = None,
+                 address: Optional[str] = None):
+        self.db = backend if backend is not None else \
+            get_queue_backend(address)
+
+
+class InputQueue(API):
+    def enqueue_image(self, uri: str, img) -> str:
+        """Put one image on the stream; ``img`` is an ndarray (HWC BGR
+        uint8) or pre-encoded jpg/png bytes (client.py:83-122)."""
+        if isinstance(img, np.ndarray):
+            import cv2
+
+            ok, buf = cv2.imencode(".jpg", img.astype(np.uint8))
+            if not ok:
+                raise ValueError("jpg encode failed")
+            data = buf.tobytes()
+        else:
+            data = bytes(img)
+        return self.db.enqueue({"uri": uri,
+                                "image": self.base64_encode_image(data)})
+
+    def enqueue(self, uri: str, **tensors) -> str:
+        """General tensor input: each kwarg becomes a (shape, data) entry."""
+        rec = {"uri": uri, "tensors": {
+            k: {"shape": list(np.asarray(v).shape),
+                "data": np.asarray(v, np.float32).tobytes()}
+            for k, v in tensors.items()}}
+        return self.db.enqueue(rec)
+
+    @staticmethod
+    def base64_encode_image(data: bytes) -> str:
+        return base64.b64encode(data).decode("utf-8")
+
+
+class OutputQueue(API):
+    def dequeue(self):
+        """Fetch-and-clear all results: {uri: ndarray} (client.py:131)."""
+        return {uri: self._decode(v)
+                for uri, v in self.db.all_results(pop=True).items()}
+
+    def query(self, uri: str):
+        """Result for one uri or None (client.py:142)."""
+        v = self.db.get_result(uri, pop=False)
+        return self._decode(v) if v is not None else None
+
+    @staticmethod
+    def _decode(value: bytes):
+        obj = json.loads(value.decode("utf-8"))
+        return np.asarray(obj["value"], np.float32)

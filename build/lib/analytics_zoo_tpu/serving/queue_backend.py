@@ -1,0 +1,266 @@
+"""Stream-queue transports for Cluster Serving.
+
+The reference's transport is a Redis stream (``image_stream`` XADD/XREAD,
+ClusterServing.scala:105-116) plus a results hash.  The rebuild keeps that
+wire model behind a small interface so the serving loop and clients are
+transport-agnostic:
+
+- :class:`InProcessStreamQueue` — threading-based, for tests and
+  single-process serving;
+- :class:`FileStreamQueue` — directory-backed, multi-process on one host
+  (each record one msgpack file, atomic rename), no external service;
+- :class:`RedisStreamQueue` — the reference transport, used when the
+  ``redis`` client package is importable and a server address is given.
+
+All three implement XADD-like ``enqueue``, XREAD-like ``read_batch``, a
+results hash (``put_result``/``get_result``), and the memory-watermark trim
+(ClusterServing.scala:130-136).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import time
+import uuid
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import msgpack
+
+
+class StreamQueue:
+    """Interface: a named input stream + a results map."""
+
+    def enqueue(self, record: dict) -> str:
+        raise NotImplementedError
+
+    def read_batch(self, max_items: int, timeout: float = 1.0
+                   ) -> List[Tuple[str, dict]]:
+        raise NotImplementedError
+
+    def put_result(self, uri: str, value: bytes):
+        raise NotImplementedError
+
+    def get_result(self, uri: str, pop: bool = True) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def all_results(self, pop: bool = True) -> Dict[str, bytes]:
+        raise NotImplementedError
+
+    def stream_len(self) -> int:
+        raise NotImplementedError
+
+    def trim(self, keep_last: int):
+        """Watermark trim (xtrim parity)."""
+        raise NotImplementedError
+
+
+class InProcessStreamQueue(StreamQueue):
+    def __init__(self, name: str = "image_stream"):
+        self.name = name
+        self._stream: "OrderedDict[str, dict]" = OrderedDict()
+        self._results: Dict[str, bytes] = {}
+        self._cv = threading.Condition()
+
+    def enqueue(self, record: dict) -> str:
+        rid = uuid.uuid4().hex
+        with self._cv:
+            self._stream[rid] = record
+            self._cv.notify_all()
+        return rid
+
+    def read_batch(self, max_items, timeout=1.0):
+        deadline = time.time() + timeout
+        with self._cv:
+            while not self._stream and time.time() < deadline:
+                self._cv.wait(timeout=max(deadline - time.time(), 0.01))
+            out = []
+            while self._stream and len(out) < max_items:
+                rid, rec = self._stream.popitem(last=False)
+                out.append((rid, rec))
+            return out
+
+    def put_result(self, uri, value):
+        with self._cv:
+            self._results[uri] = value
+
+    def get_result(self, uri, pop=True):
+        with self._cv:
+            return self._results.pop(uri, None) if pop else \
+                self._results.get(uri)
+
+    def all_results(self, pop=True):
+        with self._cv:
+            out = dict(self._results)
+            if pop:
+                self._results.clear()
+            return out
+
+    def stream_len(self):
+        with self._cv:
+            return len(self._stream)
+
+    def trim(self, keep_last):
+        with self._cv:
+            while len(self._stream) > keep_last:
+                self._stream.popitem(last=False)
+
+
+class FileStreamQueue(StreamQueue):
+    """Directory-backed stream: producers write ``<ts>-<id>.msgpack`` into
+    ``<root>/stream`` atomically; the consumer claims files by rename.
+    Results land in ``<root>/results/<safe-uri>``.  Good enough for
+    multi-process single-host serving without Redis."""
+
+    def __init__(self, root: str, name: str = "image_stream"):
+        self.root = root
+        self.stream_dir = os.path.join(root, name)
+        self.results_dir = os.path.join(root, "results")
+        os.makedirs(self.stream_dir, exist_ok=True)
+        os.makedirs(self.results_dir, exist_ok=True)
+
+    def enqueue(self, record):
+        rid = f"{time.time_ns():020d}-{uuid.uuid4().hex[:8]}"
+        payload = msgpack.packb(record, use_bin_type=True)
+        fd, tmp = tempfile.mkstemp(dir=self.stream_dir, suffix=".tmp")
+        with os.fdopen(fd, "wb") as f:
+            f.write(payload)
+        os.rename(tmp, os.path.join(self.stream_dir, rid + ".msgpack"))
+        return rid
+
+    def read_batch(self, max_items, timeout=1.0):
+        deadline = time.time() + timeout
+        while True:
+            names = sorted(n for n in os.listdir(self.stream_dir)
+                           if n.endswith(".msgpack"))[:max_items]
+            out = []
+            for n in names:
+                path = os.path.join(self.stream_dir, n)
+                claimed = path + ".claimed"
+                try:
+                    os.rename(path, claimed)  # atomic claim
+                except OSError:
+                    continue
+                with open(claimed, "rb") as f:
+                    rec = msgpack.unpackb(f.read(), raw=False)
+                os.unlink(claimed)
+                out.append((n[:-len(".msgpack")], rec))
+            if out or time.time() >= deadline:
+                return out
+            time.sleep(0.02)
+
+    @staticmethod
+    def _safe(uri: str) -> str:
+        return "".join(c if c.isalnum() or c in "._-" else "_" for c in uri)
+
+    def put_result(self, uri, value):
+        fd, tmp = tempfile.mkstemp(dir=self.results_dir, suffix=".tmp")
+        with os.fdopen(fd, "wb") as f:
+            f.write(value)
+        os.rename(tmp, os.path.join(self.results_dir, self._safe(uri)))
+
+    def get_result(self, uri, pop=True):
+        path = os.path.join(self.results_dir, self._safe(uri))
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            data = f.read()
+        if pop:
+            os.unlink(path)
+        return data
+
+    def all_results(self, pop=True):
+        out = {}
+        for n in os.listdir(self.results_dir):
+            if n.endswith(".tmp"):
+                continue
+            data = self.get_result(n, pop=pop)
+            if data is not None:
+                out[n] = data
+        return out
+
+    def stream_len(self):
+        return sum(1 for n in os.listdir(self.stream_dir)
+                   if n.endswith(".msgpack"))
+
+    def trim(self, keep_last):
+        names = sorted(n for n in os.listdir(self.stream_dir)
+                       if n.endswith(".msgpack"))
+        for n in names[:-keep_last] if keep_last else names:
+            try:
+                os.unlink(os.path.join(self.stream_dir, n))
+            except OSError:
+                pass
+
+
+class RedisStreamQueue(StreamQueue):  # pragma: no cover - needs a server
+    """The reference transport (Redis stream + hash), used when redis-py
+    and a server are available."""
+
+    def __init__(self, host="localhost", port=6379, name="image_stream"):
+        import redis
+
+        self.r = redis.Redis(host=host, port=port)
+        self.name = name
+        self._last_id = "0"
+
+    def enqueue(self, record):
+        return self.r.xadd(self.name, {
+            k: v if isinstance(v, (bytes, str, int, float)) else
+            msgpack.packb(v, use_bin_type=True)
+            for k, v in record.items()}).decode()
+
+    def read_batch(self, max_items, timeout=1.0):
+        resp = self.r.xread({self.name: self._last_id}, count=max_items,
+                            block=int(timeout * 1000))
+        out = []
+        for _stream, entries in resp or []:
+            for rid, fields in entries:
+                self._last_id = rid
+                rec = {k.decode(): v for k, v in fields.items()}
+                out.append((rid.decode(), rec))
+        return out
+
+    def put_result(self, uri, value):
+        self.r.hset("result:" + uri, "value", value)
+
+    def get_result(self, uri, pop=True):
+        v = self.r.hget("result:" + uri, "value")
+        if pop and v is not None:
+            self.r.delete("result:" + uri)
+        return v
+
+    def all_results(self, pop=True):
+        out = {}
+        for key in self.r.keys("result:*"):
+            uri = key.decode()[len("result:"):]
+            v = self.get_result(uri, pop=pop)
+            if v is not None:
+                out[uri] = v
+        return out
+
+    def stream_len(self):
+        return self.r.xlen(self.name)
+
+    def trim(self, keep_last):
+        self.r.xtrim(self.name, maxlen=keep_last)
+
+
+def get_queue_backend(spec: Optional[str] = None) -> StreamQueue:
+    """``None``/'inproc' -> InProcessStreamQueue (also registered as the
+    process-wide default so clients and server share it); 'file:<dir>' ->
+    FileStreamQueue; 'host:port' -> RedisStreamQueue."""
+    global _DEFAULT_INPROC
+    if spec is None or spec == "inproc":
+        if _DEFAULT_INPROC is None:
+            _DEFAULT_INPROC = InProcessStreamQueue()
+        return _DEFAULT_INPROC
+    if spec.startswith("file:"):
+        return FileStreamQueue(spec[len("file:"):])
+    host, _, port = spec.partition(":")
+    return RedisStreamQueue(host, int(port or 6379))
+
+
+_DEFAULT_INPROC: Optional[InProcessStreamQueue] = None

@@ -1,0 +1,19 @@
+"""TFPark equivalent (reference: ``pyzoo/zoo/tfpark``).
+
+TensorFlow models — tf.keras models, raw loss graphs, estimator model_fns —
+trained and served by the TPU engine. The reference replays TF sessions
+inside BigDL executors (TFTrainingHelper/GraphRunner, SURVEY.md §3.3); here
+TF graphs lower ONCE to jax (``tfpark.tf_bridge``) and train as compiled
+SPMD steps, with trained weights written back into the live TF objects.
+"""
+
+from .estimator import ModeKeys, TFEstimator, TFEstimatorSpec
+from .gan_estimator import GANEstimator
+from .model import KerasModel
+from .tf_bridge import LoweredTF, lower_keras_model, lower_tf_callable
+from .tf_dataset import TFDataset
+from .tf_optimizer import TFOptimizer
+
+__all__ = ["TFDataset", "TFOptimizer", "TFEstimator", "TFEstimatorSpec",
+           "ModeKeys", "KerasModel", "GANEstimator", "LoweredTF",
+           "lower_keras_model", "lower_tf_callable"]

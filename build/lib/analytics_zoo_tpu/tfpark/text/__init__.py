@@ -1,0 +1,7 @@
+from .keras import NER, IntentEntity, SequenceTagger, TextKerasModel
+from .estimator import (BERTBaseEstimator, BERTClassifier, BERTNER,
+                        BERTSQuAD, bert_input_fn)
+
+__all__ = ["TextKerasModel", "NER", "SequenceTagger", "IntentEntity",
+           "BERTBaseEstimator", "BERTClassifier", "BERTNER", "BERTSQuAD",
+           "bert_input_fn"]

@@ -1,0 +1,31 @@
+"""BERTSQuAD (parity: pyzoo/zoo/tfpark/text/estimator/bert_squad.py):
+start/end-position log-softmax heads over the BERT sequence output."""
+
+from __future__ import annotations
+
+from ....pipeline.api.autograd import Lambda
+from ....pipeline.api.keras.layers import Dense
+from .bert_base import BERTBaseEstimator
+
+
+class BERTSQuAD(BERTBaseEstimator):
+    """Outputs (start_probs (B, L), end_probs (B, L)); labels are
+    (start_positions, end_positions) int vectors."""
+
+    def __init__(self, optimizer="adam", **kwargs):
+        import jax
+        import jax.numpy as jnp
+
+        def head(seq, pooled):
+            logits = Dense(2)(seq)                      # (B, L, 2)
+            start, end = Lambda(
+                lambda t: (jnp.squeeze(t[..., 0:1], -1),
+                           jnp.squeeze(t[..., 1:2], -1)),
+                num_outputs=2)(logits)
+            soft = Lambda(lambda t: jax.nn.softmax(t, axis=-1))
+            return [soft(start), soft(end)]
+
+        super().__init__(
+            head_fn=head,
+            loss=["sparse_categorical_crossentropy"] * 2,
+            optimizer=optimizer, **kwargs)

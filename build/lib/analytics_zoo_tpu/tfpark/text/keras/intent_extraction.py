@@ -1,0 +1,123 @@
+"""IntentEntity: joint intent classification + slot filling.
+
+Parity target: ``pyzoo/zoo/tfpark/text/keras/intent_extraction.py``
+(nlp_architect MultiTaskIntentModel). Rebuilt in-repo: word embedding ∥
+char-BiLSTM features → shared BiLSTM encoder → (a) intent softmax from the
+final encoder state, (b) per-token slot softmax from a tagger BiLSTM."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ....pipeline.api.keras.engine.base import Input, KerasLayer
+from ....pipeline.api.keras.layers import LSTM, Bidirectional, Dense, \
+    Embedding
+from ....pipeline.api.keras.models import Model
+from .ner import _dropout
+from .text_model import TextKerasModel
+
+
+class _IntentNet(KerasLayer):
+    """Inputs: [word (B,L), chars (B,L,W)] →
+    (intent (B,I), tags (B,L,E))."""
+
+    stochastic = True
+    num_outputs = 2
+
+    def __init__(self, num_intents, num_entities, word_vocab_size,
+                 char_vocab_size, word_emb_dim=100, char_emb_dim=30,
+                 char_lstm_dim=30, tagger_lstm_dim=100, dropout=0.2,
+                 input_shape=None, name=None, **kw):
+        super().__init__(input_shape=input_shape, name=name)
+        self.num_intents = num_intents
+        self.num_entities = num_entities
+        self.dropout = dropout
+        self.word_emb = Embedding(word_vocab_size, word_emb_dim)
+        self.char_emb = Embedding(char_vocab_size, char_emb_dim)
+        self.char_lstm = Bidirectional(LSTM(char_lstm_dim,
+                                            return_sequences=False))
+        self.encoder = Bidirectional(LSTM(tagger_lstm_dim,
+                                          return_sequences=True))
+        self.tagger = Bidirectional(LSTM(tagger_lstm_dim,
+                                         return_sequences=True))
+        self.intent_out = Dense(num_intents, activation="softmax")
+        self.tags_out = Dense(num_entities, activation="softmax")
+        self._subs = [self.word_emb, self.char_emb, self.char_lstm,
+                      self.encoder, self.tagger, self.intent_out,
+                      self.tags_out]
+        self._dims = (word_emb_dim, char_emb_dim, char_lstm_dim,
+                      tagger_lstm_dim)
+        self._stabilize_sub_names()
+
+    def _stabilize_sub_names(self):
+        # param keys must be reproducible across process restarts:
+        # auto-generated layer names depend on global counters, so a
+        # rebuilt net (model_io definition load) would otherwise key
+        # its params differently and every lookup would KeyError
+        for i, sub in enumerate(self._subs):
+            sub.name = f"sub{i}_{type(sub).__name__.lower()}"
+
+    def build(self, rng, input_shape):
+        self._stabilize_sub_names()
+        we, ce, cl, tl = self._dims
+        rngs = jax.random.split(rng, len(self._subs))
+        shapes = [(None, None), (None, None), (None, None, ce),
+                  (None, None, we + 2 * cl), (None, None, 2 * tl),
+                  (None, 2 * tl), (None, 2 * tl)]
+        return {sub.name: sub.build(r, s)
+                for sub, r, s in zip(self._subs, rngs, shapes)}
+
+    def compute_output_shape(self, input_shape):
+        words = input_shape[0]
+        return [(words[0], self.num_intents),
+                (words[0], words[1], self.num_entities)]
+
+    def call(self, params, inputs, training=False, rng=None, **kw):
+        words, chars = inputs
+        words = words.astype(jnp.int32)
+        chars = chars.astype(jnp.int32)
+        b, l = words.shape
+        w = self.word_emb.call(params[self.word_emb.name], words)
+        c = self.char_emb.call(params[self.char_emb.name], chars)
+        cw = c.reshape((b * l,) + c.shape[2:])
+        cf = self.char_lstm.call(params[self.char_lstm.name], cw,
+                                 training=training).reshape(b, l, -1)
+        x = jnp.concatenate([w, cf], axis=-1)
+        if rng is not None:
+            rng, sub = jax.random.split(rng)
+            x = _dropout(x, self.dropout, sub, training)
+        enc = self.encoder.call(params[self.encoder.name], x,
+                                training=training)
+        # intent from mean-pooled encoder states (mask-free pooling)
+        intent = self.intent_out.call(params[self.intent_out.name],
+                                      enc.mean(axis=1))
+        tag_h = self.tagger.call(params[self.tagger.name], enc,
+                                 training=training)
+        tags = self.tags_out.call(params[self.tags_out.name], tag_h)
+        return intent, tags
+
+
+class IntentEntity(TextKerasModel):
+    """Joint intent + slot model (intent_extraction.py parity surface)."""
+
+    def __init__(self, num_intents, num_entities, word_vocab_size,
+                 char_vocab_size, word_length=12, word_emb_dim=100,
+                 char_emb_dim=30, char_lstm_dim=30, tagger_lstm_dim=100,
+                 dropout=0.2, optimizer=None, seq_len: Optional[int] = None):
+        net = _IntentNet(num_intents, num_entities, word_vocab_size,
+                         char_vocab_size, word_emb_dim=word_emb_dim,
+                         char_emb_dim=char_emb_dim,
+                         char_lstm_dim=char_lstm_dim,
+                         tagger_lstm_dim=tagger_lstm_dim, dropout=dropout)
+        words = Input(shape=(seq_len,), name="words")
+        chars = Input(shape=(seq_len, word_length), name="chars")
+        intent, tags = net([words, chars])
+        super().__init__(Model([words, chars], [intent, tags]), optimizer,
+                         losses=["sparse_categorical_crossentropy"] * 2)
+
+    @staticmethod
+    def load_model(path):
+        return IntentEntity._load_model(path)

@@ -1,0 +1,186 @@
+"""NER: BiLSTM-CRF tagger over word + per-word character features.
+
+Parity target: ``pyzoo/zoo/tfpark/text/keras/ner.py`` (which delegates to
+nlp_architect's NERCRF). Rebuilt on the in-repo layers: word embedding ∥
+char-BiLSTM word features → two stacked BiLSTM taggers → linear-chain CRF
+head (``ops/crf.py``: scan-based forward algorithm + Viterbi).
+``crf_mode='reg'`` scores every position; ``crf_mode='pad'`` takes an extra
+sequence-length input and masks pad positions out of the likelihood and the
+decode — the same two modes nlp_architect's NERCRF exposes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ....pipeline.api.keras.engine.base import Input, KerasLayer
+from ....pipeline.api.keras.layers import CRF, LSTM, Bidirectional, Dense, \
+    Embedding
+from ....pipeline.api.keras.layers.self_attention import _dropout
+from ....pipeline.api.keras.models import Model
+from .text_model import TextKerasModel
+
+
+class _NERNet(KerasLayer):
+    """Inputs: [word (B,L), chars (B,L,W)] (+ seq_lens (B,) in 'pad' mode)
+    → softmax tags (B,L,E), or CRF outputs [unary, trans(, mask)]."""
+
+    stochastic = True
+
+    def __init__(self, num_entities, word_vocab_size, char_vocab_size,
+                 word_length=12, word_emb_dim=100, char_emb_dim=30,
+                 tagger_lstm_dim=100, dropout=0.5, use_crf=False,
+                 crf_mode="reg", input_shape=None, name=None, **kw):
+        super().__init__(input_shape=input_shape, name=name)
+        self.num_entities = num_entities
+        self.dropout = dropout
+        self.use_crf = use_crf
+        self.crf_mode = crf_mode
+        self.word_emb = Embedding(word_vocab_size, word_emb_dim)
+        self.char_emb = Embedding(char_vocab_size, char_emb_dim)
+        self.char_lstm = Bidirectional(LSTM(char_emb_dim,
+                                            return_sequences=False))
+        self.tagger1 = Bidirectional(LSTM(tagger_lstm_dim,
+                                          return_sequences=True))
+        self.tagger2 = Bidirectional(LSTM(tagger_lstm_dim,
+                                          return_sequences=True))
+        # CRF consumes raw scores; the softmax path mirrors nlp_architect's
+        # default dense head
+        self.out = Dense(num_entities,
+                         activation=None if use_crf else "softmax")
+        self._subs = [self.word_emb, self.char_emb, self.char_lstm,
+                      self.tagger1, self.tagger2, self.out]
+        if use_crf:
+            self.crf = CRF(num_entities)
+            self._subs.append(self.crf)
+            self.num_outputs = 3 if crf_mode == "pad" else 2
+        self._dims = (word_emb_dim, char_emb_dim, tagger_lstm_dim)
+        self._stabilize_sub_names()
+
+    def _stabilize_sub_names(self):
+        # param keys must be reproducible across process restarts:
+        # auto-generated layer names depend on global counters, so a
+        # rebuilt net (model_io definition load) would otherwise key
+        # its params differently and every lookup would KeyError
+        for i, sub in enumerate(self._subs):
+            sub.name = f"sub{i}_{type(sub).__name__.lower()}"
+
+    def build(self, rng, input_shape):
+        self._stabilize_sub_names()
+        word_emb_dim, char_emb_dim, tagger_dim = self._dims
+        rngs = jax.random.split(rng, len(self._subs))
+        shapes = [
+            (None, None), (None, None),          # embeddings ignore shape
+            (None, None, char_emb_dim),          # char lstm over word chars
+            (None, None, word_emb_dim + 2 * char_emb_dim),
+            (None, None, 2 * tagger_dim),
+            (None, 2 * tagger_dim),
+        ]
+        if self.use_crf:
+            shapes.append((None, None, self.num_entities))
+        return {sub.name: sub.build(r, s)
+                for sub, r, s in zip(self._subs, rngs, shapes)}
+
+    def compute_output_shape(self, input_shape):
+        words = input_shape[0]
+        seq = (words[0], words[1], self.num_entities)
+        if not self.use_crf:
+            return seq
+        outs = [seq, (words[0], self.num_entities, self.num_entities)]
+        if self.crf_mode == "pad":
+            outs.append((words[0], words[1]))
+        return outs
+
+    def call(self, params, inputs, training=False, rng=None, **kw):
+        words, chars = inputs[0], inputs[1]
+        words = words.astype(jnp.int32)
+        chars = chars.astype(jnp.int32)
+        b, l = words.shape
+        w = self.word_emb.call(params[self.word_emb.name], words)
+        c = self.char_emb.call(params[self.char_emb.name], chars)
+        cw = c.reshape((b * l,) + c.shape[2:])          # (B*L, W, ce)
+        cf = self.char_lstm.call(params[self.char_lstm.name], cw,
+                                 training=training)
+        cf = cf.reshape(b, l, -1)                        # (B, L, 2*ce)
+        x = jnp.concatenate([w, cf], axis=-1)
+        if rng is not None:
+            rng, sub = jax.random.split(rng)
+            x = _dropout(x, self.dropout, sub, training)
+        x = self.tagger1.call(params[self.tagger1.name], x,
+                              training=training)
+        x = self.tagger2.call(params[self.tagger2.name], x,
+                              training=training)
+        scores = self.out.call(params[self.out.name], x)
+        if not self.use_crf:
+            return scores
+        unary, trans = self.crf.call(params[self.crf.name], scores)
+        if self.crf_mode == "pad":
+            lens = inputs[2].astype(jnp.int32).reshape(b)
+            mask = (jnp.arange(l)[None, :] < lens[:, None]).astype(
+                jnp.float32)
+            return unary, trans, mask
+        return unary, trans
+
+
+class NER(TextKerasModel):
+    """BiLSTM-CRF named-entity tagger (ner.py parity surface).
+
+    Inputs: word indices (B, L) + char indices (B, L, word_length), plus
+    sequence lengths (B,) when ``crf_mode='pad'``.  ``predict`` returns
+    one-hot Viterbi decodes (B, L, num_entities); ``predict_tags`` returns
+    integer tags (B, L).
+    """
+
+    def __init__(self, num_entities, word_vocab_size, char_vocab_size,
+                 word_length=12, word_emb_dim=100, char_emb_dim=30,
+                 tagger_lstm_dim=100, dropout=0.5, crf_mode="reg",
+                 optimizer=None, seq_len: Optional[int] = None):
+        if crf_mode not in ("reg", "pad"):
+            raise ValueError("crf_mode should be either 'reg' or 'pad'")
+        self.num_entities = num_entities
+        self.crf_mode = crf_mode
+        net = _NERNet(num_entities, word_vocab_size, char_vocab_size,
+                      word_length=word_length, word_emb_dim=word_emb_dim,
+                      char_emb_dim=char_emb_dim,
+                      tagger_lstm_dim=tagger_lstm_dim, dropout=dropout,
+                      use_crf=True, crf_mode=crf_mode)
+        words = Input(shape=(seq_len,), name="words")
+        chars = Input(shape=(seq_len, word_length), name="chars")
+        ins = [words, chars]
+        if crf_mode == "pad":
+            ins.append(Input(shape=(), name="seq_lens"))
+        outs = net(ins)
+        from ....pipeline.api.keras.objectives import CRFLoss
+        super().__init__(Model(ins, list(outs)), optimizer,
+                         losses=[CRFLoss()])
+
+    @staticmethod
+    def _decode_outputs(outs):
+        from ....pipeline.api.keras.layers import CRF
+
+        unary, trans = outs[0], outs[1]
+        mask = outs[2] if len(outs) > 2 else None
+        tags = CRF.decode(unary, trans, mask)
+        if mask is not None:
+            tags = tags * mask.astype(tags.dtype)
+        return tags
+
+    def predict_tags(self, x, batch_size: int = 128):
+        """Viterbi-decoded integer tags (B, L)."""
+        return self._decode_outputs(
+            self.model.predict(x, batch_size=batch_size))
+
+    def predict(self, x, batch_size: int = 128, distributed: bool = True):
+        outs = self.model.predict(x, batch_size=batch_size)
+        tags = self._decode_outputs(outs)
+        # tag count from the outputs: survives load_model's
+        # __init__-bypassing reconstruction
+        return np.eye(outs[0].shape[-1], dtype=np.float32)[tags]
+
+    @staticmethod
+    def load_model(path):
+        return NER._load_model(path)

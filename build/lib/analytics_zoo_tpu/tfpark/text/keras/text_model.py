@@ -1,0 +1,61 @@
+"""TextKerasModel base.
+
+Parity target: ``pyzoo/zoo/tfpark/text/keras/text_model.py`` — there the
+class wraps an ``nlp_architect`` tf.keras "labor" model and trains it through
+TFPark. TPU-native redesign: the labor networks (NER tagger, sequence
+tagger, intent+slot model) are rebuilt directly on the in-repo Keras layers
+— one jax program end-to-end, no nlp_architect / TF-graph hop — and this
+base provides the common compile/fit/evaluate/predict + save/load surface.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+
+class TextKerasModel:
+    """Common surface for the tfpark text models.
+
+    Subclasses build ``self.model`` (a zoo Keras ``Model``) in __init__ and
+    set ``self.default_losses`` (one per output).
+    """
+
+    def __init__(self, model, optimizer, losses, loss_weights=None):
+        from ....pipeline.api.keras.optimizers import Adam
+
+        self.model = model
+        self.labor = model  # reference attribute name for the inner model
+        loss: Any = list(losses) if len(losses) > 1 else losses[0]
+        if loss_weights is not None:
+            from ....pipeline.api.keras.objectives import MultiLoss
+            loss = MultiLoss(list(losses), loss_weights)
+        self.model.compile(optimizer=optimizer or Adam(lr=1e-3), loss=loss)
+
+    # ------------------------------------------------------------------
+    def fit(self, x, y, batch_size: int = 32, epochs: int = 1,
+            validation_data=None, distributed: bool = True):
+        self.model.fit(x, y, batch_size=batch_size, nb_epoch=epochs,
+                       validation_data=validation_data)
+        return self
+
+    def evaluate(self, x, y, batch_size: int = 32):
+        return self.model.evaluate(x, y, batch_size=batch_size)
+
+    def predict(self, x, batch_size: int = 128, distributed: bool = True):
+        return self.model.predict(x, batch_size=batch_size)
+
+    # ------------------------------------------------------------------
+    def save_model(self, path: str):
+        self.model.save_model(path)
+
+    @classmethod
+    def _load_model(cls, path: str):
+        from ....pipeline.api.keras.models import Model
+
+        obj = cls.__new__(cls)
+        obj.model = Model.load_model(path)
+        obj.labor = obj.model
+        return obj

@@ -1,0 +1,159 @@
+"""TFOptimizer — train an arbitrary TF loss graph on the TPU engine.
+
+Parity: ``pyzoo/zoo/pipeline/api/net/tf_optimizer.py:331`` (class), with the
+``from_loss``:422 and ``from_keras``:495 constructors and ``optimize``:607.
+The reference exports graph+grad metadata to disk and replays it through
+TFTrainingHelper/GraphRunner (JNI session per iteration, weights assigned in
+and grads copied out every step — §3.3 of SURVEY.md). Here the loss graph is
+lowered once to jax; captured tf.Variables become SPMD-trained params and
+jax AD replaces the exported-gradient machinery entirely.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..common.zoo_trigger import MaxEpoch
+from ..pipeline.api.keras.engine.base import Input
+from ..pipeline.api.keras.models import Model as ZooModel
+from ..pipeline.api.net.tfnet import TFNet
+from .tf_bridge import lower_tf_callable
+from .tf_dataset import TFDataset
+
+
+class TFOptimizer:
+    """Minimizes a scalar TF loss over a TFDataset on the TPU engine."""
+
+    def __init__(self, lowered, dataset: TFDataset,
+                 optim_method=None, input_shapes=None, input_dtypes=None):
+        self.lowered = lowered
+        self.dataset = dataset
+        self.optim_method = optim_method or "adam"
+        self._input_shapes = input_shapes
+        self._input_dtypes = input_dtypes
+        self._zoo_model: Optional[ZooModel] = None
+        self._tfnet: Optional[TFNet] = None
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_loss(cls, loss_fn, dataset: TFDataset, variables=None,
+                  optim_method=None, **kw) -> "TFOptimizer":
+        """``loss_fn(*batch_tensors) -> scalar loss`` written in TF.
+
+        ``variables``: tf.Variables to train (default: all captured).
+        Reference signature takes a TF loss tensor + session; the tf2-era
+        equivalent is a callable + variable list.
+        """
+        import tensorflow as tf
+
+        from .tf_dataset import batch_arrays
+
+        batch = next(iter(dataset.feature_set.batches(
+            min(dataset.batch_size, max(1, len(dataset))), shuffle=False)))
+        arrays = batch_arrays(batch)
+        specs = [tf.TensorSpec((None,) + a.shape[1:], _tf_dtype(tf, a))
+                 for a in arrays]
+        if variables is None:
+            # trace once just to discover variables
+            traced = tf.function(loss_fn, autograph=False)
+            concrete = traced.get_concrete_function(*specs)
+            variables = list(concrete.variables)
+        lowered = lower_tf_callable(loss_fn, specs, variables=variables,
+                                    trainable=variables)
+        return cls(lowered, dataset, optim_method,
+                   input_shapes=[a.shape[1:] for a in arrays],
+                   input_dtypes=[a.dtype for a in arrays])
+
+    @classmethod
+    def from_keras(cls, keras_model, dataset: TFDataset,
+                   optim_method=None, **kw) -> "TFOptimizer":
+        """Compiled tf.keras model + TFDataset (tf_optimizer.py:495)."""
+        from .model import KerasModel
+
+        km = keras_model if isinstance(keras_model, KerasModel) \
+            else KerasModel(keras_model)
+        opt = cls.__new__(cls)
+        opt.lowered = None
+        opt.dataset = dataset
+        opt.optim_method = optim_method
+        opt._keras = km
+        opt._zoo_model = None
+        opt._tfnet = None
+        return opt
+
+    # ------------------------------------------------------------------
+    def _ensure_model(self) -> ZooModel:
+        if self._zoo_model is not None:
+            return self._zoo_model
+        net = TFNet(graph_fn=self.lowered.graph_fn)
+        net._imported = self.lowered.init_params()
+        self._tfnet = net
+        ins = [Input(shape=tuple(s), name=f"in{k}")
+               for k, s in enumerate(self._input_shapes)]
+        out = net(ins if len(ins) > 1 else ins[0])
+        if isinstance(out, tuple):
+            out = out[0]
+        model = ZooModel(ins, out)
+        model.compile(optimizer=self.optim_method, loss="identity")
+        self._zoo_model = model
+        return model
+
+    def optimize(self, end_trigger=None, batch_size: Optional[int] = None):
+        """Run the optimization loop (tf_optimizer.py:607)."""
+        if getattr(self, "_keras", None) is not None:
+            epochs = _trigger_epochs(end_trigger)
+            self._keras.fit(self.dataset, epochs=epochs)
+            return self
+        model = self._ensure_model()
+        fs = self.dataset.feature_set
+        # feed ALL batch arrays (features + labels) as model inputs; the
+        # graph computes the loss itself, trained with the identity loss.
+        from ..feature.feature_set import ArrayFeatureSet
+        arrays = [np.asarray(a) for a in _all_arrays(fs)]
+        fs = ArrayFeatureSet(arrays,
+                             [np.zeros((arrays[0].shape[0], 1), np.float32)])
+        trainer = model._ensure_trainer()
+        trainer.train(fs, batch_size=batch_size or self.dataset.batch_size,
+                      end_trigger=end_trigger or MaxEpoch(1))
+        host = {k: np.asarray(v)
+                for k, v in trainer.params.get(self._tfnet.name, {}).items()}
+        self.lowered.write_back(host)
+        return self
+
+
+def _tf_dtype(tf, a):
+    return tf.dtypes.as_dtype(np.asarray(a).dtype)
+
+
+def _all_arrays(fs) -> List[np.ndarray]:
+    """Features + labels of any FeatureSet as host arrays.
+
+    ArrayFeatureSet exposes them directly; Generator/Disk/Transformed
+    tiers are materialized by iterating one epoch of batches.
+    """
+    feats = list(getattr(fs, "features", []))
+    if feats:
+        return feats + list(getattr(fs, "labels", []) or [])
+    xs_parts, ys_parts = [], []
+    for mb in fs.batches(batch_size=256, drop_remainder=False):
+        xs_parts.append([np.asarray(a) for a in mb.inputs])
+        if mb.targets is not None:
+            ys = mb.targets if isinstance(mb.targets, tuple) else (mb.targets,)
+            ys_parts.append([np.asarray(a) for a in ys])
+    if not xs_parts:
+        raise ValueError(
+            f"{type(fs).__name__} produced no batches; cannot rebuild a "
+            "training array set from it")
+    out = [np.concatenate(cols) for cols in zip(*xs_parts)]
+    if ys_parts:
+        out += [np.concatenate(cols) for cols in zip(*ys_parts)]
+    return out
+
+
+def _trigger_epochs(end_trigger) -> int:
+    if end_trigger is None:
+        return 1
+    return int(getattr(end_trigger, "max_epoch", getattr(
+        end_trigger, "max", 1)))

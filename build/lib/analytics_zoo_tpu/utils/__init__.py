@@ -1,0 +1,3 @@
+from . import serialization, tensorboard
+
+__all__ = ["serialization", "tensorboard"]

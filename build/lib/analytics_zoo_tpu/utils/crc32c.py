@@ -1,0 +1,58 @@
+"""CRC32C (Castagnoli) — single shared implementation.
+
+Used by the TensorBoard event writer (``utils.tensorboard``) and the
+TFRecord codec (``feature.tfrecord``); both formats frame payloads with the
+masked CRC32C TensorFlow uses. A C++ implementation (``native/``) is picked
+up when built; this table-driven python fallback is always available.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+_MASK_DELTA = 0xA282EAD8
+_TABLE: Optional[List[int]] = None
+_NATIVE = None
+_NATIVE_TRIED = False
+
+
+def _table() -> List[int]:
+    global _TABLE
+    if _TABLE is None:
+        poly = 0x82F63B78
+        out = []
+        for i in range(256):
+            crc = i
+            for _ in range(8):
+                crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
+            out.append(crc)
+        _TABLE = out
+    return _TABLE
+
+
+def _native():
+    global _NATIVE, _NATIVE_TRIED
+    if not _NATIVE_TRIED:
+        _NATIVE_TRIED = True
+        try:
+            from .native_loader import load_zoo_data
+            _NATIVE = load_zoo_data()
+        except ImportError:
+            _NATIVE = None
+    return _NATIVE
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    lib = _native()
+    if lib is not None:
+        return lib.crc32c(data, crc)
+    table = _table()
+    crc ^= 0xFFFFFFFF
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def masked_crc(data: bytes) -> int:
+    crc = crc32c(data)
+    return ((crc >> 15 | crc << 17) + _MASK_DELTA) & 0xFFFFFFFF

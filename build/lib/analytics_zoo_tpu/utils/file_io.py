@@ -1,0 +1,152 @@
+"""Scheme-dispatching file IO (Utils/File parity).
+
+The reference routes all persistence through Hadoop-FS-aware helpers
+(``common/Utils.scala`` / ``utils/File.scala``: the same ``saveBytes`` /
+``readBytes`` works on ``file:``, ``hdfs:``, ``s3:`` URIs). TPU-native
+equivalent: one registry of filesystem handlers keyed by URI scheme.
+``file://`` / bare paths use the local filesystem; deployments register
+their store (GCS via ``gcsfs``, HDFS via ``pyarrow.fs`` ...) with
+:func:`register_filesystem` — this image has no egress, so no remote
+handler ships enabled, but every consumer (checkpoints, FeatureSet shards,
+model save/load) goes through this seam instead of ``open``.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import Callable, Dict, List, Tuple
+
+_SCHEMES: Dict[str, "FileSystem"] = {}
+
+
+class FileSystem:
+    """Minimal filesystem interface; subclass + register for remote FS."""
+
+    def open(self, path: str, mode: str = "rb"):
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def makedirs(self, path: str):
+        raise NotImplementedError
+
+    def listdir(self, path: str) -> List[str]:
+        raise NotImplementedError
+
+    def glob(self, pattern: str) -> List[str]:
+        raise NotImplementedError
+
+    def remove(self, path: str):
+        raise NotImplementedError
+
+    def rename(self, src: str, dst: str):
+        raise NotImplementedError
+
+
+class LocalFileSystem(FileSystem):
+    def open(self, path: str, mode: str = "rb"):
+        if "w" in mode or "a" in mode:
+            parent = os.path.dirname(os.path.abspath(path))
+            os.makedirs(parent, exist_ok=True)
+        return open(path, mode)
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def makedirs(self, path: str):
+        os.makedirs(path, exist_ok=True)
+
+    def listdir(self, path: str) -> List[str]:
+        return sorted(os.listdir(path))
+
+    def glob(self, pattern: str) -> List[str]:
+        return sorted(_glob.glob(pattern))
+
+    def remove(self, path: str):
+        os.remove(path)
+
+    def rename(self, src: str, dst: str):
+        os.replace(src, dst)
+
+
+def register_filesystem(scheme: str, fs: FileSystem):
+    """Install a handler for ``scheme://`` URIs (hdfs, gs, s3 ...)."""
+    _SCHEMES[scheme.lower()] = fs
+
+
+def split_scheme(uri: str) -> Tuple[str, str]:
+    if "://" in uri:
+        scheme, rest = uri.split("://", 1)
+        return scheme.lower(), rest
+    return "file", uri
+
+
+def get_filesystem(uri: str) -> Tuple[FileSystem, str]:
+    scheme, rest = split_scheme(uri)
+    if scheme == "file":
+        return _SCHEMES["file"], rest
+    fs = _SCHEMES.get(scheme)
+    if fs is None:
+        raise ValueError(
+            f"no filesystem registered for scheme {scheme!r} "
+            f"(register one with utils.file_io.register_filesystem; "
+            f"known: {sorted(_SCHEMES)})")
+    return fs, rest
+
+
+# module-level convenience (the Utils.File call surface)
+def open_file(uri: str, mode: str = "rb"):
+    fs, path = get_filesystem(uri)
+    return fs.open(path, mode)
+
+
+def exists(uri: str) -> bool:
+    fs, path = get_filesystem(uri)
+    return fs.exists(path)
+
+
+def makedirs(uri: str):
+    fs, path = get_filesystem(uri)
+    fs.makedirs(path)
+
+
+def glob(pattern: str) -> List[str]:
+    fs, path = get_filesystem(pattern)
+    scheme, _ = split_scheme(pattern)
+    prefix = "" if scheme == "file" else f"{scheme}://"
+    return [prefix + p for p in fs.glob(path)]
+
+
+def rename(src: str, dst: str):
+    """Atomic (where the backing store allows) replace of ``dst`` with
+    ``src``; both must be on the same filesystem scheme."""
+    fs, src_path = get_filesystem(src)
+    fs2, dst_path = get_filesystem(dst)
+    if fs is not fs2:
+        raise ValueError(f"cross-scheme rename: {src} -> {dst}")
+    fs.rename(src_path, dst_path)
+
+
+def remove(uri: str):
+    fs, path = get_filesystem(uri)
+    fs.remove(path)
+
+
+def listdir(uri: str) -> List[str]:
+    fs, path = get_filesystem(uri)
+    return fs.listdir(path)
+
+
+def read_bytes(uri: str) -> bytes:
+    with open_file(uri, "rb") as f:
+        return f.read()
+
+
+def write_bytes(uri: str, data: bytes):
+    with open_file(uri, "wb") as f:
+        f.write(data)
+
+
+register_filesystem("file", LocalFileSystem())

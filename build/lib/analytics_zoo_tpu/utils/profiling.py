@@ -1,0 +1,104 @@
+"""Profiling & timing utilities (SURVEY §5.1: "table stakes").
+
+The reference exposes per-phase times through BigDL ``Metrics`` accumulators
+threaded into the train loop (``Topology.scala:1184``) and ad-hoc
+``Utils.timeIt`` scopes (``TFTrainingHelper.scala:189``).  Here:
+
+* :func:`device_sync` — force completion of all dispatched work reachable
+  from an array.  On tunneled backends (axon) ``jax.block_until_ready`` can
+  return before the device finishes (it only waits for the *dispatch*), so
+  the only reliable barrier is a host transfer.  Every timing path in the
+  framework must sync through this, never ``block_until_ready``.
+* :func:`peak_flops` — public peak bf16 matmul FLOP/s per TPU generation,
+  used for MFU reporting.
+* :class:`ProfilerHook` — captures a ``jax.profiler`` trace of a step window
+  when ``ZooConfig.profile_dir`` is set.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import numpy as np
+
+logger = logging.getLogger("analytics_zoo_tpu.profiling")
+
+# chip peak bf16 matmul FLOPs by device_kind substring (public specs)
+PEAK_BF16 = [
+    ("v6", 918e12), ("v5p", 459e12), ("v5 lite", 197e12), ("v5e", 197e12),
+    ("v5litepod", 197e12), ("v5", 459e12), ("v4", 275e12), ("v3", 123e12),
+    ("v2", 46e12),
+]
+
+
+def peak_flops(device_kind: str):
+    """Peak bf16 matmul FLOPs for a device kind; ``ZOO_TPU_PEAK_FLOPS``
+    overrides (needed for MFU on backends without a table entry, and for
+    deterministic tests)."""
+    env = os.environ.get("ZOO_TPU_PEAK_FLOPS")
+    if env:
+        return float(env)
+    dk = (device_kind or "").lower()
+    for key, val in PEAK_BF16:
+        if key in dk:
+            return val
+    return None
+
+
+def device_sync(tree):
+    """Block until the computation producing ``tree`` has actually executed,
+    by pulling ONE scalar to the host (a 1-element device-side slice, so the
+    barrier costs one RTT, not a full-array transfer).
+
+    All leaves must come from the same dispatched program (e.g. a train
+    step's outputs): a PJRT execution materializes its output buffers
+    together, so one scalar is a barrier for the whole tree."""
+    import jax
+
+    leaves = [x for x in jax.tree.leaves(tree) if hasattr(x, "dtype")]
+    if not leaves:
+        return
+    leaf = leaves[0]
+    idx = (0,) * getattr(leaf, "ndim", 0)
+    _ = np.asarray(leaf[idx] if idx else leaf)
+
+
+class ProfilerHook:
+    """Start/stop a jax.profiler trace over a configured step window."""
+
+    def __init__(self, profile_dir, start_step, num_steps):
+        self.profile_dir = profile_dir
+        self.start_step = int(start_step)
+        self.stop_step = int(start_step) + int(num_steps)
+        self.active = False
+        self.done = False
+
+    def step(self, step: int):
+        import jax
+
+        if self.done:
+            return
+        if not self.active and step >= self.start_step:
+            try:
+                jax.profiler.start_trace(self.profile_dir)
+                self.active = True
+                logger.info("profiler trace started -> %s", self.profile_dir)
+            except Exception as e:  # backend may not support tracing
+                logger.warning("profiler unavailable: %s", e)
+                self.done = True
+                return
+        if self.active and step >= self.stop_step:
+            self.close()
+
+    def close(self):
+        import jax
+
+        if self.active:
+            try:
+                jax.profiler.stop_trace()
+                logger.info("profiler trace written to %s", self.profile_dir)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("profiler stop failed: %s", e)
+            self.active = False
+        self.done = True

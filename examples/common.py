@@ -30,6 +30,8 @@ def example_args(description, **extra):
     p.add_argument("--platform", choices=["cpu", "default"], default="cpu",
                    help="cpu (hermetic, default) or the environment's "
                         "default accelerator")
+    if extra.get("extra_args") is not None:
+        extra["extra_args"](p)
     args = p.parse_args()
     if args.platform == "cpu":
         os.environ.setdefault("JAX_PLATFORMS", "cpu")

@@ -29,6 +29,7 @@ EXAMPLES = [
     "variational_autoencoder.py",
     "fraud_detection.py",
     "image_augmentation.py",
+    "image_augmentation_3d.py",
     "image_similarity.py",
     "model_inference_pipeline.py",
 ]

@@ -1,0 +1,31 @@
+#!/bin/bash
+# Assemble the distributable (reference: /root/reference/make-dist.sh,
+# which collects jars + python zip + scripts into dist/). TPU-native
+# equivalent: wheel + native library + ops scripts + docs in dist/, plus
+# one tarball.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+rm -rf dist
+mkdir -p dist
+
+echo "== wheel"
+python -m pip wheel --no-deps --no-build-isolation -w dist .
+
+echo "== native"
+if make -C native >/dev/null 2>&1; then
+    cp native/build/*.so dist/ 2>/dev/null || true
+else
+    echo "   (native build skipped: no toolchain)"
+fi
+
+echo "== scripts + docs"
+mkdir -p dist/scripts dist/docs
+cp scripts/cluster-serving-* dist/scripts/
+cp -r docs/. dist/docs/
+
+echo "== tarball"
+tar czf dist/analytics-zoo-tpu-dist.tar.gz -C dist \
+    $(cd dist && ls *.whl) scripts docs
+ls -la dist/
+echo "dist assembled."

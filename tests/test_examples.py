@@ -23,6 +23,7 @@ ALL = FAST + ["recommendation_ncf.py", "text_classification.py",
               "image_finetune.py", "text_matching_knrm.py",
               "ray_reinforce.py", "variational_autoencoder.py",
               "fraud_detection.py", "image_augmentation.py",
+              "image_augmentation_3d.py",
               "image_similarity.py",
               "model_inference_pipeline.py"]
 

@@ -90,3 +90,54 @@ def test_feature_shards_over_remote_scheme(mockfs):
     assert fs.size() == 20
     batches = list(fs.batches(10, shuffle=False))
     np.testing.assert_array_equal(batches[0].inputs[0], local[0][0])
+
+
+def test_engine_checkpoint_over_remote_scheme(mockfs, monkeypatch):
+    """The FULL trainer checkpoint protocol (sharded: shards + manifests +
+    meta + commit + GC; and restore) must run against a registered remote
+    scheme end-to-end — the exact usage arrow_fs advertises."""
+    from analytics_zoo_tpu.common.nncontext import (ZooConfig, ZooContext,
+                                                    set_nncontext)
+    from analytics_zoo_tpu.common.zoo_trigger import MaxIteration
+    from analytics_zoo_tpu.feature.feature_set import ArrayFeatureSet
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    from analytics_zoo_tpu.pipeline.api.keras.models import Sequential
+    from analytics_zoo_tpu.utils import sharded_checkpoint as sc
+    import jax
+
+    monkeypatch.setenv("ZOO_TPU_SHARDED_CHECKPOINT", "1")
+    set_nncontext(None)
+    set_nncontext(ZooContext(ZooConfig(log_every_n_steps=1000)))
+    try:
+        model = Sequential()
+        model.add(Dense(8, activation="relu", input_shape=(4,)))
+        model.add(Dense(1))
+        model.compile(optimizer="adam", loss="mse")
+        trainer = model._ensure_trainer()
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((64, 4)).astype(np.float32)
+        y = rng.standard_normal((64, 1)).astype(np.float32)
+        trainer.train(ArrayFeatureSet([x], y), batch_size=32,
+                      end_trigger=MaxIteration(2))
+
+        ckpt = f"{mockfs}/remote_ckpt"
+        saved = jax.tree.map(lambda l: np.asarray(l), trainer.params)
+        trainer.save_checkpoint(ckpt)
+        assert sc.read_commit(ckpt) == "s2"
+        assert trainer.has_checkpoint(ckpt)
+
+        trainer.train(ArrayFeatureSet([x], y), batch_size=32,
+                      end_trigger=MaxIteration(4))
+        trainer.load_checkpoint(ckpt)
+        assert trainer.step == 2
+        restored = jax.tree.map(lambda l: np.asarray(l), trainer.params)
+        jax.tree.map(np.testing.assert_array_equal, restored, saved)
+
+        # overwrite in place on the remote scheme: GC + commit move
+        trainer.train(ArrayFeatureSet([x], y), batch_size=32,
+                      end_trigger=MaxIteration(3))
+        trainer.save_checkpoint(ckpt)
+        assert sc.read_commit(ckpt) == "s3"
+        assert not any(".s2." in f for f in file_io.listdir(ckpt))
+    finally:
+        set_nncontext(None)

@@ -1,0 +1,241 @@
+"""One-shot TPU measurement session (run the moment the tunnel is alive).
+
+Legs, each independently emitted to ``TPU_SESSION.jsonl`` as it finishes
+(tunnel deaths mid-session must not lose earlier legs — round-3 lesson):
+
+1. ``bench``      — the driver benchmark (``python bench.py``), first so a
+                    later tunnel death cannot cost the round its numbers.
+2. ``attn``       — flash-kernel vs XLA attention A/B (fwd+bwd train-step
+                    proxy) across sequence lengths, to re-tune
+                    ``KERNEL_MIN_SEQ`` now that the backward runs in the
+                    Pallas kernels too (r3 routing was measured with the
+                    O(L^2) recompute backward).
+3. ``resnet_layout`` — NCHW vs NHWC conv-tower proxy (XLA TPU layout
+                    assignment cost of the reference's "th" ordering).
+4. ``resnet_profile`` — ResNet-50 step decomposition: full step vs fwd
+                    vs BN-less fwd, infeed wait; optional profiler trace.
+
+Usage: python tools/tpu_perf_session.py [leg ...]   (default: all)
+"""
+
+import functools
+import json
+import math
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+import numpy as np
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))), "TPU_SESSION.jsonl")
+
+
+def emit(leg, payload):
+    rec = {"leg": leg, "t": round(time.time()), **payload}
+    with open(OUT, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print("EMIT", json.dumps(rec), flush=True)
+
+
+def leg_bench():
+    t0 = time.time()
+    proc = subprocess.run([sys.executable, "bench.py"],
+                          cwd=os.path.dirname(OUT), capture_output=True,
+                          text=True, timeout=2700)
+    line = (proc.stdout.strip().splitlines() or [""])[-1]
+    try:
+        parsed = json.loads(line)
+    except json.JSONDecodeError:
+        parsed = None
+    emit("bench", {"rc": proc.returncode, "seconds": round(time.time() - t0),
+                   "parsed": parsed,
+                   "stderr_tail": proc.stderr[-500:] if parsed is None
+                   else None})
+
+
+def _sync(x):
+    from analytics_zoo_tpu.utils.profiling import device_sync
+    device_sync(x)
+
+
+def _time_fn(fn, *args, iters=8, warmup=2):
+    import jax
+    for _ in range(warmup):
+        out = fn(*args)
+    _sync(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    _sync(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def leg_attn():
+    import jax
+    import jax.numpy as jnp
+
+    results = []
+    # (B, L) pairs with roughly constant tokens; BERT-base head geometry
+    for b, l in [(32, 512), (16, 1024), (8, 2048), (4, 4096)]:
+        h, d = 12, 64
+        rng = np.random.default_rng(0)
+        q = jnp.asarray(rng.standard_normal((b, h, l, d)), jnp.bfloat16)
+        bias = jnp.asarray(
+            (rng.random((b, 1, 1, l)) > 0.9) * -10000.0, jnp.float32)
+
+        row = {"B": b, "L": l}
+        for mode in ("xla", "kernel"):
+            try:
+                os.environ["ZOO_TPU_FORCE_PALLAS"] = \
+                    "1" if mode == "kernel" else "0"
+                os.environ["ZOO_TPU_DISABLE_PALLAS"] = \
+                    "1" if mode == "xla" else "0"
+                from analytics_zoo_tpu.ops import attention as A
+
+                def step(q):
+                    def l2(q):
+                        return (A.flash_attention(
+                            q, q, q, bias=bias).astype(jnp.float32)
+                            ** 2).mean()
+                    return jax.grad(l2)(q)
+
+                jit_step = jax.jit(step)
+                row[f"{mode}_ms"] = round(_time_fn(jit_step, q) * 1e3, 2)
+            except Exception as e:  # noqa: BLE001
+                row[f"{mode}_err"] = str(e).splitlines()[0][:200]
+            finally:
+                os.environ.pop("ZOO_TPU_FORCE_PALLAS", None)
+                os.environ.pop("ZOO_TPU_DISABLE_PALLAS", None)
+        results.append(row)
+        emit("attn", row)
+    emit("attn_summary", {"rows": results})
+
+
+def leg_resnet_layout():
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    b = 128
+    # 3-stage conv tower proxy (the resnet body shape classes)
+    specs = [(64, 2), (128, 2), (256, 2)]
+
+    def tower(x, kernels, dn):
+        for k, (f, s) in zip(kernels, specs):
+            x = jax.lax.conv_general_dilated(
+                x, k, (s, s), "SAME", dimension_numbers=dn)
+            x = jnp.maximum(x, 0)
+        return x.mean()
+
+    for fmt, dn, shape in [
+            ("NCHW", ("NCHW", "HWIO", "NCHW"), (b, 64, 112, 112)),
+            ("NHWC", ("NHWC", "HWIO", "NHWC"), (b, 112, 112, 64))]:
+        cin = 64
+        kernels = []
+        for f, _ in specs:
+            kernels.append(jnp.asarray(
+                rng.standard_normal((3, 3, cin, f)) * 0.05, jnp.bfloat16))
+            cin = f
+        x = jnp.asarray(rng.standard_normal(shape), jnp.bfloat16)
+        fn = jax.jit(functools.partial(tower, dn=dn))
+        try:
+            ms = _time_fn(lambda x: fn(x, kernels), x) * 1e3
+            emit("resnet_layout", {"format": fmt, "ms": round(ms, 2)})
+        except Exception as e:  # noqa: BLE001
+            emit("resnet_layout", {"format": fmt,
+                                   "err": str(e).splitlines()[0][:200]})
+
+
+def leg_resnet_profile():
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.common.nncontext import (ZooConfig, ZooContext,
+                                                    set_nncontext)
+    from analytics_zoo_tpu.feature.feature_set import ArrayFeatureSet
+    from analytics_zoo_tpu.models.image.imageclassification import \
+        ImageClassifier
+
+    set_nncontext(None)
+    set_nncontext(ZooContext(ZooConfig(compute_dtype="bfloat16")))
+    batch = 128
+    clf = ImageClassifier(class_num=1000, model_name="resnet-50")
+    clf.compile(optimizer="sgd", loss="sparse_categorical_crossentropy")
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((batch, 3, 224, 224)).astype(np.float32)
+    y = rng.integers(0, 1000, (batch,)).astype(np.int32)
+    trainer = clf.model._ensure_trainer()
+    trainer.ensure_initialized()
+    fs = ArrayFeatureSet([x], y)
+    host_batch = next(iter(fs.batches(batch)))
+    dev_batch = trainer._put_batch(host_batch)
+    step = trainer.build_train_step()
+
+    def full(params, opt_state, net_state):
+        return step(params, opt_state, net_state, dev_batch, 0)
+
+    # full train step (no donation reuse issues: rebind each call)
+    p, o, s = trainer.params, trainer.opt_state, trainer.net_state
+    times = []
+    for _ in range(6):
+        t0 = time.perf_counter()
+        p, o, s, logs = step(p, o, s, dev_batch, 0)
+        _sync(logs["loss"])
+        times.append(time.perf_counter() - t0)
+    emit("resnet_profile", {"what": "train_step_ms",
+                            "ms": round(sorted(times)[len(times) // 2]
+                                        * 1e3, 2)})
+
+    # forward only
+    predict = trainer.build_predict_step()
+    fwd_ms = _time_fn(lambda: predict(p, s, dev_batch[0]), iters=6) * 1e3
+    emit("resnet_profile", {"what": "fwd_ms", "ms": round(fwd_ms, 2)})
+
+    # infeed: host->device transfer of one batch
+    t0 = time.perf_counter()
+    for _ in range(4):
+        db = trainer._put_batch(host_batch)
+        _sync(db[0][0])
+    emit("resnet_profile", {"what": "infeed_ms",
+                            "ms": round((time.perf_counter() - t0) / 4
+                                        * 1e3, 2)})
+
+    # optional trace
+    trace_dir = os.path.join(os.path.dirname(OUT), "resnet_trace")
+    try:
+        with jax.profiler.trace(trace_dir):
+            p, o, s, logs = step(p, o, s, dev_batch, 0)
+            _sync(logs["loss"])
+        emit("resnet_profile", {"what": "trace", "dir": trace_dir})
+    except Exception as e:  # noqa: BLE001
+        emit("resnet_profile", {"what": "trace",
+                                "err": str(e).splitlines()[0][:200]})
+
+
+LEGS = {"bench": leg_bench, "attn": leg_attn,
+        "resnet_layout": leg_resnet_layout,
+        "resnet_profile": leg_resnet_profile}
+
+
+def main():
+    want = sys.argv[1:] or list(LEGS)
+    import jax
+    d = jax.devices()[0]
+    emit("session_start", {"platform": d.platform,
+                           "device_kind": d.device_kind})
+    for name in want:
+        try:
+            LEGS[name]()
+        except Exception as e:  # noqa: BLE001
+            import traceback
+            traceback.print_exc()
+            emit(name, {"err": str(e).splitlines()[0][:300]})
+
+
+if __name__ == "__main__":
+    main()
