@@ -49,9 +49,11 @@ class ImageConfigure:
 
 
 def imagenet_preprocess(size: int = 224,
-                        mean=(123.68, 116.779, 103.939)) -> Preprocessing:
+                        mean=(123.68, 116.779, 103.939),
+                        format: str = "NCHW") -> Preprocessing:
     """Standard imagenet eval chain: resize-256 → center-crop → normalize
-    → NCHW tensor (the reference's default classifier preprocessing).
+    → NCHW (or NHWC) tensor (the reference's default classifier
+    preprocessing).
 
     The resize edge scales with the crop (256/224 ratio) so crops larger
     than 256 still fit inside the resized image."""
@@ -60,7 +62,7 @@ def imagenet_preprocess(size: int = 224,
         ImageResize(edge, edge),
         ImageCenterCrop(size, size),
         ImageChannelNormalize(*mean),
-        ImageMatToTensor(format="NCHW"),
+        ImageMatToTensor(format=format),
         ImageSetToSample(),
     ])
 

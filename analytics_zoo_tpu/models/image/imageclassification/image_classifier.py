@@ -33,10 +33,12 @@ def _backbone(name):
 
 
 def _conv_bn(x, filters, k, stride=1, pad="same", name=None,
-             activation="relu"):
+             activation="relu", fmt="th"):
     x = Convolution2D(filters, k, k, subsample=(stride, stride),
-                      border_mode=pad, bias=False, name=name)(x)
-    x = BatchNormalization(name=None if name is None else name + "_bn")(x)
+                      border_mode=pad, bias=False, name=name,
+                      dim_ordering=fmt)(x)
+    x = BatchNormalization(axis=1 if fmt == "th" else -1,
+                           name=None if name is None else name + "_bn")(x)
     if activation:
         x = Activation(activation)(x)
     return x
@@ -102,33 +104,47 @@ def _mobilenet(class_num, shape=(3, 224, 224), alpha=1.0):
     return Model(inp, out)
 
 
-def _res_block(x, filters, stride=1, conv_shortcut=False):
+def _res_block(x, filters, stride=1, conv_shortcut=False, fmt="th"):
+    bn_axis = 1 if fmt == "th" else -1
     shortcut = x
     if conv_shortcut:
         shortcut = Convolution2D(4 * filters, 1, 1,
                                  subsample=(stride, stride),
-                                 bias=False)(x)
-        shortcut = BatchNormalization()(shortcut)
-    y = _conv_bn(x, filters, 1, stride=stride)
-    y = _conv_bn(y, filters, 3, pad="same")
-    y = Convolution2D(4 * filters, 1, 1, bias=False)(y)
-    y = BatchNormalization()(y)
+                                 bias=False, dim_ordering=fmt)(x)
+        shortcut = BatchNormalization(axis=bn_axis)(shortcut)
+    y = _conv_bn(x, filters, 1, stride=stride, fmt=fmt)
+    y = _conv_bn(y, filters, 3, pad="same", fmt=fmt)
+    y = Convolution2D(4 * filters, 1, 1, bias=False, dim_ordering=fmt)(y)
+    y = BatchNormalization(axis=bn_axis)(y)
     y = Add()([y, shortcut])
     return Activation("relu")(y)
 
 
 @_backbone("resnet-50")
-def _resnet50(class_num, shape=(3, 224, 224)):
+def _resnet50(class_num, shape=(3, 224, 224), data_format="th"):
+    """data_format "tf" builds the NHWC variant (input (224, 224, 3)):
+    XLA TPU's native conv layout, so no per-conv relayouts — an on-chip
+    A/B knob for the conv-layout cost of the reference's NCHW ordering
+    (tools/tpu_perf_session.py leg ``resnet_layout``)."""
+    fmt = "tf" if str(data_format).lower() in ("tf", "nhwc", "channels_last") \
+        else "th"
+    shape = tuple(shape)
+    if fmt == "tf" and shape[0] in (1, 3) and shape[-1] not in (1, 3):
+        # a clearly channels-first shape with the NHWC format: swap rather
+        # than silently building H=3 W=96 C=96 nonsense
+        shape = shape[1:] + shape[:1]
     inp = Input(shape=shape)
-    x = ZeroPadding2D((3, 3))(inp)
-    x = _conv_bn(x, 64, 7, stride=2, pad="valid")
-    x = MaxPooling2D((3, 3), strides=(2, 2), border_mode="same")(x)
+    x = ZeroPadding2D((3, 3), dim_ordering=fmt)(inp)
+    x = _conv_bn(x, 64, 7, stride=2, pad="valid", fmt=fmt)
+    x = MaxPooling2D((3, 3), strides=(2, 2), border_mode="same",
+                     dim_ordering=fmt)(x)
     for stage, (filters, blocks) in enumerate(
             [(64, 3), (128, 4), (256, 6), (512, 3)]):
         for b in range(blocks):
             stride = 2 if (b == 0 and stage > 0) else 1
-            x = _res_block(x, filters, stride=stride, conv_shortcut=(b == 0))
-    x = GlobalAveragePooling2D()(x)
+            x = _res_block(x, filters, stride=stride,
+                           conv_shortcut=(b == 0), fmt=fmt)
+    x = GlobalAveragePooling2D(dim_ordering=fmt)(x)
     out = Dense(class_num, activation="softmax")(x)
     return Model(inp, out)
 
@@ -167,32 +183,57 @@ class ImageClassifier(ImageModel):
 
     def __init__(self, class_num: int = 1000, model_name: str = "resnet-50",
                  dataset: str = "imagenet", input_shape=None,
-                 label_map: Optional[dict] = None):
+                 label_map: Optional[dict] = None, data_format: str = "th"):
         key = model_name.lower()
         if key not in backbones:
             raise ValueError(
                 f"unknown model {model_name}; have {sorted(backbones)}")
+        fmt = _norm_format(data_format)
+        if fmt == "tf" and key != "resnet-50":
+            raise ValueError(
+                "data_format='tf' (NHWC) is only supported for resnet-50; "
+                f"{key} builds NCHW")
         self._record_config(class_num=class_num, model_name=key,
-                            dataset=dataset, input_shape=input_shape)
+                            dataset=dataset, input_shape=input_shape,
+                            data_format=fmt)
         kwargs = {} if input_shape is None else {"shape": tuple(input_shape)}
+        if fmt == "tf":
+            kwargs["data_format"] = "tf"
         self.model = backbones[key](class_num, **kwargs)
         self.config = ImageConfigure(
-            pre_processor=_default_preprocess(key, input_shape),
+            pre_processor=_default_preprocess(key, input_shape, fmt),
             post_processor=LabelOutput(label_map))
 
     @classmethod
     def load_model(cls, path, weight_path=None):
         obj = super().load_model(path, weight_path)
         obj.config = ImageConfigure(
-            pre_processor=_default_preprocess(obj.model_name,
-                                              obj.input_shape),
+            pre_processor=_default_preprocess(
+                obj.model_name, obj.input_shape,
+                getattr(obj, "data_format", "th")),
             post_processor=LabelOutput(None))
         return obj
 
 
-def _default_preprocess(key: str, input_shape):
-    """Crop size follows the graph's actual input, not a fixed 224."""
+def _norm_format(data_format: str) -> str:
+    fmt = str(data_format).lower()
+    if fmt in ("th", "nchw", "channels_first"):
+        return "th"
+    if fmt in ("tf", "nhwc", "channels_last"):
+        return "tf"
+    raise ValueError(f"unknown data_format {data_format!r}; "
+                     "use 'th'/'NCHW' or 'tf'/'NHWC'")
+
+
+def _default_preprocess(key: str, input_shape, fmt: str = "th"):
+    """Crop size follows the graph's actual input, not a fixed 224; the
+    emitted tensor layout follows the graph's data format."""
     if key == "lenet":
         return None
-    size = 224 if input_shape is None else int(input_shape[-1])
-    return imagenet_preprocess(size)
+    if input_shape is None:
+        size = 224
+    else:
+        # crop is square; take the spatial edge for either layout
+        size = int(input_shape[-1] if fmt == "th" else input_shape[0])
+    return imagenet_preprocess(
+        size, format="NCHW" if fmt == "th" else "NHWC")

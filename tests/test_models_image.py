@@ -139,3 +139,49 @@ class TestSSD:
         if len(rows):
             assert rows[:, [2, 4]].max() <= 200 + 1e-3
             assert rows[:, [3, 5]].max() <= 100 + 1e-3
+
+
+def test_resnet50_nhwc_variant_matches_nchw():
+    """data_format="tf" builds the NHWC resnet (XLA TPU's native conv
+    layout). Same HWIO kernels + per-channel BN -> with weights copied
+    leaf-for-leaf, outputs must match the NCHW variant on transposed
+    input."""
+    import jax
+    import numpy as np
+
+    from analytics_zoo_tpu.models.image.imageclassification import \
+        ImageClassifier
+
+    from analytics_zoo_tpu.pipeline.api.keras.engine import base as _base
+
+    # identical auto-names in both builds -> identical param tree keys,
+    # so weights copy leaf-for-leaf
+    saved = dict(_base._name_counters)
+    _base._name_counters.clear()
+    a = ImageClassifier(class_num=10, model_name="resnet-50",
+                        input_shape=(3, 64, 64))
+    _base._name_counters.clear()
+    b = ImageClassifier(class_num=10, model_name="resnet-50",
+                        input_shape=(64, 64, 3), data_format="tf")
+    _base._name_counters.clear()
+    _base._name_counters.update(saved)
+    ta = a.model._ensure_trainer()
+    tb = b.model._ensure_trainer()
+    ta.ensure_initialized()
+    tb.ensure_initialized()
+    la, da = jax.tree_util.tree_flatten(ta.params)
+    lb, db_ = jax.tree_util.tree_flatten(tb.params)
+    assert [x.shape for x in la] == [x.shape for x in lb]
+    # net_state trees must align leaf-for-leaf (BN moving stats) —
+    # captured BEFORE tb's state is overwritten below
+    sa = jax.tree_util.tree_leaves(ta.net_state)
+    sb = jax.tree_util.tree_leaves(tb.net_state)
+    assert [x.shape for x in sa] == [x.shape for x in sb]
+    tb.set_params(jax.tree_util.tree_unflatten(db_, la),
+                  jax.tree.map(lambda x: x, ta.net_state))
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 3, 64, 64)).astype(np.float32)
+    pa = np.asarray(a.model.predict(x, batch_size=2))
+    pb = np.asarray(b.model.predict(x.transpose(0, 2, 3, 1), batch_size=2))
+    np.testing.assert_allclose(pa, pb, rtol=1e-4, atol=1e-5)

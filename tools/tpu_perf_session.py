@@ -151,9 +151,8 @@ def leg_resnet_layout():
                                    "err": str(e).splitlines()[0][:200]})
 
 
-def leg_resnet_profile():
+def _resnet_step_times(data_format, batch=128, with_extras=False):
     import jax
-    import jax.numpy as jnp
 
     from analytics_zoo_tpu.common.nncontext import (ZooConfig, ZooContext,
                                                     set_nncontext)
@@ -163,11 +162,13 @@ def leg_resnet_profile():
 
     set_nncontext(None)
     set_nncontext(ZooContext(ZooConfig(compute_dtype="bfloat16")))
-    batch = 128
-    clf = ImageClassifier(class_num=1000, model_name="resnet-50")
+    clf = ImageClassifier(class_num=1000, model_name="resnet-50",
+                          data_format=data_format)
     clf.compile(optimizer="sgd", loss="sparse_categorical_crossentropy")
     rng = np.random.default_rng(0)
-    x = rng.standard_normal((batch, 3, 224, 224)).astype(np.float32)
+    shape = (batch, 3, 224, 224) if data_format == "th" \
+        else (batch, 224, 224, 3)
+    x = rng.standard_normal(shape).astype(np.float32)
     y = rng.integers(0, 1000, (batch,)).astype(np.int32)
     trainer = clf.model._ensure_trainer()
     trainer.ensure_initialized()
@@ -176,10 +177,6 @@ def leg_resnet_profile():
     dev_batch = trainer._put_batch(host_batch)
     step = trainer.build_train_step()
 
-    def full(params, opt_state, net_state):
-        return step(params, opt_state, net_state, dev_batch, 0)
-
-    # full train step (no donation reuse issues: rebind each call)
     p, o, s = trainer.params, trainer.opt_state, trainer.net_state
     times = []
     for _ in range(6):
@@ -187,25 +184,27 @@ def leg_resnet_profile():
         p, o, s, logs = step(p, o, s, dev_batch, 0)
         _sync(logs["loss"])
         times.append(time.perf_counter() - t0)
-    emit("resnet_profile", {"what": "train_step_ms",
-                            "ms": round(sorted(times)[len(times) // 2]
-                                        * 1e3, 2)})
+    step_ms = sorted(times)[len(times) // 2] * 1e3
+    emit("resnet_profile", {"fmt": data_format, "what": "train_step_ms",
+                            "ms": round(step_ms, 2),
+                            "mfu_197T": round(3 * 2 * 4.09e9 * batch /
+                                              (step_ms / 1e3) / 197e12, 3)})
+    if not with_extras:
+        return
 
-    # forward only
     predict = trainer.build_predict_step()
     fwd_ms = _time_fn(lambda: predict(p, s, dev_batch[0]), iters=6) * 1e3
-    emit("resnet_profile", {"what": "fwd_ms", "ms": round(fwd_ms, 2)})
+    emit("resnet_profile", {"fmt": data_format, "what": "fwd_ms",
+                            "ms": round(fwd_ms, 2)})
 
-    # infeed: host->device transfer of one batch
     t0 = time.perf_counter()
     for _ in range(4):
         db = trainer._put_batch(host_batch)
         _sync(db[0][0])
-    emit("resnet_profile", {"what": "infeed_ms",
+    emit("resnet_profile", {"fmt": data_format, "what": "infeed_ms",
                             "ms": round((time.perf_counter() - t0) / 4
                                         * 1e3, 2)})
 
-    # optional trace
     trace_dir = os.path.join(os.path.dirname(OUT), "resnet_trace")
     try:
         with jax.profiler.trace(trace_dir):
@@ -215,6 +214,17 @@ def leg_resnet_profile():
     except Exception as e:  # noqa: BLE001
         emit("resnet_profile", {"what": "trace",
                                 "err": str(e).splitlines()[0][:200]})
+
+
+def leg_resnet_profile():
+    # NCHW (the reference ordering, current bench path) with the full
+    # decomposition, then the NHWC variant head-to-head
+    _resnet_step_times("th", with_extras=True)
+    try:
+        _resnet_step_times("tf")
+    except Exception as e:  # noqa: BLE001
+        emit("resnet_profile", {"fmt": "tf",
+                                "err": str(e).splitlines()[0][:300]})
 
 
 LEGS = {"bench": leg_bench, "attn": leg_attn,
