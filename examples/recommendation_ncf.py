@@ -34,13 +34,18 @@ def implicit_interactions(n_users=150, n_items=80, pos_per_user=6,
     return positives, n_users, n_items
 
 
-def hit_rate_ndcg(ncf, holdout, negatives, batch_size, k=10):
-    """Rank each user's held-out positive among sampled negatives; the
-    NCF paper's HR@K / NDCG@K."""
+N_NEG = 50      # sampled negatives per user at evaluation
+TOP_K = 10      # HR@K / NDCG@K cut
+
+
+def hit_rate_ndcg(ncf, user_ids, holdout, negatives, batch_size, k=TOP_K):
+    """Rank each user's held-out positive among its sampled negatives; the
+    NCF paper's HR@K / NDCG@K. Ties rank PESSIMISTICALLY (a constant-
+    output model must score at the random baseline, not 1.0)."""
     users, items, owners = [], [], []
-    for u, (pos, negs) in enumerate(zip(holdout, negatives)):
+    for uid, pos, negs in zip(user_ids, holdout, negatives):
         cand = [pos] + list(negs)
-        users.extend([u + 1] * len(cand))
+        users.extend([uid] * len(cand))
         items.extend(cand)
         owners.append(len(cand))
     x = np.stack([np.array(users, np.float32),
@@ -50,7 +55,8 @@ def hit_rate_ndcg(ncf, holdout, negatives, batch_size, k=10):
     off = 0
     for n_cand in owners:
         scores = probs[off:off + n_cand]
-        rank = int((scores > scores[0]).sum()) + 1   # held-out is index 0
+        # held-out is index 0; ties with negatives count against it
+        rank = int((scores[1:] >= scores[0]).sum()) + 1
         if rank <= k:
             hr += 1.0
             ndcg += 1.0 / np.log2(rank + 1)
@@ -95,7 +101,7 @@ def main():
         holdout.append(held)
         pos_set = set(pos_items)
         pool = np.array([i for i in all_items if i not in pos_set])
-        negatives.append(rng.choice(pool, size=50, replace=False))
+        negatives.append(rng.choice(pool, size=N_NEG, replace=False))
         for it in pos_items[:-1]:
             train_u.append(u)
             train_i.append(it)
@@ -116,10 +122,11 @@ def main():
                 loss="sparse_categorical_crossentropy")
     imp.fit(xt, yt, batch_size=args.batch_size, nb_epoch=args.epochs)
 
-    hr, ndcg = hit_rate_ndcg(imp, holdout, negatives, args.batch_size)
-    rand_hr = 10 / 51
-    print(f"leave-one-out HR@10 {hr:.3f} NDCG@10 {ndcg:.3f} "
-          f"(random baseline HR@10 {rand_hr:.3f})")
+    hr, ndcg = hit_rate_ndcg(imp, list(positives), holdout, negatives,
+                             args.batch_size)
+    rand_hr = TOP_K / (N_NEG + 1)
+    print(f"leave-one-out HR@{TOP_K} {hr:.3f} NDCG@{TOP_K} {ndcg:.3f} "
+          f"(random baseline HR@{TOP_K} {rand_hr:.3f})")
     assert hr > rand_hr * 1.5, hr   # must clearly beat random ranking
     print("NCF example OK")
 
