@@ -61,6 +61,11 @@ class ZooConfig:
     profile_dir: Optional[str] = None
     profile_start_step: int = 10
     profile_num_steps: int = 5
+    # write flat checkpoints on a background thread (single-process only;
+    # the snapshot is taken synchronously, serialization + file IO move
+    # off the training hot path). Multi-host formats stay synchronous —
+    # they are barrier-sequenced.
+    async_checkpoint: bool = False
     # NNFrames ingest: when the processed samples of a DataFrame would
     # exceed this many bytes, NNEstimator.fit spills them to sharded .npz
     # files and streams (ShardedFileFeatureSet) instead of holding the

@@ -72,6 +72,21 @@ def _iteration_granularity_all(record: TrainRecord, *triggers) -> int:
     return max(1, min(_iteration_granularity(t, record) for t in triggers))
 
 
+_CKPT_POOL = None
+
+
+def _checkpoint_writer_pool():
+    """One process-wide single-worker pool for async checkpoint writes:
+    serializes writes globally (they are disk-bound anyway) and caps the
+    thread cost at one, however many trainers a process builds."""
+    global _CKPT_POOL
+    if _CKPT_POOL is None:
+        import concurrent.futures
+        _CKPT_POOL = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="zoo-ckpt-writer")
+    return _CKPT_POOL
+
+
 class GradientClipping:
     """Constant / L2-norm clipping, parity with
     ``setConstantGradientClipping`` / ``setGradientClippingByL2Norm``
@@ -484,6 +499,13 @@ class SPMDTrainer:
                                 validation_trigger, end_trigger)
             except (jax.errors.JaxRuntimeError, RuntimeError) as e:
                 retries += 1
+                # an in-flight async write may be the checkpoint we need:
+                # land it before deciding whether retry is possible
+                try:
+                    self.wait_for_checkpoint()
+                except Exception:  # noqa: BLE001 - the write itself failed
+                    logger.warning("pending checkpoint write failed",
+                                   exc_info=True)
                 has_ckpt = self.checkpoint_dir is not None and \
                     self.has_checkpoint(self.checkpoint_dir)
                 if retries > max_retries or not has_ckpt:
@@ -493,6 +515,9 @@ class SPMDTrainer:
                                max_retries)
                 self.load_checkpoint(self.checkpoint_dir)
                 record.epoch, record.iteration = self.epoch, self.step
+        # an async checkpoint still in flight must be durable before
+        # train() reports completion
+        self.wait_for_checkpoint()
         return record
 
     def _run_epoch(self, train_set, batch_size, step_fn, record,
@@ -882,42 +907,93 @@ class SPMDTrainer:
         return file_io.exists(os.path.join(directory, "model.npz")) or \
             self._sharded_available(directory)
 
+    @staticmethod
+    def _write_flat_checkpoint(directory, params_np, state_np, opt_leaves,
+                               step, epoch):
+        """Serialize + atomically publish one flat checkpoint from HOST
+        snapshots (no trainer state touched — safe on a writer thread)."""
+        file_io.makedirs(directory)
+        # write to temp names + atomic rename so a reader (retry path
+        # on another process) can never observe a half-written file.
+        # Temp names keep the .npz suffix (save_leaves appends it
+        # otherwise) and the .treedef sidecars rename along.
+        for fname, writer, sidecars in (
+                ("model.npz", lambda p: serialization.save_pytree(
+                    p, {"params": params_np, "state": state_np}),
+                 (".treedef",)),
+                ("optim.npz", lambda p: serialization.save_leaves(
+                    p, opt_leaves), ()),
+                ("meta.npz", lambda p: serialization.save_pytree(
+                    p, {"step": np.asarray(step),
+                        "epoch": np.asarray(epoch)}),
+                 (".treedef",))):
+            tmp = os.path.join(directory, fname + ".tmp.npz")
+            writer(tmp)
+            final = os.path.join(directory, fname)
+            for suffix in sidecars:
+                file_io.rename(tmp + suffix, final + suffix)
+            file_io.rename(tmp, final)
+        logger.info("checkpoint saved to %s @step %d", directory, step)
+
+    def _flat_snapshot(self, copy: bool):
+        """Host snapshot of the trainer state. ``copy=True`` forces owned
+        buffers: np.asarray can be a zero-copy VIEW of the device buffer
+        on the CPU backend, and with donate_buffers the next dispatched
+        step overwrites exactly those buffers — an async writer racing
+        that would serialize a mix of two steps. The guard in
+        serialization._to_host_array stays in the path (directed error
+        for misclassified multi-host leaves)."""
+        def snap(leaf):
+            arr = serialization._to_host_array(leaf)
+            return np.array(arr, copy=True) if copy else arr
+
+        return (jax.tree.map(snap, self.params),
+                jax.tree.map(snap, self.net_state),
+                jax.tree.map(snap, self.opt_state),
+                self.step, self.epoch)
+
+    def wait_for_checkpoint(self):
+        """Join a pending async checkpoint write; re-raises its error."""
+        fut, self._ckpt_future = getattr(self, "_ckpt_future", None), None
+        if fut is not None:
+            fut.result()
+
+    def _async_ckpt_eligible(self) -> bool:
+        """Async applies to the single-process flat format only: the
+        multi-host protocols are barrier-sequenced, and a barrier on a
+        writer thread would deadlock against the main thread's
+        collectives."""
+        return (self.ctx.config.async_checkpoint and
+                jax.process_count() == 1)
+
     def save_checkpoint(self, directory: Optional[str] = None):
         directory = directory or self.checkpoint_dir
         if directory is None:
             raise ValueError("no checkpoint dir set")
+        # one writer at a time per trainer: a still-running previous write
+        # must finish (and surface its error) before the next snapshot
+        self.wait_for_checkpoint()
         if self._needs_sharded_ckpt():
             self._save_checkpoint_sharded(directory)
             return
         if jax.process_index() == 0:
-            file_io.makedirs(directory)
-            # write to temp names + atomic rename so a reader (retry path
-            # on another process) can never observe a half-written file.
-            # Temp names keep the .npz suffix (save_leaves appends it
-            # otherwise) and the .treedef sidecars rename along.
-            for fname, writer, sidecars in (
-                    ("model.npz", lambda p: serialization.save_pytree(
-                        p, {"params": serialization.tree_to_numpy(
-                            self.params),
-                            "state": serialization.tree_to_numpy(
-                            self.net_state)}), (".treedef",)),
-                    ("optim.npz", lambda p: serialization.save_leaves(
-                        p, self.opt_state), ()),
-                    ("meta.npz", lambda p: serialization.save_pytree(
-                        p, {"step": np.asarray(self.step),
-                            "epoch": np.asarray(self.epoch)}),
-                     (".treedef",))):
-                tmp = os.path.join(directory, fname + ".tmp.npz")
-                writer(tmp)
-                final = os.path.join(directory, fname)
-                for suffix in sidecars:
-                    file_io.rename(tmp + suffix, final + suffix)
-                file_io.rename(tmp, final)
-            logger.info("checkpoint saved to %s @step %d", directory,
-                        self.step)
+            use_async = self._async_ckpt_eligible()
+            snapshot = self._flat_snapshot(copy=use_async)
+            if use_async:
+                # device->host transfer + copy happened above
+                # (synchronous, it must see THIS step's state and own its
+                # bytes — donation reuses the device buffers next step);
+                # serialization + file IO — the stall the hot loop cares
+                # about — moves off-thread
+                self._ckpt_future = _checkpoint_writer_pool().submit(
+                    self._write_flat_checkpoint, directory, *snapshot)
+            else:
+                self._write_flat_checkpoint(directory, *snapshot)
         self._barrier("zoo_ckpt_save")
 
     def load_checkpoint(self, directory: str):
+        # a pending async write to this (or any) dir must land first
+        self.wait_for_checkpoint()
         # writer (process 0) must have finished before anyone reads
         self._barrier("zoo_ckpt_load")
         if self._sharded_available(directory) and \

@@ -308,3 +308,63 @@ def test_mfu_scalar_emitted_for_plain_fit(tmp_path, monkeypatch):
         assert mfu, "no MFU scalar in the train event file"
     finally:
         set_nncontext(None)
+
+
+def test_async_checkpoint(tmp_path):
+    """async_checkpoint=True: save_checkpoint snapshots synchronously but
+    writes on a background thread; wait_for_checkpoint / train() join it;
+    the result is byte-identical to a synchronous save and restorable."""
+    import numpy as np
+    from analytics_zoo_tpu.common.nncontext import (ZooConfig, ZooContext,
+                                                    set_nncontext)
+    from analytics_zoo_tpu.common.zoo_trigger import (MaxIteration,
+                                                      SeveralIteration)
+    from analytics_zoo_tpu.feature.feature_set import ArrayFeatureSet
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    from analytics_zoo_tpu.pipeline.api.keras.models import Sequential
+
+    set_nncontext(None)
+    set_nncontext(ZooContext(ZooConfig(async_checkpoint=True,
+                                       log_every_n_steps=1000)))
+    try:
+        model = Sequential()
+        model.add(Dense(8, activation="relu", input_shape=(4,)))
+        model.add(Dense(1))
+        model.compile(optimizer="adam", loss="mse")
+        trainer = model._ensure_trainer()
+        trainer.checkpoint_dir = str(tmp_path)
+
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((64, 4)).astype(np.float32)
+        y = rng.standard_normal((64, 1)).astype(np.float32)
+        # trigger-driven saves inside the loop ride the writer thread
+        trainer.train(ArrayFeatureSet([x], y), batch_size=16,
+                      end_trigger=MaxIteration(8),
+                      checkpoint_trigger=SeveralIteration(2))
+        # train() returned -> the last write is durable
+        assert trainer.has_checkpoint(str(tmp_path))
+
+        import jax
+        saved = jax.tree.map(lambda l: np.asarray(l), trainer.params)
+        trainer.save_checkpoint(str(tmp_path))
+        trainer.wait_for_checkpoint()
+        trainer.train(ArrayFeatureSet([x], y), batch_size=16,
+                      end_trigger=MaxIteration(10))
+        trainer.load_checkpoint(str(tmp_path))
+        assert trainer.step == 8
+        restored = jax.tree.map(lambda l: np.asarray(l), trainer.params)
+        jax.tree.map(np.testing.assert_array_equal, restored, saved)
+
+        # a failing write surfaces on the next join, not silently
+        def boom(*a, **kw):
+            raise OSError("disk full")
+
+        orig = trainer._write_flat_checkpoint
+        trainer._write_flat_checkpoint = boom
+        trainer.save_checkpoint(str(tmp_path))
+        import pytest
+        with pytest.raises(OSError, match="disk full"):
+            trainer.wait_for_checkpoint()
+        trainer._write_flat_checkpoint = orig
+    finally:
+        set_nncontext(None)
