@@ -101,3 +101,58 @@ class ZooModel:
 
 
 KerasZooModel = ZooModel
+
+
+class Ranker:
+    """Validation with ranking metrics for matching models (parity:
+    ``pyzoo/zoo/models/common/ranker.py`` ``evaluateNDCG``/``evaluateMAP``
+    — each TextFeature in the TextSet holds ONE query's candidate batch:
+    features ``(listLength, d)``, labels ``(listLength, 1)``, exactly what
+    ``TextSet.from_relation_lists`` builds). Mix into a model exposing
+    ``predict``.
+    """
+
+    def _ranking_groups(self, x):
+        if hasattr(x, "features"):           # a TextSet
+            for tf_ in x.features:
+                sample = tf_.get_sample()
+                assert sample is not None, \
+                    "TextFeature has no sample; run from_relation_lists " \
+                    "(or generate_sample) first"
+                yield (np.asarray(sample.features[0]),
+                       np.asarray(sample.labels[0]).reshape(-1))
+        else:                                 # [(features, labels), ...]
+            for feats, labels in x:
+                yield np.asarray(feats), np.asarray(labels).reshape(-1)
+
+    def _ranked_relevance(self, feats, labels, threshold):
+        scores = np.asarray(
+            self.predict(feats, batch_size=max(len(feats), 1))).reshape(-1)
+        order = np.argsort(-scores, kind="stable")
+        return (labels > threshold).astype(np.float64)[order]
+
+    def evaluate_ndcg(self, x, k: int, threshold: float = 0.0) -> float:
+        """Mean NDCG@k over the query groups of ``x``. Queries with no
+        positive record contribute 0 (reference semantics)."""
+        vals = []
+        for feats, labels in self._ranking_groups(x):
+            rel = self._ranked_relevance(feats, labels, threshold)
+            gains = rel[:k]
+            discounts = np.log2(np.arange(2, len(gains) + 2))
+            dcg = float((gains / discounts).sum())
+            ideal = np.sort(rel)[::-1][:k]
+            idcg = float((ideal / discounts[:len(ideal)]).sum())
+            vals.append(dcg / idcg if idcg > 0 else 0.0)
+        return float(np.mean(vals)) if vals else 0.0
+
+    def evaluate_map(self, x, threshold: float = 0.0) -> float:
+        """Mean average precision over the query groups of ``x``."""
+        vals = []
+        for feats, labels in self._ranking_groups(x):
+            rel = self._ranked_relevance(feats, labels, threshold)
+            if rel.sum() == 0:
+                vals.append(0.0)
+                continue
+            prec = np.cumsum(rel) / np.arange(1, len(rel) + 1)
+            vals.append(float((prec * rel).sum() / rel.sum()))
+        return float(np.mean(vals)) if vals else 0.0

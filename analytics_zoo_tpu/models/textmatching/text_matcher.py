@@ -9,10 +9,10 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..common import ZooModel
+from ..common import Ranker, ZooModel
 
 
-class TextMatcher(ZooModel):
+class TextMatcher(ZooModel, Ranker):
     TARGET_MODES = ("ranking", "classification")
 
     def __init__(self, text1_length, vocab_size, embed_size=300,

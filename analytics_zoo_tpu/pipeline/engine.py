@@ -945,7 +945,12 @@ class SPMDTrainer:
         for misclassified multi-host leaves)."""
         def snap(leaf):
             arr = serialization._to_host_array(leaf)
-            return np.array(arr, copy=True) if copy else arr
+            # only actual views alias device buffers (CPU backend);
+            # accelerator transfers already produce owned host arrays —
+            # copying those again would double the synchronous stall
+            if copy and arr.base is not None:
+                return np.array(arr, copy=True)
+            return arr
 
         return (jax.tree.map(snap, self.params),
                 jax.tree.map(snap, self.net_state),

@@ -1,0 +1,64 @@
+"""Nested-structure utilities (parity: ``pyzoo/zoo/util/nest.py``, the
+tf.nest subset the reference's tfpark layer uses: ``flatten`` /
+``pack_sequence_as`` / ``is_sequence`` over lists, tuples and dicts, with
+dict values traversed in sorted-key order).
+
+jax's tree_util is the engine-internal pytree machinery; this module keeps
+the reference's exact public semantics (sorted dicts, no registry,
+structure mismatch errors) for code ported from the reference surface.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+
+def is_sequence(value: Any) -> bool:
+    return isinstance(value, (list, tuple, dict))
+
+
+def flatten(structure: Any) -> List[Any]:
+    """Depth-first leaves of ``structure``; dicts iterate by sorted key;
+    a non-sequence is its own single leaf."""
+    if not is_sequence(structure):
+        return [structure]
+    out: List[Any] = []
+    values = (structure[k] for k in sorted(structure)) \
+        if isinstance(structure, dict) else structure
+    for value in values:
+        out.extend(flatten(value))
+    return out
+
+
+def _pack(structure: Any, flat: List[Any], index: int):
+    if not is_sequence(structure):
+        return index + 1, flat[index]
+    packed = []
+    values = (structure[k] for k in sorted(structure)) \
+        if isinstance(structure, dict) else structure
+    for value in values:
+        index, rebuilt = _pack(value, flat, index)
+        packed.append(rebuilt)
+    if isinstance(structure, dict):
+        return index, {k: v for k, v in zip(sorted(structure), packed)}
+    if isinstance(structure, tuple):
+        return index, tuple(packed)
+    return index, packed
+
+
+def pack_sequence_as(structure: Any, flat_sequence: List[Any]) -> Any:
+    """Rebuild ``structure``'s shape from ``flat_sequence`` leaves."""
+    flat = list(flat_sequence)
+    if not is_sequence(structure):
+        if len(flat) != 1:
+            raise ValueError(
+                f"structure is a scalar but flat_sequence has "
+                f"{len(flat)} elements")
+        return flat[0]
+    n_expected = len(flatten(structure))
+    if len(flat) != n_expected:
+        raise ValueError(
+            f"structure has {n_expected} leaves but flat_sequence has "
+            f"{len(flat)}")
+    _, packed = _pack(structure, flat, 0)
+    return packed
