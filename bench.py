@@ -246,7 +246,7 @@ def bench_bert_mfu(peak_flops, batch_candidates=(BERT_BATCH, 16)):
     raise last_err
 
 
-def _bench_bert_mfu_at(peak_flops, bert_batch):
+def _bench_bert_mfu_at(peak_flops, bert_batch, seq_len=BERT_SEQ):
     from analytics_zoo_tpu.common.nncontext import (ZooConfig, ZooContext,
                                                     set_nncontext)
     from analytics_zoo_tpu.feature.feature_set import ArrayFeatureSet
@@ -260,12 +260,12 @@ def _bench_bert_mfu_at(peak_flops, bert_batch):
     set_nncontext(ZooContext(ZooConfig(compute_dtype="bfloat16")))
 
     bert = BERT(vocab=BERT_VOCAB, hidden_size=BERT_H, n_block=BERT_BLOCKS,
-                n_head=BERT_HEADS, seq_len=BERT_SEQ,
+                n_head=BERT_HEADS, seq_len=seq_len,
                 intermediate_size=4 * BERT_H, output_all_block=False)
-    tokens = Input(shape=(BERT_SEQ,), name="tokens")
-    positions = Input(shape=(BERT_SEQ,), name="positions")
-    segments = Input(shape=(BERT_SEQ,), name="segments")
-    mask = Input(shape=(1, 1, BERT_SEQ), name="mask")
+    tokens = Input(shape=(seq_len,), name="tokens")
+    positions = Input(shape=(seq_len,), name="positions")
+    segments = Input(shape=(seq_len,), name="segments")
+    mask = Input(shape=(1, 1, seq_len), name="mask")
     seq_out, pooled = bert([tokens, positions, segments, mask])
     out = Dense(BERT_CLASSES, activation="softmax")(pooled)
     model = Model([tokens, positions, segments, mask], out)
@@ -273,10 +273,10 @@ def _bench_bert_mfu_at(peak_flops, bert_batch):
 
     rng = np.random.default_rng(0)
     toks = rng.integers(0, BERT_VOCAB,
-                        (bert_batch, BERT_SEQ)).astype(np.int32)
-    poss = np.tile(np.arange(BERT_SEQ, dtype=np.int32), (bert_batch, 1))
-    segs = np.zeros((bert_batch, BERT_SEQ), np.int32)
-    msk = np.ones((bert_batch, 1, 1, BERT_SEQ), np.float32)
+                        (bert_batch, seq_len)).astype(np.int32)
+    poss = np.tile(np.arange(seq_len, dtype=np.int32), (bert_batch, 1))
+    segs = np.zeros((bert_batch, seq_len), np.int32)
+    msk = np.ones((bert_batch, 1, 1, seq_len), np.float32)
     ys = rng.integers(0, BERT_CLASSES, (bert_batch,)).astype(np.int32)
 
     fs = ArrayFeatureSet([toks, poss, segs, msk], ys)
@@ -311,14 +311,14 @@ def _bench_bert_mfu_at(peak_flops, bert_batch):
     sps, stats = _windows_stats(window)
     dt = 1.0 / sps
 
-    flops = _bert_flops_per_step(bert_batch, BERT_SEQ, BERT_H, BERT_BLOCKS,
+    flops = _bert_flops_per_step(bert_batch, seq_len, BERT_H, BERT_BLOCKS,
                                  BERT_CLASSES)
     achieved = flops / dt
     return {
         "bert_batch": bert_batch,
         "bert_step_time_ms": round(dt * 1e3, 2),
         "bert_steps_per_sec_windows": stats,
-        "bert_tokens_per_sec": round(bert_batch * BERT_SEQ / dt, 1),
+        "bert_tokens_per_sec": round(bert_batch * seq_len / dt, 1),
         "bert_model_tflops_per_sec": round(achieved / 1e12, 2),
         "bert_mfu": (round(achieved / peak_flops, 4)
                      if peak_flops else None),
@@ -465,6 +465,21 @@ def main():
         except Exception as e:  # noqa: BLE001
             RESULT["resnet_error"] = (str(e).splitlines()[0][:500]
                                       if str(e) else repr(e)[:500])
+        emit()
+
+    # Long-context leg (SURVEY §5.7): BERT at L=2048 routes through the
+    # Pallas flash kernels (fwd + the r4 blockwise bwd) — the XLA path's
+    # saved/recomputed O(L^2) probs dominate here. TPU-only, last (least
+    # critical leg if the tunnel dies mid-run).
+    if info["platform"] == "tpu" and \
+            time.time() - T_START < TOTAL_BUDGET_S * 0.75:
+        try:
+            long_res = _bench_bert_mfu_at(peak, 4, seq_len=2048)
+            RESULT.update({"bert_long_" + k.split("bert_", 1)[-1]: v
+                           for k, v in long_res.items()})
+        except Exception as e:  # noqa: BLE001
+            RESULT["bert_long_error"] = (str(e).splitlines()[0][:500]
+                                         if str(e) else repr(e)[:500])
         emit()
 
     emit()

@@ -262,6 +262,13 @@ def test_zooconfig_env_overrides(monkeypatch):
     monkeypatch.setenv("ZOO_TPU_DONATE_BUFFERS", "maybe")
     with pytest.raises(ValueError, match="DONATE_BUFFERS"):
         ZooConfig.from_env()
+    monkeypatch.setenv("ZOO_TPU_DONATE_BUFFERS", "1")
+    # r4 fields ride the same machinery
+    monkeypatch.setenv("ZOO_TPU_ASYNC_CHECKPOINT", "1")
+    monkeypatch.setenv("ZOO_TPU_NNFRAMES_SPILL_BYTES", "12345")
+    cfg = ZooConfig.from_env()
+    assert cfg.async_checkpoint is True
+    assert cfg.nnframes_spill_bytes == 12345
 
 
 def test_auto_steps_per_dispatch_stays_per_step_on_cpu():
