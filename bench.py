@@ -11,8 +11,10 @@ Two parts, one JSON line:
   vs_baseline = tpu/cpu steps-per-sec.
 * Part B — the BERT flagship (same family as ``__graft_entry__.entry``,
   scaled to BERT-base) with an MFU computation: matmul FLOPs per train step
-  / step time / chip peak bf16 FLOPs.  Routed through the Pallas flash-
-  attention kernel (ops/attention.py) on TPU.
+  / step time / chip peak bf16 FLOPs. At L=512 the attention router sends
+  this through the fused-XLA path (KERNEL_MIN_SEQ routing,
+  ops/attention.py); the separate ``bert_long_*`` leg at L=2048 exercises
+  the Pallas flash kernels (fwd + blockwise bwd).
 
 Backend init is probed in a subprocess with retries/backoff so a hung or
 failing TPU runtime can neither kill the driver nor waste the round: on
