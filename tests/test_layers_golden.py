@@ -210,3 +210,55 @@ def test_bidirectional_lstm_matches_keras():
         "forward": {"W": wf[0], "U": wf[1], "b": wf[2]},
         "backward": {"W": wf[3], "U": wf[4], "b": wf[5]}})
     np.testing.assert_allclose(out, ref_out, rtol=1e-4, atol=1e-4)
+
+
+def test_conv3d_matches_keras():
+    rng = np.random.default_rng(20)
+    x = rng.standard_normal((2, 5, 6, 7, 3)).astype(np.float32)  # NDHWC
+    for padding in ("valid", "same"):
+        ref = tf.keras.layers.Conv3D(4, (3, 2, 3), strides=(1, 2, 1),
+                                     padding=padding)
+        ref_out = ref(x).numpy()
+        kernel, bias = [w.numpy() for w in ref.weights]
+
+        layer = zl.Convolution3D(4, 3, 2, 3, subsample=(1, 2, 1),
+                                 border_mode=padding, dim_ordering="tf")
+        out, _ = _forward(layer, x, weights=lambda p: {
+            "kernel": kernel, "bias": bias})
+        np.testing.assert_allclose(out, ref_out, rtol=2e-4, atol=2e-4)
+
+
+def test_maxpool3d_matches_keras():
+    rng = np.random.default_rng(21)
+    x = rng.standard_normal((2, 6, 8, 4, 3)).astype(np.float32)  # NDHWC
+    ref = tf.keras.layers.MaxPooling3D(pool_size=(2, 2, 2),
+                                       strides=(2, 2, 2), padding="valid")
+    ref_out = ref(x).numpy()
+    layer = zl.MaxPooling3D(pool_size=(2, 2, 2), strides=(2, 2, 2),
+                            border_mode="valid", dim_ordering="tf")
+    out, _ = _forward(layer, x)
+    np.testing.assert_allclose(out, ref_out, rtol=1e-5, atol=1e-6)
+
+
+def test_locally_connected2d_equals_conv_when_kernels_shared():
+    """keras 3 dropped LocallyConnected*, so golden-test by property: with
+    every per-position kernel set EQUAL, LocallyConnected2D must match
+    Convolution2D exactly (unshared conv degenerates to shared conv)."""
+    rng = np.random.default_rng(22)
+    x = rng.standard_normal((2, 3, 8, 8)).astype(np.float32)   # NCHW
+    kh = kw = 3
+    cin, cout = 3, 4
+
+    conv = zl.Convolution2D(cout, kh, kw, border_mode="valid", bias=False)
+    conv_out, conv_params = _forward(conv, x)
+    shared = np.asarray(conv_params["kernel"])     # (kh, kw, cin, cout)
+
+    lc = zl.LocallyConnected2D(cout, kh, kw, border_mode="valid",
+                               bias=False)
+    oh = ow = 8 - kh + 1
+    # LC kernel layout: (positions, C*kh*kw, cout) — patches come from
+    # conv_general_dilated_patches, whose feature order is (C, kh, kw)
+    flat = shared.transpose(2, 0, 1, 3).reshape(cin * kh * kw, cout)
+    tiled = np.tile(flat[None], (oh * ow, 1, 1)).astype(np.float32)
+    lc_out, _ = _forward(lc, x, weights=lambda p: {"kernel": tiled})
+    np.testing.assert_allclose(lc_out, conv_out, rtol=2e-4, atol=2e-4)
