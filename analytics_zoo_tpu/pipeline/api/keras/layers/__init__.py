@@ -7,7 +7,7 @@ from .core import (AddConstant, Activation, BinaryThreshold, CAdd, CMul,
                    RepeatVector, Reshape, ResizeBilinear, Scale, Select,
                    SoftShrink, SpatialDropout1D, SpatialDropout2D,
                    SpatialDropout3D, SplitTensor, Sqrt, Square, Squeeze,
-                   Threshold)
+                   Threshold, Expand, GetShape, SelectTable, SparseDense)
 from .embeddings import Embedding, SparseEmbedding, WordEmbedding
 from .merge import (Add, Average, Concatenate, Maximum, Merge, Multiply,
                     merge)

@@ -607,3 +607,134 @@ class ResizeBilinear(KerasLayer):
         if self.dim_ordering == "th":
             return (s[0], s[1], self.oh, self.ow)
         return (s[0], self.oh, self.ow, s[3])
+
+
+class SparseDense(KerasLayer):
+    """Dense over (conceptually) sparse inputs (SparseDense.scala). Two
+    behavioral differences from ``Dense``: (1) by default NO gradient flows
+    back to the input — the reference skips it because a dense gradInput
+    over a huge sparse feature vector is useless; (2) ``backward_start`` /
+    ``backward_length`` (1-based start, per the Scala surface) open a
+    window of the last input dim that DOES receive gradient, which is what
+    Wide&Deep uses to train the dense half of a mixed input.
+
+    TPU-first note: there is no SparseTensor on the MXU — a sparse row
+    batch lowers to the same dense matmul, and XLA's scatter-add already
+    gives the weight gradient sparse-update behavior, so the input is a
+    plain dense array and sparsity is purely a gradient-routing contract.
+    """
+
+    def __init__(self, output_dim, init="glorot_uniform", activation=None,
+                 W_regularizer=None, b_regularizer=None, backward_start=-1,
+                 backward_length=-1, bias=True, input_shape=None, name=None,
+                 **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.output_dim = int(output_dim)
+        self.init = init
+        self.activation = get_activation_fn(activation)
+        self.bias = bias
+        self.backward_start = int(backward_start)
+        self.backward_length = int(backward_length)
+
+    def build(self, rng, input_shape):
+        if len(input_shape) < 2:
+            raise ValueError("SparseDense requires input dim >= 2, got %r"
+                             % (input_shape,))
+        in_dim = int(input_shape[-1])
+        k_rng, _ = jax.random.split(rng)
+        params = {"kernel": init_tensor(k_rng, (in_dim, self.output_dim),
+                                        self.init)}
+        self._annotate(kernel=("in", "out"))
+        if self.bias:
+            params["bias"] = jnp.zeros((self.output_dim,))
+            self._annotate(bias=("out",))
+        return params
+
+    def call(self, params, x, training=False, **kw):
+        if self.backward_start > 0 and self.backward_length > 0:
+            start = self.backward_start - 1
+            mask = jnp.zeros((x.shape[-1],), x.dtype).at[
+                start:start + self.backward_length].set(1.0)
+            x = jax.lax.stop_gradient(x) * (1.0 - mask) + x * mask
+        else:
+            x = jax.lax.stop_gradient(x)
+        y = jnp.matmul(x, params["kernel"])
+        if self.bias:
+            y = y + params["bias"]
+        if self.activation is not None:
+            y = self.activation(y)
+        return y
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape[:-1]) + (self.output_dim,)
+
+
+class SelectTable(KerasLayer):
+    """Pick element ``index`` (0-based, per the zoo python surface) from a
+    table of inputs (SelectTable.scala; BigDL ``nn.SelectTable`` is 1-based
+    — the zoo wrapper adds 1). Gradient flows only to the selected input."""
+
+    def __init__(self, index, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.index = int(index)
+
+    def call(self, params, xs, training=False, **kw):
+        if not isinstance(xs, (list, tuple)):
+            raise ValueError("SelectTable expects a table (list) input")
+        return xs[self.index]
+
+    def compute_output_shape(self, input_shape):
+        if input_shape and isinstance(input_shape[0], (list, tuple)):
+            return tuple(input_shape[self.index])
+        return tuple(input_shape)
+
+
+class Expand(KerasLayer):
+    """Broadcast singleton dims to ``tgt_sizes`` (Expand.scala /
+    InternalExpand.scala). ``tgt_sizes`` covers EVERY dim including batch;
+    -1 keeps a dim; only size-1 dims may grow. Backward is the usual
+    broadcast transpose (sum over expanded dims), which jax derives."""
+
+    def __init__(self, tgt_sizes, input_shape=None, name=None, **kwargs):
+        super().__init__(input_shape=input_shape, name=name)
+        self.tgt_sizes = tuple(int(t) for t in tgt_sizes)
+
+    def _target(self, shape):
+        if len(self.tgt_sizes) != len(shape):
+            raise ValueError(
+                "tgt_sizes must cover every dim: got %d for rank %d"
+                % (len(self.tgt_sizes), len(shape)))
+        out = []
+        for have, want in zip(shape, self.tgt_sizes):
+            if want == -1:
+                out.append(have)
+            elif have is None:
+                # unknown (batch) dim with an explicit target: the output
+                # size is statically the target either way
+                out.append(want)
+            elif have not in (1, want):
+                raise ValueError(
+                    "only singleton expansion supported: %r -> %r"
+                    % (tuple(shape), self.tgt_sizes))
+            else:
+                out.append(want)
+        return tuple(out)
+
+    def call(self, params, x, training=False, **kw):
+        return jnp.broadcast_to(x, self._target(x.shape))
+
+    def compute_output_shape(self, input_shape):
+        return self._target(tuple(input_shape))
+
+
+class GetShape(KerasLayer):
+    """Return the (static) shape of the input, batch dim included, as a
+    1-D tensor (GetShape.scala). The output carries no dependence on the
+    input values, so the gradient to the input is zero — same contract as
+    the reference's InternalGetShape.updateGradInput."""
+
+    def call(self, params, x, training=False, **kw):
+        return jnp.asarray(x.shape, jnp.float32)
+
+    def compute_output_shape(self, input_shape):
+        return (len(input_shape),)

@@ -887,10 +887,16 @@ class SPMDTrainer:
                         dtypes=[leaf.dtype for leaf in s_leaves], tag=tag))
         template = self.tx.init(self.params)
         o_leaves, o_def = jax.tree_util.tree_flatten(template)
+        # dtype must come from .dtype, not np.asarray: after the params
+        # load above, template leaves inherit params' sharding, and on a
+        # multi-host TP/PP run those are non-fully-addressable —
+        # np.asarray on such a jax.Array raises. asarray only for
+        # python-scalar leaves (e.g. schedule counts held as ints).
         self.opt_state = jax.tree_util.tree_unflatten(
             o_def, sharded_checkpoint.load_shards(
                 directory, "optim", self._opt_leaf_shardings(template),
-                dtypes=[np.asarray(leaf).dtype for leaf in o_leaves],
+                dtypes=[getattr(leaf, "dtype", None) or
+                        np.asarray(leaf).dtype for leaf in o_leaves],
                 tag=tag))
         meta_name = "meta.npz" if tag is None else f"meta.{tag}.npz"
         meta = serialization.load_pytree(os.path.join(directory, meta_name))
@@ -945,11 +951,22 @@ class SPMDTrainer:
         for misclassified multi-host leaves)."""
         def snap(leaf):
             arr = serialization._to_host_array(leaf)
-            # only actual views alias device buffers (CPU backend);
-            # accelerator transfers already produce owned host arrays —
-            # copying those again would double the synchronous stall
-            if copy and arr.base is not None:
-                return np.array(arr, copy=True)
+            # CPU-backend jax Arrays can share their buffer with the host
+            # array (zero-copy asarray) with no guarantee that .base is
+            # set, so the aliasing test is "is this a CPU-device jax
+            # Array", not arr.base. Accelerator transfers already produce
+            # owned host arrays — copying those again would double the
+            # synchronous stall.
+            if copy:
+                aliases = arr.base is not None
+                if not aliases and isinstance(leaf, jax.Array):
+                    try:
+                        aliases = all(d.platform == "cpu"
+                                      for d in leaf.devices())
+                    except Exception:
+                        aliases = True
+                if aliases:
+                    return np.array(arr, copy=True)
             return arr
 
         return (jax.tree.map(snap, self.params),

@@ -269,9 +269,9 @@ class NNEstimator(_Params):
         (preprocessing can expand rows by orders of magnitude — an image
         path becomes a 224x224x3 tensor), write ~64 MB ``.npz`` shards and
         stream them via ShardedFileFeatureSet instead of keeping every
-        sample resident. The estimate processes one row; the spill then
-        processes chunk-by-chunk, so peak memory is one shard, not the
-        dataset. The spill directory lives as long as the returned
+        sample resident. The estimate processes a handful of rows spread
+        across the dataset; the spill then processes chunk-by-chunk, so
+        peak memory is one shard, not the dataset. The spill directory lives as long as the returned
         FeatureSet (weakref finalizer removes it)."""
         from ...common.nncontext import get_nncontext
         from ...feature.feature_set import (DiskFeatureSet,
@@ -282,9 +282,16 @@ class NNEstimator(_Params):
         n = len(feats)
         if n == 0:
             return None
-        probe = self._row_to_sample(
-            feats[0], labels[0] if labels is not None else None)
-        per_sample = max(1, self._sample_nbytes(probe))
+        # probe rows spread across the dataset, not just row 0: with
+        # heterogeneous rows (variable-length sequences, mixed image
+        # sizes) a small first row would underestimate the total and the
+        # spill would silently never trigger
+        probe_idx = sorted({int(i) for i in
+                            np.linspace(0, n - 1, num=min(n, 8))})
+        probe_sizes = [max(1, self._sample_nbytes(self._row_to_sample(
+            feats[i], labels[i] if labels is not None else None)))
+            for i in probe_idx]
+        per_sample = max(1, int(np.mean(probe_sizes)))
         if per_sample * n <= threshold:
             return None
         import shutil
@@ -292,9 +299,10 @@ class NNEstimator(_Params):
         import weakref
 
         # each shard must respect the memory bound that triggered the
-        # spill (and a 64 MB practical cap)
+        # spill (and a 64 MB practical cap); size shards by the LARGEST
+        # probed row so oversized rows can't blow the bound
         shard_bytes = min(threshold, 64 << 20)
-        shard_rows = int(min(n, max(1, shard_bytes // per_sample)))
+        shard_rows = int(min(n, max(1, shard_bytes // max(probe_sizes))))
         spill_dir = tempfile.mkdtemp(prefix="zoo_nnframes_spill_")
         paths = []
         for start in range(0, n, shard_rows):

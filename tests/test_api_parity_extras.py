@@ -164,3 +164,126 @@ class TestDatasets:
         assert all(w < 300 for seq in xtr for w in seq)
         assert set(np.unique(ytr)) <= set(range(46))
         assert len(xte) > 0
+
+
+class TestParityHoleLayers:
+    """r5: the last four public-layer parity holes (VERDICT r4 missing #2).
+
+    References: SparseDense.scala, SelectTable.scala, Expand.scala /
+    InternalExpand.scala (+ InternalExpandSpec), GetShape.scala.
+    """
+
+    def _build(self, layer, in_shape):
+        import jax
+        return layer.build(jax.random.PRNGKey(0), in_shape)
+
+    def test_sparse_dense_forward_matches_dense(self):
+        import jax
+        import jax.numpy as jnp
+        from analytics_zoo_tpu.pipeline.api.keras import layers as zl
+
+        x = np.random.default_rng(0).standard_normal((3, 6)).astype(
+            np.float32)
+        sd = zl.SparseDense(4, activation="tanh")
+        params = self._build(sd, (None, 6))
+        dense = zl.Dense(4, activation="tanh")
+        out = sd.call(params, jnp.asarray(x))
+        ref = dense.call(params, jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-6, atol=1e-6)
+        assert sd.compute_output_shape((None, 6)) == (None, 4)
+
+    def test_sparse_dense_blocks_input_gradient_by_default(self):
+        import jax
+        import jax.numpy as jnp
+        from analytics_zoo_tpu.pipeline.api.keras import layers as zl
+
+        sd = zl.SparseDense(4)
+        params = self._build(sd, (None, 6))
+        x = jnp.asarray(np.random.default_rng(1).standard_normal(
+            (3, 6)).astype(np.float32))
+        gx = jax.grad(lambda x_: sd.call(params, x_).sum())(x)
+        np.testing.assert_array_equal(np.asarray(gx), 0.0)
+        # ...but the kernel still trains
+        gk = jax.grad(lambda p: sd.call(p, x).sum())(params)["kernel"]
+        assert np.abs(np.asarray(gk)).sum() > 0
+
+    def test_sparse_dense_backward_window(self):
+        import jax
+        import jax.numpy as jnp
+        from analytics_zoo_tpu.pipeline.api.keras import layers as zl
+
+        # backward_start is 1-based (Scala surface): window = dims 2..4
+        sd = zl.SparseDense(4, backward_start=3, backward_length=2)
+        params = self._build(sd, (None, 6))
+        x = jnp.asarray(np.random.default_rng(2).standard_normal(
+            (3, 6)).astype(np.float32))
+        gx = np.asarray(jax.grad(
+            lambda x_: sd.call(params, x_).sum())(x))
+        assert np.abs(gx[:, 2:4]).sum() > 0
+        np.testing.assert_array_equal(gx[:, :2], 0.0)
+        np.testing.assert_array_equal(gx[:, 4:], 0.0)
+        # windowed grad equals the plain-Dense grad on the window
+        full = np.asarray(jax.grad(lambda x_: jnp.matmul(
+            x_, params["kernel"]).sum() + params["bias"].sum())(x))
+        np.testing.assert_allclose(gx[:, 2:4], full[:, 2:4],
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_select_table(self):
+        import jax
+        import jax.numpy as jnp
+        from analytics_zoo_tpu.pipeline.api.keras import layers as zl
+
+        a = jnp.asarray(np.arange(6, dtype=np.float32).reshape(2, 3))
+        b = jnp.asarray(np.ones((2, 5), np.float32))
+        st = zl.SelectTable(1)
+        out = st.call(None, [a, b])
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(b))
+        # gradient routes only to the selected table entry
+        ga, gb = jax.grad(lambda xs: st.call(None, xs).sum())([a, b])
+        np.testing.assert_array_equal(np.asarray(ga), 0.0)
+        np.testing.assert_array_equal(np.asarray(gb), 1.0)
+        assert st.compute_output_shape([(None, 3), (None, 5)]) == (None, 5)
+
+    def test_expand_matches_internal_expand_spec(self):
+        import jax
+        import jax.numpy as jnp
+        from analytics_zoo_tpu.pipeline.api.keras import layers as zl
+
+        # InternalExpandSpec: (5,4,1) -> (5,4,3); every slice == input
+        x = np.random.default_rng(3).random((5, 4, 1)).astype(np.float32)
+        for tgt in ((5, 4, 3), (-1, 4, 3)):
+            layer = zl.Expand(tgt)
+            out = np.asarray(layer.call(None, jnp.asarray(x)))
+            assert out.shape == (5, 4, 3)
+            for i in range(3):
+                np.testing.assert_allclose(out[:, :, i:i + 1], x)
+        # backward: sum over the expanded dim (broadcast transpose)
+        layer = zl.Expand((5, 4, 3))
+        g = np.random.default_rng(4).random((5, 4, 3)).astype(np.float32)
+        gx = jax.grad(lambda x_: (layer.call(None, x_) *
+                                  jnp.asarray(g)).sum())(jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(gx),
+                                   g.sum(axis=2, keepdims=True), rtol=1e-6)
+
+    def test_expand_rejects_non_singleton(self):
+        import jax.numpy as jnp
+        from analytics_zoo_tpu.pipeline.api.keras import layers as zl
+
+        with pytest.raises(ValueError, match="singleton"):
+            zl.Expand((5, 4, 3)).call(None, jnp.zeros((5, 2, 1)))
+        with pytest.raises(ValueError, match="every dim"):
+            zl.Expand((4, 3)).call(None, jnp.zeros((5, 4, 1)))
+
+    def test_get_shape(self):
+        import jax
+        import jax.numpy as jnp
+        from analytics_zoo_tpu.pipeline.api.keras import layers as zl
+
+        gs = zl.GetShape()
+        x = jnp.zeros((2, 7, 3))
+        np.testing.assert_array_equal(np.asarray(gs.call(None, x)),
+                                      [2.0, 7.0, 3.0])
+        gx = jax.grad(lambda x_: gs.call(None, x_).sum())(x)
+        np.testing.assert_array_equal(np.asarray(gx), 0.0)
+        assert gs.compute_output_shape((None, 7, 3)) == (3,)

@@ -177,3 +177,36 @@ def test_nnestimator_auto_spill(tmp_path):
         assert np.isfinite(np.stack(out["prediction"].tolist())).all()
     finally:
         set_nncontext(None)
+
+def test_nnestimator_spill_probe_not_fooled_by_small_first_row():
+    """r5 (ADVICE r4 low): the spill estimate samples rows across the
+    dataset, so a tiny row 0 in a heterogeneous DataFrame cannot
+    underestimate total bytes and silently skip the spill."""
+    import pandas as pd
+    from analytics_zoo_tpu.common.nncontext import (ZooConfig, ZooContext,
+                                                    set_nncontext)
+    from analytics_zoo_tpu.feature.common import LambdaPreprocessing
+    from analytics_zoo_tpu.feature.feature_set import ShardedFileFeatureSet
+
+    n = 64
+    # row 0 processes to a float16 sample (2 KB); every later row to
+    # float64 (8 KB) — same shape, so shards still stack (promoting to
+    # f64), but a row-0-only probe estimates 2K*64 = 128 KB and skips the
+    # spill at a 200 KB threshold; the true total is ~500 KB.
+    feats = [np.zeros(1000, np.float16)] + \
+        [np.arange(1000, dtype=np.float64) for _ in range(n - 1)]
+    labels = np.zeros(n, np.float32)
+    df = pd.DataFrame({"features": feats, "label": labels})
+    set_nncontext(None)
+    set_nncontext(ZooContext(ZooConfig(nnframes_spill_bytes=200_000,
+                                       log_every_n_steps=1000)))
+    try:
+        est = NNEstimator(_mlp(), "mse",
+                          feature_preprocessing=LambdaPreprocessing(
+                              np.asarray),
+                          label_preprocessing=[1])
+        fs = est._maybe_spill(feats, labels)
+        assert isinstance(fs, ShardedFileFeatureSet), \
+            "heterogeneous rows must still trigger the spill"
+    finally:
+        set_nncontext(None)

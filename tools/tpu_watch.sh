@@ -1,0 +1,32 @@
+#!/bin/bash
+# Tunnel watcher: probe the axon TPU backend every PROBE_INTERVAL seconds
+# (in a subprocess with a hard timeout — jax.devices() HANGS when the
+# tunnel is dead, BENCH_NOTES.md r3/r4), and the moment a probe succeeds,
+# run the full measurement session (tools/tpu_perf_session.py) BEFORE
+# anything else can kill the tunnel. Log to TPU_WATCH.log.
+#
+# Usage: bash tools/tpu_watch.sh [probe_interval_seconds]
+set -u
+cd "$(dirname "$0")/.."
+LOG=TPU_WATCH.log
+INTERVAL="${1:-300}"
+echo "[watch] start $(date -u +%FT%TZ) interval=${INTERVAL}s" >> "$LOG"
+while true; do
+  if timeout 90 python -c "import jax; d=jax.devices(); assert d and d[0].platform!='cpu', d; print(d)" >> "$LOG" 2>&1; then
+    echo "[watch] TUNNEL ALIVE $(date -u +%FT%TZ) — launching perf session" >> "$LOG"
+    # sentinel: other jobs on this 1-core box must not run concurrently
+    # with a measurement (trap #7 in BENCH_NOTES — timings corrupt)
+    touch TPU_SESSION_RUNNING
+    python tools/tpu_perf_session.py >> "$LOG" 2>&1
+    rc=$?
+    rm -f TPU_SESSION_RUNNING
+    echo "[watch] perf session exited rc=$rc $(date -u +%FT%TZ)" >> "$LOG"
+    if [ $rc -eq 0 ]; then
+      echo "[watch] session complete — watcher idling (re-probe hourly for re-runs)" >> "$LOG"
+      INTERVAL=3600
+    fi
+  else
+    echo "[watch] probe dead $(date -u +%FT%TZ)" >> "$LOG"
+  fi
+  sleep "$INTERVAL"
+done
