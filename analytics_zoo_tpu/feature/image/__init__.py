@@ -1,4 +1,5 @@
 from .image_feature import ImageFeature
+from .pipeline import ImagePipelineFeatureSet, PipelineStats
 from .image_set import DistributedImageSet, ImageSet, LocalImageSet
 from .preprocessing import (ImageAspectScale, ImageBrightness,
                             ImageBytesToMat, ImageCenterCrop,
@@ -24,5 +25,5 @@ __all__ = [
     "ImagePixelNormalize", "ImageRandomCrop", "ImageCenterCrop",
     "ImageFixedCrop", "ImageExpand", "ImageFiller", "ImageHFlip",
     "ImageMirror", "ImageFeatureToTensor", "ImageFeatureToSample",
-    "ImageRandomPreprocessing",
+    "ImageRandomPreprocessing", "ImagePipelineFeatureSet", "PipelineStats",
 ]

@@ -51,7 +51,10 @@ class Dense(KerasLayer):
         return params
 
     def call(self, params, x, training=False, **kw):
-        y = jnp.matmul(x, params["kernel"])
+        # quant.matmul passes float kernels straight to jnp.matmul; int8
+        # serving kernels (QuantTensor) take the calibrated-compute path
+        from .....ops import quant
+        y = quant.matmul(x, params["kernel"])
         if self.bias:
             y = y + params["bias"]
         if self.activation is not None:
@@ -658,7 +661,8 @@ class SparseDense(KerasLayer):
             x = jax.lax.stop_gradient(x) * (1.0 - mask) + x * mask
         else:
             x = jax.lax.stop_gradient(x)
-        y = jnp.matmul(x, params["kernel"])
+        from .....ops import quant
+        y = quant.matmul(x, params["kernel"])
         if self.bias:
             y = y + params["bias"]
         if self.activation is not None:

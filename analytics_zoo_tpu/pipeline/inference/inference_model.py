@@ -15,9 +15,10 @@ TPU redesign:
   "model copies" become concurrency *permits*: the blocking queue holds
   tokens bounding in-flight predicts, with the same autoscale-on-demand
   behavior, while weights are shared (no per-copy duplication in HBM);
-- int8 arrives as weight-only quantization of matmul/conv kernels
-  (per-output-channel scales, dequantized in the kernel) instead of the
-  OpenVINO calibration subprocess — see :class:`QuantizedModel`;
+- int8 arrives in two tiers instead of the OpenVINO calibration
+  subprocess: weight-only PTQ (per-output-channel scales, dequantized in
+  the kernel), and activation-calibrated int8 compute on Dense matmuls
+  (``QuantizedModel.calibrate``, backed by ``ops.quant``);
 - foreign formats (TF saved model / TorchScript) load through the interop
   importers in ``pipeline.api.net`` and then compile like any native model.
 """
@@ -33,6 +34,8 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ...ops import quant
 
 
 class AbstractModel:
@@ -56,15 +59,19 @@ class FloatModel(AbstractModel):
         self.model = model
         self.compute_dtype = compute_dtype
         graph = model.graph_function()
+        self._graph = graph
         params, state = model._params_tuple() \
             if hasattr(model, "_params_tuple") \
             else getattr(model, "_built_params")
         self._params = params
         self._state = state
+        #: param paths eligible for int8 COMPUTE (set by QuantizedModel)
+        self._int8_paths = frozenset()
 
         def fwd(params, state, *inputs):
-            params = _dequantize(params)  # no-op for float trees; XLA
-            # fuses the int8->f32 upcast into consumers for quantized ones
+            params = _dequantize(params, self._int8_paths)
+            # no-op for float trees; XLA fuses the int8->f32 upcast into
+            # consumers for weight-only quantized leaves
             out, _ = graph.apply(params, list(inputs), state=state,
                                  training=False, rng=None,
                                  collect_state=True)
@@ -96,67 +103,110 @@ class FloatModel(AbstractModel):
 
 
 class QuantizedModel(FloatModel):
-    """Weight-only int8 PTQ: kernels of matmul-bearing params are stored as
-    int8 with per-output-channel scales and dequantized inside the compiled
-    program.  Replaces the reference's OpenVINO int8 calibration pipeline
-    (OpenVinoInferenceSupportive.scala:151-343) with an XLA-native path:
-    ~4x smaller weights (HBM-bandwidth-bound serving speedup), no
-    calibration data needed for weight-only mode.
+    """int8 PTQ, two tiers (replacing OpenVINO int8,
+    ``OpenVinoInferenceSupportive.scala:151-343``):
+
+    - **weight-only** (construction): matmul-bearing kernels stored int8
+      per-out-channel and dequantized inside the compiled program — ~4x
+      smaller weights, the HBM-bandwidth win, no calibration data.
+    - **activation-calibrated** (``calibrate(samples)``): the reference's
+      ``calibrateTensorflowModel`` equivalent — an eager replay over a
+      calibration set records per-kernel activation ranges, after which
+      Dense-family matmuls run true ``int8 x int8 -> int32`` on the MXU
+      (2x the bf16 rate on v5e). Only 2D ``kernel`` leaves take the
+      compute path; conv/embedding/attention kernels stay weight-only.
     """
 
     #: param leaf names treated as quantizable 2D+ kernels
     KERNEL_KEYS = ("kernel", "w", "qkv_w", "proj_w", "embedding")
 
-    def __init__(self, model, compute_dtype=None):
+    def __init__(self, model, compute_dtype=None, calibration=None):
         super().__init__(model, compute_dtype)
         self._params = self._quantize_tree(self._params)
+        # int8-COMPUTE eligibility is decided by the CONSUMER, not the
+        # leaf name: only layers that route their matmul through
+        # quant.matmul (the Dense family) may receive a QuantTensor;
+        # anything else (Highway, attention, convs) must be dequantized
+        # upfront or its raw jnp.matmul crashes on the wrapper type.
+        self._int8_paths = self._compute_eligible_paths()
+        self.calibrated = False
+        if calibration is not None:
+            self.calibrate(calibration)
+
+    def _compute_eligible_paths(self) -> frozenset:
+        from ..api.keras.layers import Dense, SparseDense
+
+        eligible = set()
+        for layer in getattr(self._graph, "layers", ()):
+            if type(layer) in (Dense, SparseDense):
+                eligible.add(f"['{layer.name}']['kernel']")
+        return frozenset(eligible)
 
     @classmethod
     def _quantize_tree(cls, params):
-        def quant(path, leaf):
+        def qleaf(path, leaf):
             name = str(path[-1].key) if path and hasattr(path[-1], "key") \
                 else ""
-            if leaf.ndim >= 2 and any(k in name.lower()
-                                      for k in cls.KERNEL_KEYS):
-                scale = np.max(np.abs(leaf), axis=tuple(
-                    range(leaf.ndim - 1)), keepdims=True) / 127.0
-                scale = np.maximum(scale, 1e-12).astype(np.float32)
-                q = np.clip(np.round(np.asarray(leaf) / scale), -127,
-                            127).astype(np.int8)
-                return _QuantizedLeaf(q, scale)
+            if getattr(leaf, "ndim", 0) >= 2 and any(
+                    k in name.lower() for k in cls.KERNEL_KEYS):
+                return quant.quantize_weight(
+                    np.asarray(leaf), name=jax.tree_util.keystr(path))
             return leaf
 
-        return jax.tree_util.tree_map_with_path(quant, params)
+        return jax.tree_util.tree_map_with_path(qleaf, params)
+
+    def calibrate(self, samples):
+        """Record activation ranges over ``samples`` (a list of
+        input-lists, or a single batched array) and switch 2D Dense
+        kernels to calibrated int8 compute."""
+        if isinstance(samples, np.ndarray):
+            samples = [samples]
+        with quant.calibrating() as ranges:
+            for s in samples:
+                inputs = [np.asarray(x) for x in
+                          (s if isinstance(s, (list, tuple)) else [s])]
+                # eager (unjitted) replay so quant.matmul sees values
+                self._fwd(self._params, self._state, *inputs)
+        scales = quant.calibration_scales(ranges)
+
+        def apply_scale(leaf):
+            if isinstance(leaf, quant.QuantTensor) and \
+                    leaf.name in scales and \
+                    leaf.name in self._int8_paths and leaf.q.ndim == 2:
+                return leaf.with_act_scale(scales[leaf.name])
+            return leaf
+
+        # under the compile lock: a concurrent predict must not lower
+        # against the old tree and then publish its executable into the
+        # cache we are about to invalidate
+        with self._lock:
+            self._params = jax.tree.map(
+                apply_scale, self._params,
+                is_leaf=lambda l: isinstance(l, quant.QuantTensor))
+            self._compiled.clear()
+            self.calibrated = True
+        return self
 
 
-@jax.tree_util.register_pytree_node_class
-class _QuantizedLeaf:
-    """int8 weights + f32 per-channel scale, dequantized inside the
-    compiled program (weights live in HBM as int8)."""
-
-    def __init__(self, q, scale):
-        self.q = q
-        self.scale = scale
-
-    def dequantize(self):
-        return jnp.asarray(self.q, jnp.float32) * self.scale
-
-    @property
-    def shape(self):
-        return self.q.shape
-
-    def tree_flatten(self):
-        return (self.q, self.scale), None
-
-    @classmethod
-    def tree_unflatten(cls, aux, children):
-        return cls(*children)
+# Back-compat alias: r3/r4 weight-only leaves are now ops.quant.QuantTensor
+_QuantizedLeaf = quant.QuantTensor
 
 
-def _dequantize(params):
+def _dequantize(params, int8_paths=frozenset()):
+    """Upfront dequantize for every quantized leaf EXCEPT those on the
+    int8-compute path (calibrated, or mid-calibration-recording) — ONLY
+    paths in ``int8_paths`` (kernels whose consuming layer routes
+    through ``quant.matmul``) may pass through; any other consumer's raw
+    ``jnp.matmul`` would crash on the wrapper type."""
+    def conv(p):
+        if not isinstance(p, quant.QuantTensor):
+            return p
+        passthrough = p.q.ndim == 2 and p.name in int8_paths and (
+            p.act_scale is not None or quant._recorder.active)
+        return p if passthrough else p.dequantize()
+
     return jax.tree.map(
-        lambda p: p.dequantize() if isinstance(p, _QuantizedLeaf) else p,
-        params, is_leaf=lambda p: isinstance(p, _QuantizedLeaf))
+        conv, params, is_leaf=lambda p: isinstance(p, quant.QuantTensor))
 
 
 class InferenceModel:
@@ -207,11 +257,25 @@ class InferenceModel:
     load_bigdl = load
     do_load = load
 
-    def load_keras_net(self, net, quantize: bool = False):
-        """Load an in-memory KerasNet/ZooModel."""
+    def load_keras_net(self, net, quantize: bool = False,
+                       calibration=None):
+        """Load an in-memory KerasNet/ZooModel. ``calibration``: optional
+        sample inputs enabling int8 *compute* (implies quantize)."""
         if hasattr(net, "model") and not hasattr(net, "graph_function"):
             net = net.model
-        self._install(QuantizedModel(net) if quantize else FloatModel(net))
+        if quantize or calibration is not None:
+            self._install(QuantizedModel(net, calibration=calibration))
+        else:
+            self._install(FloatModel(net))
+        return self
+
+    def calibrate(self, samples):
+        """Activation-calibrate a loaded quantized model
+        (doCalibrate / calibrateTensorflowModel parity)."""
+        if not isinstance(self.model, QuantizedModel):
+            raise RuntimeError("calibrate() needs a quantized model "
+                               "(load with quantize=True)")
+        self.model.calibrate(samples)
         return self
 
     def load_tf(self, model_path: str, backend: str = "auto", **kw):

@@ -296,3 +296,94 @@ def test_file_io_scheme_registry(tmp_path):
     import pytest as _pytest
     with _pytest.raises(ValueError, match="no filesystem registered"):
         file_io.read_bytes("hdfs://nn/x")
+
+
+class TestImagePipeline:
+    """r5 streaming decode pipeline (feature/image/pipeline.py) — the
+    throughput-bearing input path for SURVEY §7 hard-part (c)."""
+
+    @pytest.fixture(scope="class")
+    def jpeg_dir(self, tmp_path_factory):
+        cv2 = pytest.importorskip("cv2")
+        root = tmp_path_factory.mktemp("imgs")
+        rng = np.random.default_rng(0)
+        for cls in ("cats", "dogs"):
+            (root / cls).mkdir()
+            for i in range(5):
+                img = rng.integers(0, 255, (48 + 8 * i, 64, 3), np.uint8)
+                cv2.imwrite(str(root / cls / f"{cls}{i}.jpg"), img)
+        return str(root)
+
+    def test_content_matches_eager_imageset(self, jpeg_dir):
+        """Same files, same resize -> identical arrays as the eager
+        ImageSet.read path (both BGR, both cv2.resize INTER_LINEAR)."""
+        from analytics_zoo_tpu.feature.image import (ImagePipelineFeatureSet,
+                                                     ImageSet)
+
+        fs = ImagePipelineFeatureSet.read_folder(jpeg_dir, height=32,
+                                                 width=32, num_workers=2)
+        got = list(fs.batches(5, shuffle=False))
+        eager = ImageSet.read(jpeg_dir, resize_h=32, resize_w=32,
+                              with_label=True)
+        want = np.stack([f.get_image() for f in eager.features])
+        want_labels = np.asarray(eager.get_label(), np.float32)
+        xs = np.concatenate([b.inputs[0] for b in got])
+        ys = np.concatenate([b.targets for b in got])
+        np.testing.assert_allclose(xs, want, atol=1e-4)
+        np.testing.assert_array_equal(ys, want_labels)
+
+    def test_stats_shuffle_and_remainder(self, jpeg_dir):
+        from analytics_zoo_tpu.feature.image import ImagePipelineFeatureSet
+
+        fs = ImagePipelineFeatureSet.read_folder(jpeg_dir, height=16,
+                                                 width=16, num_workers=2)
+        assert fs.size() == 10
+        # drop_remainder: 10 -> 3 batches of 3
+        n = sum(1 for _ in fs.batches(3, shuffle=True, seed=7))
+        assert n == 3
+        assert fs.stats.batches == 3 and fs.stats.images == 9
+        assert fs.stats.elapsed_s > 0 and fs.stats.throughput() > 0
+        # pad_remainder keeps every batch full
+        shapes = [b.inputs[0].shape[0] for b in
+                  fs.batches(4, drop_remainder=False, pad_remainder=True)]
+        assert shapes == [4, 4, 4]
+        # same seed -> same order
+        a = np.concatenate([b.targets for b in
+                            fs.batches(3, shuffle=True, seed=5)])
+        b = np.concatenate([b.targets for b in
+                            fs.batches(3, shuffle=True, seed=5)])
+        np.testing.assert_array_equal(a, b)
+
+    def test_augment_and_chw(self, jpeg_dir):
+        from analytics_zoo_tpu.feature.image import ImagePipelineFeatureSet
+
+        fs = ImagePipelineFeatureSet.read_folder(
+            jpeg_dir, height=16, width=16, num_workers=1,
+            augment=_double, data_format="th",
+            mean=(1.0, 2.0, 3.0))
+        b = next(iter(fs.batches(4)))
+        assert b.inputs[0].shape == (4, 3, 16, 16)
+        # augment ran before mean-subtract: values can exceed 255
+        assert b.inputs[0].max() > 255.0
+
+    def test_fit_through_pipeline(self, jpeg_dir):
+        """End-to-end: Model.fit consumes the pipeline FeatureSet."""
+        from analytics_zoo_tpu.feature.image import ImagePipelineFeatureSet
+        from analytics_zoo_tpu.pipeline.api.keras.layers import (Dense,
+                                                                 Flatten)
+        from analytics_zoo_tpu.pipeline.api.keras.models import Sequential
+
+        fs = ImagePipelineFeatureSet.read_folder(
+            jpeg_dir, height=8, width=8, num_workers=2,
+            one_based_label=False, std=(255.0, 255.0, 255.0))
+        m = Sequential()
+        m.add(Flatten(input_shape=(8, 8, 3)))
+        m.add(Dense(2, activation="softmax"))
+        m.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+        m.fit(fs, batch_size=5, nb_epoch=2)
+        p = m.predict(np.zeros((2, 8, 8, 3), np.float32), batch_size=2)
+        assert p.shape == (2, 2)
+
+
+def _double(img):
+    return img * 2.0
