@@ -153,7 +153,12 @@ class FileStreamQueue(StreamQueue):
 
     @staticmethod
     def _safe(uri: str) -> str:
-        return "".join(c if c.isalnum() or c in "._-" else "_" for c in uri)
+        # ASCII [A-Za-z0-9._-] exactly as documented
+        # (docs/inference-serving.md): non-ASCII alphanumerics must NOT
+        # survive, or second-language clients (bytewise mapping) poll a
+        # different result filename than the server writes
+        return "".join(c if (c.isascii() and c.isalnum()) or c in "._-"
+                       else "_" for c in uri)
 
     def put_result(self, uri, value):
         fd, tmp = tempfile.mkstemp(dir=self.results_dir, suffix=".tmp")

@@ -1,0 +1,143 @@
+// Second-language serving client for the documented wire protocol
+// (docs/inference-serving.md "Wire protocol (non-Python clients)").
+//
+// Proves the doc is sufficient without any zoo/python code: speaks the
+// file transport directly — msgpack-encodes a tensor request, writes it
+// atomically into <root>/image_stream/, then polls <root>/results/<uri>
+// for the JSON result. Reference analogue: the Java client
+// (zoo/src/main/java/.../inference/AbstractInferenceModel.java).
+//
+// Build:  g++ -O2 -std=c++17 -o file_client file_client.cpp
+// Usage:  ./file_client <root> <uri> <dim1> [dim2 ...]
+//         input tensor "input" of the given shape, filled with the
+//         deterministic pattern value[i] = ((i % 7) - 3) * 0.25
+// Exit:   0 on result received (JSON printed to stdout), 2 on timeout.
+
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ---- minimal msgpack writer (just the subset the protocol needs) ----
+struct Packer {
+    std::string buf;
+
+    void map_header(uint8_t n) { buf.push_back(char(0x80 | n)); }
+
+    void str(const std::string& s) {
+        if (s.size() < 32) {
+            buf.push_back(char(0xa0 | s.size()));
+        } else {  // str8
+            buf.push_back(char(0xd9));
+            buf.push_back(char(s.size()));
+        }
+        buf += s;
+    }
+
+    void array_header(uint8_t n) { buf.push_back(char(0x90 | n)); }
+
+    void uint(uint32_t v) {
+        if (v < 128) {
+            buf.push_back(char(v));
+        } else {  // uint32
+            buf.push_back(char(0xce));
+            for (int i = 3; i >= 0; --i) buf.push_back(char(v >> (8 * i)));
+        }
+    }
+
+    void bin(const void* data, uint32_t n) {  // bin32
+        buf.push_back(char(0xc6));
+        for (int i = 3; i >= 0; --i) buf.push_back(char(n >> (8 * i)));
+        buf.append(static_cast<const char*>(data), n);
+    }
+};
+
+std::string safe_uri(const std::string& uri) {
+    std::string out;
+    for (char c : uri)
+        out += (isalnum(static_cast<unsigned char>(c)) || c == '.' ||
+                c == '_' || c == '-') ? c : '_';
+    return out;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+    if (argc < 4) {
+        std::fprintf(stderr,
+                     "usage: %s <root> <uri> <dim1> [dim2 ...]\n", argv[0]);
+        return 1;
+    }
+    const std::string root = argv[1], uri = argv[2];
+    std::vector<uint32_t> shape;
+    size_t n_elem = 1;
+    for (int i = 3; i < argc; ++i) {
+        shape.push_back(uint32_t(std::strtoul(argv[i], nullptr, 10)));
+        n_elem *= shape.back();
+    }
+    std::vector<float> data(n_elem);  // little-endian float32 on x86/arm
+    for (size_t i = 0; i < n_elem; ++i)
+        data[i] = float((int(i % 7) - 3)) * 0.25f;
+
+    // {"uri": uri, "tensors": {"input": {"shape": [...], "data": bin}}}
+    Packer p;
+    p.map_header(2);
+    p.str("uri");
+    p.str(uri);
+    p.str("tensors");
+    p.map_header(1);
+    p.str("input");
+    p.map_header(2);
+    p.str("shape");
+    p.array_header(uint8_t(shape.size()));
+    for (uint32_t d : shape) p.uint(d);
+    p.str("data");
+    p.bin(data.data(), uint32_t(n_elem * sizeof(float)));
+
+    // atomic enqueue: temp name, then rename to <ns-timestamp>-<hex>.msgpack
+    auto ns = std::chrono::duration_cast<std::chrono::nanoseconds>(
+        std::chrono::system_clock::now().time_since_epoch()).count();
+    std::mt19937_64 rng{uint64_t(ns)};
+    char rid[64];
+    std::snprintf(rid, sizeof rid, "%020lld-%08llx",
+                  static_cast<long long>(ns),
+                  static_cast<unsigned long long>(rng() & 0xffffffffULL));
+    const std::string dir = root + "/image_stream/";
+    const std::string tmp = dir + std::string(rid) + ".tmp";
+    const std::string fin = dir + std::string(rid) + ".msgpack";
+    {
+        std::ofstream f(tmp, std::ios::binary);
+        if (!f) { std::fprintf(stderr, "cannot write %s\n", tmp.c_str());
+                  return 1; }
+        f.write(p.buf.data(), std::streamsize(p.buf.size()));
+    }
+    if (std::rename(tmp.c_str(), fin.c_str()) != 0) {
+        std::perror("rename");
+        return 1;
+    }
+
+    // poll for the result (server writes <root>/results/<safe-uri>)
+    const std::string rpath = root + "/results/" + safe_uri(uri);
+    for (int i = 0; i < 600; ++i) {  // up to 30 s
+        std::ifstream r(rpath, std::ios::binary);
+        if (r) {
+            std::string body((std::istreambuf_iterator<char>(r)),
+                             std::istreambuf_iterator<char>());
+            if (!body.empty()) {
+                std::printf("%s\n", body.c_str());
+                std::remove(rpath.c_str());  // pop
+                return 0;
+            }
+        }
+        std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    }
+    std::fprintf(stderr, "timeout waiting for %s\n", rpath.c_str());
+    return 2;
+}

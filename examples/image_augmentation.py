@@ -10,7 +10,7 @@ variant on a synthetic volume.
 
 import numpy as np
 
-from common import example_args
+from common import cat_dog_real, example_args
 
 from analytics_zoo_tpu.feature.image import (ImageCenterCrop,
                                              ImageChannelNormalize,
@@ -25,8 +25,16 @@ from analytics_zoo_tpu.feature.image3d import CenterCrop3D, Rotate3D
 def main():
     args = example_args("ImageSet augmentation chain", samples=16)
     rng = np.random.default_rng(args.seed)
-    imgs = [rng.integers(0, 256, (48, 64, 3)).astype(np.float32)
-            for _ in range(args.samples)]
+    root = cat_dog_real()
+    if root is not None:
+        # REAL photos: the reference's cat_dog fixture (the app augments
+        # real images too; synthetic only when the checkout is absent)
+        real = ImageSet.read(root, with_label=True)
+        imgs = [f.get_image() for f in real.features]
+        print(f"augmenting {len(imgs)} real cat_dog JPEGs")
+    else:
+        imgs = [rng.integers(0, 256, (48, 64, 3)).astype(np.float32)
+                for _ in range(args.samples)]
 
     image_set = ImageSet.array(imgs)
     transformer = (ImageResize(40, 40)
@@ -37,7 +45,7 @@ def main():
                    >> ImageMatToTensor(format="NCHW"))
     out = image_set.transform(transformer)
     tensors = out.get_image(key="floats")
-    assert len(tensors) == args.samples
+    assert len(tensors) == len(imgs)
     assert all(t.shape == (3, 32, 32) for t in tensors)
     print(f"augmented {len(tensors)} images -> {tensors[0].shape} tensors, "
           f"mean {float(np.mean([t.mean() for t in tensors])):.2f}")

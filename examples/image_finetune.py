@@ -9,9 +9,11 @@ surgery: ``new_graph`` to re-root on the penultimate layer,
 ``freeze_up_to`` so only the new head trains.
 """
 
+import os
+
 import numpy as np
 
-from common import example_args
+from common import cat_dog_real, example_args
 
 from analytics_zoo_tpu.models.image.imageclassification import \
     ImageClassifier
@@ -35,6 +37,10 @@ def make_dataset(n, rng):
 def main():
     args = example_args("image transfer learning / freeze + new head",
                         epochs=6, samples=512, batch_size=64)
+    if os.environ.get("ZOO_ONLY_REAL"):
+        real_cat_dog_section(args)
+        print("image fine-tune example OK (real leg only)")
+        return
     rng = np.random.default_rng(args.seed)
     x, y = make_dataset(args.samples, rng)
 
@@ -79,7 +85,42 @@ def main():
     res = tl.evaluate(x, y, batch_size=args.batch_size)
     print(f"after full fine-tune: {res}")
     assert res["accuracy"] > 0.8, res
+
+    real_cat_dog_section(args)
     print("image fine-tune example OK")
+
+
+def real_cat_dog_section(args):
+    """REAL data: the reference's dogs-vs-cats JPEGs (the actual
+    fixture behind the ``apps/dogs-vs-cats`` notebook) streamed through
+    the parallel decode pipeline into a fresh classifier fine-tune."""
+    root = cat_dog_real()
+    if root is None:
+        print("reference fixtures absent; skipping real cat_dog leg")
+        return
+    from analytics_zoo_tpu.feature.image import ImagePipelineFeatureSet
+
+    fs = ImagePipelineFeatureSet.read_folder(
+        root, height=SIZE, width=SIZE, num_workers=2,
+        one_based_label=False, data_format="th",
+        mean=(104.0, 117.0, 123.0), std=(58.0, 57.0, 57.0))
+    print(f"real cat_dog: {fs.size()} JPEGs, classes {fs.label_map}")
+
+    clf = ImageClassifier(class_num=2, model_name="lenet",
+                          input_shape=(3, SIZE, SIZE))
+    clf.model.compile(optimizer=Adam(lr=3e-3),
+                      loss="sparse_categorical_crossentropy",
+                      metrics=["accuracy"])
+    clf.model.fit(fs, batch_size=4, nb_epoch=8 * args.epochs)
+    # evaluate on the decoded images directly (train-set memorization:
+    # 12 real photos must be fully separable for a working pipeline)
+    batches = list(fs.batches(fs.size(), shuffle=False,
+                              drop_remainder=False))
+    xs = np.concatenate([b.inputs[0] for b in batches])
+    ys = np.concatenate([b.targets for b in batches]).astype(np.int32)
+    res = clf.model.evaluate(xs, ys, batch_size=16)
+    print(f"REAL cat_dog train-set evaluation: {res}")
+    assert res["accuracy"] >= 0.9, res
 
 
 if __name__ == "__main__":

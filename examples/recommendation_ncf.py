@@ -9,9 +9,11 @@ implicit feedback with 4:1 negative sampling, leave-one-out evaluation,
 and HR@10 / NDCG@10 against the random-ranking baseline.
 """
 
+import os
+
 import numpy as np
 
-from common import example_args, movielens_like
+from common import example_args, movielens_like, movielens_real
 
 from analytics_zoo_tpu.models.recommendation import (NeuralCF,
                                                      UserItemFeature)
@@ -65,8 +67,43 @@ def hit_rate_ndcg(ncf, user_ids, holdout, negatives, batch_size, k=TOP_K):
     return hr / n, ndcg / n
 
 
+
+def build_implicit_leave_one_out(positives, excluded, n_items, rng,
+                                 n_neg=N_NEG, neg_ratio=4):
+    """Shared leave-one-out construction (synthetic AND real legs): hold
+    out each user's last positive, sample evaluation negatives from the
+    items outside ``excluded[u]``, and emit ``neg_ratio``:1 sampled
+    training rows for the remaining positives."""
+    all_items = np.arange(1, n_items + 1)
+    train_u, train_i, train_y = [], [], []
+    user_ids, holdout, negatives = [], [], []
+    for u, its in positives.items():
+        held = its[-1]
+        user_ids.append(u)
+        holdout.append(held)
+        pool = np.array([i for i in all_items if i not in excluded[u]])
+        negatives.append(rng.choice(pool, size=min(n_neg, len(pool)),
+                                    replace=False))
+        for it in its[:-1]:
+            train_u.append(u)
+            train_i.append(it)
+            train_y.append(1)
+            for neg in rng.choice(pool, size=neg_ratio, replace=False):
+                train_u.append(u)
+                train_i.append(int(neg))
+                train_y.append(0)
+    xt = np.stack([np.array(train_u, np.float32),
+                   np.array(train_i, np.float32)], axis=1)
+    yt = np.array(train_y, np.int32)
+    return xt, yt, user_ids, holdout, negatives
+
+
 def main():
     args = example_args("NeuralCF / MovieLens-style feedback", epochs=12)
+    if os.environ.get("ZOO_ONLY_REAL"):
+        real_movielens_section(args)
+        print("NCF example OK (real leg only)")
+        return
     x, y, n_users, n_items = movielens_like(args.samples, seed=args.seed)
 
     ncf = NeuralCF(n_users, n_items, class_num=5, user_embed=16,
@@ -93,26 +130,8 @@ def main():
     # -- implicit feedback: leave-one-out HR@10 / NDCG@10 ----------------
     rng = np.random.default_rng(args.seed)
     positives, nu, ni = implicit_interactions(seed=args.seed)
-    train_u, train_i, train_y = [], [], []
-    holdout, negatives = [], []
-    all_items = np.arange(1, ni + 1)
-    for u, pos_items in positives.items():
-        held = pos_items[-1]
-        holdout.append(held)
-        pos_set = set(pos_items)
-        pool = np.array([i for i in all_items if i not in pos_set])
-        negatives.append(rng.choice(pool, size=N_NEG, replace=False))
-        for it in pos_items[:-1]:
-            train_u.append(u)
-            train_i.append(it)
-            train_y.append(1)
-            for neg in rng.choice(pool, size=4, replace=False):   # 4:1
-                train_u.append(u)
-                train_i.append(int(neg))
-                train_y.append(0)
-    xt = np.stack([np.array(train_u, np.float32),
-                   np.array(train_i, np.float32)], axis=1)
-    yt = np.array(train_y, np.int32)
+    xt, yt, user_ids, holdout, negatives = build_implicit_leave_one_out(
+        positives, {u: set(its) for u, its in positives.items()}, ni, rng)
     print(f"implicit: {nu} users, {ni} items, {len(yt)} training rows "
           f"({(yt == 1).mean():.0%} positive)")
 
@@ -122,13 +141,69 @@ def main():
                 loss="sparse_categorical_crossentropy")
     imp.fit(xt, yt, batch_size=args.batch_size, nb_epoch=args.epochs)
 
-    hr, ndcg = hit_rate_ndcg(imp, list(positives), holdout, negatives,
+    hr, ndcg = hit_rate_ndcg(imp, user_ids, holdout, negatives,
                              args.batch_size)
     rand_hr = TOP_K / (N_NEG + 1)
     print(f"leave-one-out HR@{TOP_K} {hr:.3f} NDCG@{TOP_K} {ndcg:.3f} "
           f"(random baseline HR@{TOP_K} {rand_hr:.3f})")
     assert hr > rand_hr * 1.5, hr   # must clearly beat random ranking
+
+    real_movielens_section(args)
     print("NCF example OK")
+
+
+def real_movielens_section(args):
+    """REAL data: the reference's in-tree MovieLens slice
+    (recommender/data.parquet, 458 genuine ratings) — explicit rating
+    fit + leave-one-out ranking on the real interactions."""
+    df = movielens_real()
+    if df is None:
+        print("reference fixtures absent; skipping real-MovieLens leg")
+        return
+    users = df["userId"].to_numpy(np.int64)
+    items = df["itemId"].to_numpy(np.int64)
+    ratings = df["label"].to_numpy(np.int64)
+    nu, ni = int(users.max()), int(items.max())
+    x = np.stack([users, items], axis=1).astype(np.float32)
+    y = (ratings - 1).astype(np.int32)
+    print(f"real MovieLens: {len(df)} ratings, {nu} users, {ni} items")
+
+    ncf = NeuralCF(nu, ni, class_num=5, user_embed=16, item_embed=16,
+                   hidden_layers=(32, 16, 8), include_mf=True, mf_embed=8)
+    ncf.compile(optimizer=Adam(lr=2e-3),
+                loss="sparse_categorical_crossentropy",
+                metrics=["accuracy"])
+    ncf.fit(x, y, batch_size=64, nb_epoch=3 * args.epochs)
+    res = ncf.evaluate(x, y, batch_size=256)
+    majority = float(np.bincount(y).max()) / len(y)
+    print(f"real explicit ratings: {res} (majority-class {majority:.3f})")
+    assert res["accuracy"] > majority, (res, majority)
+
+    # implicit leave-one-out over the real positives (rating >= 4)
+    rng = np.random.default_rng(args.seed)
+    rated = {}
+    pos = {}
+    for u, i, r in zip(users, items, ratings):
+        rated.setdefault(u, set()).add(i)
+        if r >= 4:
+            pos.setdefault(u, []).append(i)
+    eligible = {u: its for u, its in pos.items() if len(its) >= 2}
+    xt, yt, user_ids, holdout, negatives = build_implicit_leave_one_out(
+        eligible, rated, ni, rng)
+    print(f"real implicit: {len(eligible)} evaluable users, "
+          f"{len(yt)} training rows")
+    imp = NeuralCF(nu, ni, class_num=2, user_embed=16, item_embed=16,
+                   hidden_layers=(32, 16, 8), include_mf=True, mf_embed=8)
+    imp.compile(optimizer=Adam(lr=2e-3),
+                loss="sparse_categorical_crossentropy")
+    imp.fit(xt, yt, batch_size=64, nb_epoch=3 * args.epochs)
+    hr, ndcg = hit_rate_ndcg(imp, user_ids, holdout, negatives, 256)
+    rand_hr = TOP_K / (N_NEG + 1)
+    print(f"REAL leave-one-out HR@{TOP_K} {hr:.3f} NDCG@{TOP_K} "
+          f"{ndcg:.3f} (random {rand_hr:.3f})")
+    # 458 real ratings is thin for factorization: require a real lift,
+    # not the synthetic leg's 1.5x margin
+    assert hr > rand_hr, (hr, rand_hr)
 
 
 if __name__ == "__main__":

@@ -6,9 +6,12 @@ TextClassifier (CNN/LSTM/GRU encoder). Here the embedding table is a small
 random matrix instead of downloaded GloVe vectors.
 """
 
+import os
+
 import numpy as np
 
-from common import example_args, news_like
+from common import (example_args, news_like, glove_real,
+                    reference_resource)
 
 from analytics_zoo_tpu.models.textclassification import TextClassifier
 from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
@@ -19,6 +22,10 @@ VOCAB, SEQ_LEN, CLASSES, EMB_DIM = 500, 64, 5, 32
 def main():
     args = example_args("TextClassifier / news20-style documents",
                         epochs=8, samples=1024)
+    if os.environ.get("ZOO_ONLY_REAL"):
+        real_news20_section(args)
+        print("TextClassifier example OK (real leg only)")
+        return
     docs, labels = news_like(args.samples, vocab=VOCAB, seq_len=SEQ_LEN,
                              n_classes=CLASSES, seed=args.seed)
     embedding = np.random.default_rng(args.seed) \
@@ -36,7 +43,76 @@ def main():
         res = clf.evaluate(docs, labels, batch_size=args.batch_size)
         print(f"encoder={encoder}: {res}")
         assert res["accuracy"] > 0.6, (encoder, res)
+
+    real_news20_section(args)
     print("TextClassifier example OK")
+
+
+def real_news20_section(args, seq_len=32):
+    """REAL data: the reference's news20 fixture driven through the real
+    TextSet pipeline (read -> tokenize -> normalize -> word2idx ->
+    shape_sequence) with the real GloVe 6B.50d subset feeding
+    WordEmbedding-style vectors. The fixture is tiny (3 posts, 2
+    classes), so posts are windowed into chunks and the assertion is
+    that the trained classifier labels every REAL post correctly by
+    chunk-majority vote."""
+    from analytics_zoo_tpu.feature.text import TextSet
+
+    root = reference_resource("news20")
+    if root is None:
+        print("reference fixtures absent; skipping real-news20 leg")
+        return
+    ts = TextSet.read(root).tokenize().normalize().word2idx()
+    vocab = ts.word_index
+    print(f"real news20: {len(ts.features)} posts, vocab {len(vocab)}")
+
+    # real GloVe vectors for covered words; seeded random elsewhere
+    rng = np.random.default_rng(args.seed)
+    emb = rng.standard_normal((len(vocab) + 1, 50)).astype(np.float32) * .1
+    covered = 0
+    glove_path = glove_real()
+    if glove_path:
+        with open(glove_path, encoding="utf-8") as f:
+            for line in f:
+                parts = line.rstrip().split(" ")
+                idx = vocab.get(parts[0])
+                if idx is not None:
+                    emb[idx] = np.asarray(parts[1:], np.float32)
+                    covered += 1
+        print(f"real GloVe subset: {covered} vocabulary words covered")
+
+    # window each post's token sequence into chunk samples
+    chunks, labels, owners = [], [], []
+    for pi, feat in enumerate(ts.features):
+        idxs = [int(i) for i in
+                feat.get_indices() if i > 0]
+        step = seq_len // 2
+        for s in range(0, max(len(idxs) - seq_len // 2, 1), step):
+            win = idxs[s:s + seq_len]
+            chunks.append(np.pad(win, (0, seq_len - len(win))))
+            labels.append(feat.get_label())
+            owners.append(pi)
+    x = np.asarray(chunks, np.float32)
+    y = np.asarray(labels, np.int32)
+    print(f"real chunks: {len(x)} windows from {len(ts.features)} posts")
+
+    clf = TextClassifier(class_num=2, embedding=emb,
+                         sequence_length=seq_len, encoder="cnn",
+                         encoder_output_dim=16)
+    clf.compile(optimizer=Adam(lr=3e-3),
+                loss="sparse_categorical_crossentropy",
+                metrics=["accuracy"])
+    clf.fit(x, y, batch_size=8, nb_epoch=6 * args.epochs)
+    probs = np.asarray(clf.model.predict(x, batch_size=32))
+    votes = {}
+    for pi, p in zip(owners, probs):
+        votes.setdefault(pi, []).append(p)
+    correct = sum(
+        int(np.argmax(np.mean(votes[pi], axis=0)) ==
+            ts.features[pi].get_label())
+        for pi in votes)
+    print(f"REAL post-level majority vote: {correct}/{len(votes)} correct")
+    assert correct == len(votes), (correct, len(votes))
 
 
 if __name__ == "__main__":

@@ -48,3 +48,48 @@ def test_fast_examples(name):
 @pytest.mark.parametrize("name", [n for n in ALL if n not in FAST])
 def test_all_examples(name):
     _run(name)
+
+
+# -- real reference fixtures (VERDICT r4 next #4) -----------------------
+# Each wired example asserts its analysis metric ON REAL DATA inside its
+# real_* section (NCF: HR@10/NDCG@10 lift over random on genuine
+# MovieLens ratings; Wide&Deep: accuracy over the majority class on the
+# real categorical columns; text: post-level majority vote through the
+# real TextSet pipeline + real GloVe; image: separability of the real
+# cat_dog JPEGs through the decode pipeline). ZOO_ONLY_REAL runs just
+# that leg.
+
+REAL_FIXTURES = os.environ.get(
+    "ZOO_REF_RESOURCES", "/root/reference/pyzoo/test/zoo/resources")
+REAL_EXAMPLES = ["text_classification.py", "image_finetune.py"]
+REAL_EXAMPLES_SLOW = ["recommendation_ncf.py",
+                      "recommendation_wide_and_deep.py"]
+
+
+def _run_real(name):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["ZOO_ONLY_REAL"] = "1"
+    proc = subprocess.run([sys.executable, name, "--platform", "cpu"],
+                          cwd=EXAMPLES_DIR, capture_output=True, text=True,
+                          timeout=900, env=env)
+    assert proc.returncode == 0, \
+        f"{name} failed:\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    # a skipped real section also prints "... (real leg only)", so the
+    # gate is the positive metric marker each real section emits
+    assert "REAL " in proc.stdout, proc.stdout[-500:]
+
+
+@pytest.mark.skipif(not os.path.isdir(REAL_FIXTURES),
+                    reason="reference fixtures not present")
+@pytest.mark.parametrize("name", REAL_EXAMPLES)
+def test_real_fixture_examples(name):
+    _run_real(name)
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not os.path.isdir(REAL_FIXTURES),
+                    reason="reference fixtures not present")
+@pytest.mark.parametrize("name", REAL_EXAMPLES_SLOW)
+def test_real_fixture_examples_slow(name):
+    _run_real(name)
