@@ -9,14 +9,10 @@ is attached. Reference test analogue: KerasBaseSpec golden checks, except on
 hardware (SURVEY §4: "real multi-chip tests" are what the reference lacks).
 """
 
-import functools
 import subprocess
 import sys
 
 import pytest
-
-_PROBE = ("import jax; d = jax.devices()[0]; "
-          "print('PLATFORM=' + d.platform)")
 
 _PARITY = r"""
 import os
@@ -54,23 +50,7 @@ print("TPU_PARITY_OK")
 """
 
 
-@functools.lru_cache(maxsize=1)
-def _tpu_available() -> bool:
-    try:
-        out = subprocess.run([sys.executable, "-c", _PROBE],
-                             capture_output=True, text=True, timeout=120,
-                             env=_clean_env())
-        return "PLATFORM=tpu" in out.stdout
-    except Exception:
-        return False
-
-
-def _clean_env():
-    import os
-    env = dict(os.environ)
-    env.pop("JAX_PLATFORMS", None)
-    env.pop("XLA_FLAGS", None)
-    return env
+from _tpu_probe import clean_env as _clean_env,     tpu_available as _tpu_available
 
 
 @pytest.mark.skipif(not _tpu_available(), reason="no TPU attached")

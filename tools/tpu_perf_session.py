@@ -79,9 +79,23 @@ def leg_attn():
     import jax
     import jax.numpy as jnp
 
+    # probe-and-report EVERY grid point before any timing: one bad shape
+    # must cost a log line, not the session (r2/r3 history: on-chip-only
+    # Mosaic failures; VERDICT r4 next #8)
+    from analytics_zoo_tpu.ops import attention as A
+    grid = [(32, 512), (16, 1024), (8, 2048), (4, 4096)]
+    probe_report = {}
+    for b, l in grid:
+        try:
+            ok = A._kernel_ok_for(b, 12, l, l, 64, False, jnp.bfloat16)
+        except Exception as e:  # noqa: BLE001
+            ok = f"probe raised: {str(e).splitlines()[0][:200]}"
+        probe_report[f"B{b}_L{l}"] = ok
+    emit("attn_probe", probe_report)
+
     results = []
     # (B, L) pairs with roughly constant tokens; BERT-base head geometry
-    for b, l in [(32, 512), (16, 1024), (8, 2048), (4, 4096)]:
+    for b, l in grid:
         h, d = 12, 64
         rng = np.random.default_rng(0)
         q = jnp.asarray(rng.standard_normal((b, h, l, d)), jnp.bfloat16)
