@@ -28,6 +28,7 @@ instead of ``jnp.matmul`` — a float kernel passes straight through.
 
 from __future__ import annotations
 
+import functools
 import threading
 from typing import Optional
 
@@ -35,8 +36,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["QuantTensor", "quantize_weight", "matmul", "calibrating",
-           "calibration_scales"]
+__all__ = ["QuantTensor", "quantize_weight", "matmul", "conv2d",
+           "calibrating", "calibration_scales"]
 
 
 @jax.tree_util.register_pytree_node_class
@@ -124,26 +125,78 @@ def calibration_scales(ranges: dict) -> dict:
 
 # -- the op ------------------------------------------------------------
 
+def _record_range(x, name):
+    """Eager calibration replay: fold this activation's max-abs into the
+    recorder entry for the kernel named ``name``."""
+    seen = float(np.max(np.abs(np.asarray(x)))) if x.size else 0.0
+    prev = _recorder.ranges.get(name, 0.0)
+    _recorder.ranges[name] = max(prev, seen)
+
+
+def _quantize_act(x, act_scale):
+    """Symmetric per-tensor int8 quantization with the calibrated scale."""
+    return jnp.clip(jnp.round(x / act_scale), -127, 127).astype(jnp.int8)
+
+
 def matmul(x, w):
     """``x @ w`` where ``w`` may be float, weight-only QuantTensor, or a
     calibrated QuantTensor (true int8 compute)."""
     if not isinstance(w, QuantTensor):
         return jnp.matmul(x, w)
     if _recorder.active:
-        # eager calibration replay: record the activation range seen by
-        # THIS kernel (keyed by param path), then compute in float
-        seen = float(np.max(np.abs(np.asarray(x)))) if x.size else 0.0
-        prev = _recorder.ranges.get(w.name, 0.0)
-        _recorder.ranges[w.name] = max(prev, seen)
+        _record_range(x, w.name)
         return jnp.matmul(x, w.dequantize())
     if w.act_scale is None or w.q.ndim != 2:
         # weight-only: upcast fuses into the consumer
         return jnp.matmul(x, w.dequantize())
     # calibrated int8 path: quantize the activation with the static
     # calibration scale, accumulate in int32 on the MXU, rescale once.
-    xq = jnp.clip(jnp.round(x / w.act_scale), -127, 127).astype(jnp.int8)
+    xq = _quantize_act(x, w.act_scale)
     acc = jax.lax.dot_general(
         xq, w.q, (((x.ndim - 1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32)
     out_scale = w.act_scale * w.scale.reshape(-1)  # (out,)
     return acc.astype(jnp.float32) * out_scale
+
+
+def conv2d(x, w, window_strides, padding, rhs_dilation,
+           dimension_numbers):
+    """``lax.conv_general_dilated`` where ``w`` may be float, weight-only
+    QuantTensor, or calibrated QuantTensor (int8 conv, int32 accumulate —
+    convs ride the MXU exactly like matmuls, and int8 doubles the v5e
+    rate). Kernel layout must be HWIO (out channels last, matching
+    Convolution2D.build) so the per-out-channel scale broadcasts on the
+    output feature dim."""
+    conv = functools.partial(
+        jax.lax.conv_general_dilated, window_strides=window_strides,
+        padding=padding, rhs_dilation=rhs_dilation,
+        dimension_numbers=dimension_numbers)
+    if not isinstance(w, QuantTensor):
+        return conv(x, w.astype(x.dtype))
+    if _recorder.active:
+        _record_range(x, w.name)
+        return conv(x, w.dequantize().astype(x.dtype))
+    if w.act_scale is None or w.q.ndim != 4:
+        return conv(x, w.dequantize().astype(x.dtype))
+    xq = _quantize_act(x, w.act_scale)
+    acc = conv(xq, w.q, preferred_element_type=jnp.int32)
+    out_scale = (w.act_scale * w.scale.reshape(-1)).astype(jnp.float32)
+    c_axis = _out_feature_axis(dimension_numbers)
+    shape = [1] * acc.ndim
+    shape[c_axis] = out_scale.shape[0]
+    return acc.astype(jnp.float32) * out_scale.reshape(shape)
+
+
+def _out_feature_axis(dimension_numbers) -> int:
+    """Output-feature axis for any form conv_general_dilated accepts:
+    a (lhs, rhs, out) string triple, a lax.ConvDimensionNumbers (whose
+    out_spec is (batch, feature, *spatial) POSITIONS), or None (lax
+    default layout: batch, feature, spatial -> axis 1)."""
+    if dimension_numbers is None:
+        return 1
+    if isinstance(dimension_numbers, jax.lax.ConvDimensionNumbers):
+        return int(dimension_numbers.out_spec[1])
+    out_spec = dimension_numbers[2]
+    if isinstance(out_spec, str):
+        return out_spec.index("C")
+    return int(out_spec[1])

@@ -71,11 +71,14 @@ class Convolution2D(KerasLayer):
 
     def call(self, params, x, training=False, **kw):
         pad = "SAME" if self.border_mode == "same" else "VALID"
-        y = jax.lax.conv_general_dilated(
-            x, params["kernel"].astype(x.dtype), self.subsample, pad,
-            rhs_dilation=self.dilation, dimension_numbers=self._dn())
+        # quant.conv2d passes float kernels straight through; int8
+        # serving kernels (QuantTensor) take the calibrated-compute path
+        from .....ops import quant
+        y = quant.conv2d(x, params["kernel"], self.subsample, pad,
+                         rhs_dilation=self.dilation,
+                         dimension_numbers=self._dn())
         if self.bias:
-            b = params["bias"].astype(x.dtype)
+            b = params["bias"].astype(y.dtype)
             y = y + (b[None, :, None, None] if self.dim_ordering == "th"
                      else b)
         return self.activation(y) if self.activation else y

@@ -134,11 +134,18 @@ class QuantizedModel(FloatModel):
             self.calibrate(calibration)
 
     def _compute_eligible_paths(self) -> frozenset:
-        from ..api.keras.layers import Dense, SparseDense
+        from ..api.keras.layers import (AtrousConvolution2D, Convolution2D,
+                                        Dense, ShareConvolution2D,
+                                        SparseDense)
 
         eligible = set()
+        # exact types only: a subclass that overrides call() may not
+        # route through quant.matmul/quant.conv2d. The listed conv
+        # subclasses inherit Convolution2D.call verbatim.
+        ok_types = (Dense, SparseDense, Convolution2D,
+                    AtrousConvolution2D, ShareConvolution2D)
         for layer in getattr(self._graph, "layers", ()):
-            if type(layer) in (Dense, SparseDense):
+            if type(layer) in ok_types:
                 eligible.add(f"['{layer.name}']['kernel']")
         return frozenset(eligible)
 
@@ -172,7 +179,8 @@ class QuantizedModel(FloatModel):
         def apply_scale(leaf):
             if isinstance(leaf, quant.QuantTensor) and \
                     leaf.name in scales and \
-                    leaf.name in self._int8_paths and leaf.q.ndim == 2:
+                    leaf.name in self._int8_paths and \
+                    leaf.q.ndim in (2, 4):
                 return leaf.with_act_scale(scales[leaf.name])
             return leaf
 
@@ -201,7 +209,7 @@ def _dequantize(params, int8_paths=frozenset()):
     def conv(p):
         if not isinstance(p, quant.QuantTensor):
             return p
-        passthrough = p.q.ndim == 2 and p.name in int8_paths and (
+        passthrough = p.q.ndim in (2, 4) and p.name in int8_paths and (
             p.act_scale is not None or quant._recorder.active)
         return p if passthrough else p.dequantize()
 

@@ -277,3 +277,48 @@ class TestCalibratedInt8:
             if isinstance(l, quant.QuantTensor) and
             l.act_scale is not None]
         assert cal, "Dense head should be calibrated"
+
+    def test_cnn_calibrated_int8(self):
+        """Conv path (r5): Convolution2D kernels take the int8-compute
+        route after calibration — the CNN small-batch serving case that
+        was OpenVINO int8's headline. Gate: <=0.1% accuracy drop."""
+        import jax
+        from analytics_zoo_tpu.ops import quant
+        from analytics_zoo_tpu.pipeline.api.keras.layers import (
+            Convolution2D, Flatten)
+
+        # separable image task: vertical vs horizontal stripes
+        rng = np.random.default_rng(9)
+        n, size = 256, 12
+        y = rng.integers(0, 2, n).astype(np.int32)
+        x = rng.normal(0, 0.3, (n, 3, size, size)).astype(np.float32)
+        stripes = (np.arange(size) // 2 % 2).astype(np.float32) * 2 - 1
+        x[y == 0] += stripes[None, None, None, :]
+        x[y == 1] += stripes[None, None, :, None]
+
+        m = Sequential()
+        m.add(Convolution2D(8, 3, 3, activation="relu",
+                            input_shape=(3, size, size), name="c1"))
+        m.add(Flatten())
+        m.add(Dense(2, activation="softmax", name="out"))
+        m.compile("adam", "sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+        m.fit(x, y, batch_size=64, nb_epoch=6)
+        facc = np.mean(np.argmax(m.predict(x, batch_size=128), 1) == y)
+        assert facc > 0.9, facc
+
+        inf = InferenceModel()
+        inf.load_keras_net(m, calibration=[x[:64], x[64:128]])
+        qm = inf.model
+        conv_leaves = [l for l in jax.tree_util.tree_leaves(
+            qm._params, is_leaf=lambda p: isinstance(p, quant.QuantTensor))
+            if isinstance(l, quant.QuantTensor) and l.q.ndim == 4]
+        assert conv_leaves and all(
+            l.act_scale is not None for l in conv_leaves)
+        jaxpr = str(jax.make_jaxpr(
+            lambda p, s, xx: qm._fwd(p, s, xx))(
+                qm._params, qm._state, x[:4]))
+        assert "conv_general_dilated" in jaxpr and \
+            "preferred_element_type=int32" in jaxpr
+        qacc = np.mean(np.argmax(inf.predict(x), 1) == y)
+        assert facc - qacc <= 0.001, (facc, qacc)
