@@ -243,3 +243,95 @@ class TestKeras2Expansion:
                   metrics=["accuracy"])
         m.fit(x, y, batch_size=32, nb_epoch=30)
         assert m.evaluate(x, y, batch_size=32)["accuracy"] > 0.7
+
+
+class TestKeras2ModelDialect:
+    """r5: keras2.models carries the keras-2 TRAINING dialect
+    (fit(epochs=, validation_split=)) over the shared keras-1 engine —
+    the last pass-through module now adapts, like keras2.layers does."""
+
+    def test_fit_epochs_and_validation_split(self):
+        from analytics_zoo_tpu.pipeline.api.keras2.layers import Dense
+        from analytics_zoo_tpu.pipeline.api.keras2.models import Sequential
+
+        rng = np.random.default_rng(0)
+        x = rng.random((200, 8)).astype(np.float32)
+        w = rng.standard_normal(8).astype(np.float32)
+        y = (x @ w > 0).astype(np.int32)
+        m = Sequential()
+        m.add(Dense(16, activation="relu", input_shape=(8,)))
+        m.add(Dense(2, activation="softmax"))
+        from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+        m.compile(Adam(lr=1e-2), "sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+        m.fit(x, y, batch_size=32, epochs=12, validation_split=0.2)
+        # validation ran on the 20% tail: trainer saw only 160 samples
+        assert m.trainer.step == 12 * (160 // 32)
+        res = m.evaluate(x, y, batch_size=64)
+        assert res["accuracy"] > 0.7, res
+
+    def test_functional_model_accepts_epochs(self):
+        from analytics_zoo_tpu.pipeline.api.keras2.layers import Dense, Input
+        from analytics_zoo_tpu.pipeline.api.keras2.models import Model
+
+        rng = np.random.default_rng(1)
+        x = rng.random((64, 4)).astype(np.float32)
+        y = (x.sum(1) > 2).astype(np.int32)
+        a = Input(shape=(4,))
+        out = Dense(2, activation="softmax")(Dense(8, activation="tanh")(a))
+        m = Model(a, out)
+        m.compile("adam", "sparse_categorical_crossentropy")
+        m.fit(x, y, batch_size=16, epochs=2)      # keras-2 spelling
+        m.fit(x, y, batch_size=16, nb_epoch=1)    # keras-1 still accepted
+        assert m.predict(x[:4], batch_size=4).shape == (4, 2)
+
+    def test_dialect_guards(self):
+        """r5 review findings: loud failures for typo'd kwargs, epoch
+        conflicts, and validation_split without arrays; multi-output
+        label lists split on the SAMPLE axis; load_model keeps the
+        keras-2 dialect."""
+        import tempfile
+        import pytest as _pytest
+        from analytics_zoo_tpu.pipeline.api.keras2.layers import Dense
+        from analytics_zoo_tpu.pipeline.api.keras2 import models as k2m
+
+        rng = np.random.default_rng(2)
+        x = rng.random((60, 6)).astype(np.float32)
+        y = (x.sum(1) > 3).astype(np.int32)
+        m = k2m.Sequential()
+        m.add(Dense(2, activation="softmax", input_shape=(6,)))
+        m.compile("adam", "sparse_categorical_crossentropy")
+        with _pytest.raises(TypeError, match="epohcs"):
+            m.fit(x, y, epohcs=5)
+        with _pytest.raises(TypeError, match="conflicting"):
+            m.fit(x, y, epochs=5, nb_epoch=1)
+        with _pytest.raises(ValueError, match="validation_split"):
+            from analytics_zoo_tpu.feature.feature_set import \
+                ArrayFeatureSet
+            m.fit(ArrayFeatureSet(x, y), validation_split=0.2)
+        m.fit(x, y, batch_size=30, epochs=1)
+
+        d = tempfile.mkdtemp()
+        m.save_model(d + "/k2", over_write=True)
+        m2 = k2m.Sequential.load_model(d + "/k2")
+        # the loader rebuilds Sequential as its graph form; what must
+        # survive is the keras-2 DIALECT, not the concrete class
+        assert isinstance(m2, (k2m.Sequential, k2m.Model)), type(m2)
+        m2.compile("adam", "sparse_categorical_crossentropy")
+        m2.fit(x, y, batch_size=30, epochs=1)   # dialect survived reload
+
+    def test_dialect_multi_output_split(self):
+        from analytics_zoo_tpu.pipeline.api.keras2.layers import Dense, Input
+        from analytics_zoo_tpu.pipeline.api.keras2.models import Model
+
+        rng = np.random.default_rng(4)
+        x = rng.random((50, 5)).astype(np.float32)
+        y1 = (x.sum(1) > 2.5).astype(np.int32)
+        y2 = x.sum(1, keepdims=True).astype(np.float32)
+        a = Input(shape=(5,))
+        h = Dense(8, activation="tanh")(a)
+        m = Model(a, [Dense(2, activation="softmax")(h), Dense(1)(h)])
+        m.compile("adam", ["sparse_categorical_crossentropy", "mse"])
+        m.fit(x, [y1, y2], batch_size=10, epochs=1, validation_split=0.2)
+        # 40 training samples -> 4 steps at batch 10
+        assert m.trainer.step == 4, m.trainer.step
