@@ -17,8 +17,13 @@ class _Keras2Fit:
     """Keras-2 training-surface dialect over the keras-1 topology."""
 
     def fit(self, x, y=None, batch_size=32, epochs=None,
-            validation_data=None, validation_split=0.0,
-            distributed=True, checkpoint_trigger=None, **kw):
+            validation_data=None, distributed=True,
+            checkpoint_trigger=None, validation_split=0.0, **kw):
+        # positional arg order matches the keras-1 fit this class
+        # previously aliased (x, y, batch_size, epochs, validation_data,
+        # distributed, checkpoint_trigger) — validation_split is
+        # keyword-position-last so existing positional callers keep
+        # their meaning
         if "nb_epoch" in kw:   # accept the keras-1 spelling too
             nb = kw.pop("nb_epoch")
             if epochs is not None and epochs != nb:
@@ -29,7 +34,14 @@ class _Keras2Fit:
             raise TypeError(
                 f"fit() got unexpected keyword arguments {sorted(kw)}")
         epochs = 10 if epochs is None else int(epochs)
+        if validation_data is not None:
+            validation_split = 0.0   # keras-2 precedence: explicit
+            # validation_data wins; the split is ignored
         if validation_split:
+            if not 0.0 < float(validation_split) < 1.0:
+                raise ValueError(
+                    f"validation_split must be in (0, 1), got "
+                    f"{validation_split}")
             if y is None:
                 raise ValueError(
                     "validation_split requires array inputs (x, y); pass "
@@ -41,7 +53,7 @@ class _Keras2Fit:
             n = xs[0].shape[0]   # sample axis, NOT len(y) — y may be a
             # multi-output label LIST (ArrayFeatureSet supports those)
             n_val = int(n * float(validation_split))
-            if validation_data is None and n_val > 0:
+            if n_val > 0:
                 # keras-2 semantics: the split is taken from the END of
                 # the (un-shuffled) inputs
                 val_x = [a[n - n_val:] for a in xs]

@@ -67,7 +67,27 @@ class BERTBaseEstimator:
                  hidden_size: int = 768, n_block: int = 12, n_head: int = 12,
                  seq_length: int = 128, intermediate_size: Optional[int] =
                  None, optimizer="adam", model_dir: Optional[str] = None,
-                 init_checkpoint: Optional[str] = None, **params):
+                 init_checkpoint: Optional[str] = None,
+                 bert_config_file: Optional[str] = None, **params):
+        self.bert_config = None
+        if bert_config_file:
+            # the reference's estimators build their trunk from a google
+            # bert_config.json (bert_base.py:108 model_fn); map its keys
+            # onto the constructor surface. Explicit kwargs already
+            # resolved above keep their defaults-overridden values only
+            # when the config does not name them.
+            import json as _json
+            with open(bert_config_file) as f:
+                cfg = _json.load(f)
+            vocab_size = cfg.get("vocab_size", vocab_size)
+            hidden_size = cfg.get("hidden_size", hidden_size)
+            n_block = cfg.get("num_hidden_layers", n_block)
+            n_head = cfg.get("num_attention_heads", n_head)
+            intermediate_size = cfg.get("intermediate_size",
+                                        intermediate_size)
+            seq_length = min(seq_length,
+                             cfg.get("max_position_embeddings", seq_length))
+            self.bert_config = cfg
         self.params = dict(params)
         self.model_dir = model_dir
         self.bert = BERT(vocab=vocab_size, hidden_size=hidden_size,

@@ -9,9 +9,11 @@ synthetic "scenes", and retrieval must place same-class scenes above
 other classes.
 """
 
+import os
+
 import numpy as np
 
-from common import example_args
+from common import example_args, reference_resource
 
 from analytics_zoo_tpu.models.image.imageclassification import \
     ImageClassifier
@@ -35,15 +37,13 @@ def scene_like(n, seed=0):
 def main():
     args = example_args("Image similarity / backbone embeddings",
                         samples=64)
+    if os.environ.get("ZOO_ONLY_REAL"):
+        real_imagenet_section(_make_embedder())
+        print("Image-similarity example OK (real leg only)")
+        return
     imgs, cls = scene_like(args.samples, seed=args.seed)
 
-    clf = ImageClassifier(class_num=10, model_name="mobilenet",
-                          input_shape=(3, SIDE, SIDE))
-    # graph surgery: re-root on the global-average-pool embedding, exactly
-    # the reference notebook's newGraph(["pool5/drop_7x7_s1"]) move
-    gap = [layer.name for layer in clf.model.graph_function().layers
-           if type(layer).__name__ == "GlobalAveragePooling2D"][-1]
-    embedder = clf.model.new_graph([gap])
+    embedder = _make_embedder()
 
     emb = embedder.predict(imgs, batch_size=16)
     emb = emb - emb.mean(axis=0)        # center features before cosine
@@ -96,7 +96,84 @@ def main():
     top = np.argsort(-sims[q])[:5]
     print(f"query image 0 (class {cls[q]}): top-5 retrieved classes "
           f"{cls[top].tolist()}")
+
+    real_imagenet_section(embedder)
     print("Image-similarity example OK")
+
+
+def _make_embedder():
+    clf = ImageClassifier(class_num=10, model_name="mobilenet",
+                          input_shape=(3, SIDE, SIDE))
+    # graph surgery: re-root on the global-average-pool embedding, exactly
+    # the reference notebook's newGraph(["pool5/drop_7x7_s1"]) move
+    gap = [layer.name for layer in clf.model.graph_function().layers
+           if type(layer).__name__ == "GlobalAveragePooling2D"][-1]
+    return clf.model.new_graph([gap])
+
+
+def real_imagenet_section(embedder):
+    """REAL data: the reference's mini-imagenet fixture (3 clean class
+    dirs, 8 genuine JPEGs) through the decode pipeline and the same
+    embedding + retrieval flow. 8 unrelated photos cannot support a
+    class-separation gate without the pretrained backbone the notebook
+    downloads (measured: pixel stats AND an untrained backbone both sit
+    at/below the random baseline), so this leg gates on the FLOW —
+    decode, embed, rank — and reports the metrics unguarded; the
+    metric-gated real-data evidence lives in the NCF / Wide&Deep /
+    text / cat_dog legs."""
+    root = reference_resource("imagenet")
+    if root is None:
+        print("reference fixtures absent; skipping real-imagenet leg")
+        return
+    import os as _os
+
+    from analytics_zoo_tpu.feature.image import ImagePipelineFeatureSet
+
+    classes = [d for d in sorted(_os.listdir(root))
+               if d != "n99999999"]      # mixed/test-junk dir
+    paths, labels = [], []
+    for li, c in enumerate(classes):
+        for f in sorted(_os.listdir(_os.path.join(root, c))):
+            if f.lower().endswith((".jpg", ".jpeg")):
+                paths.append(_os.path.join(root, c, f))
+                labels.append(li)
+    fs = ImagePipelineFeatureSet(paths, np.asarray(labels, np.float32),
+                                 height=SIDE, width=SIDE, num_workers=2,
+                                 data_format="th",
+                                 std=(255.0, 255.0, 255.0))
+    batches = list(fs.batches(len(paths), drop_remainder=False))
+    xs = np.concatenate([b.inputs[0] for b in batches])
+    ys = np.concatenate([b.targets for b in batches]).astype(int)
+    def p_at_1(e):
+        e = e - e.mean(axis=0)
+        e = e / np.maximum(np.linalg.norm(e, axis=1, keepdims=True),
+                           1e-12)
+        s = e @ e.T
+        np.fill_diagonal(s, -np.inf)
+        return float(np.mean(ys[np.argmax(s, 1)] == ys))
+
+    # the notebook embeds with a PRETRAINED GoogLeNet; offline we have
+    # no pretrained weights, so the GATED embedding is color/pixel
+    # statistics (downsampled pixels — scene palettes separate these
+    # classes), and the untrained-backbone number is reported for
+    # reference only
+    pix = xs.reshape(len(xs), 3, SIDE, SIDE)[:, :, ::8, ::8]
+    p1_pix = p_at_1(pix.reshape(len(xs), -1))
+    p1_backbone = p_at_1(np.asarray(embedder.predict(xs, batch_size=8)))
+
+    rng = np.random.default_rng(0)
+    rp1 = []
+    for _ in range(64):
+        r = rng.standard_normal((len(xs), 64))
+        rp1.append(p_at_1(r))
+    rbase = float(np.mean(rp1))
+    print(f"REAL imagenet retrieval: {len(paths)} photos, "
+          f"{len(classes)} classes — p@1 pixel-stats {p1_pix:.2f}, "
+          f"untrained-backbone {p1_backbone:.2f}, random baseline "
+          f"{rbase:.2f} (no separation gate: no pretrained weights "
+          f"offline)")
+    assert 0.0 <= p1_pix <= 1.0 and 0.0 <= p1_backbone <= 1.0
+    assert np.isfinite(rbase)
 
 
 if __name__ == "__main__":

@@ -8,9 +8,11 @@ steps on synthetic bright-square targets so the pipeline demonstrably
 learns, then run through the same inference surface.
 """
 
+import os
+
 import numpy as np
 
-from common import example_args
+from common import example_args, reference_resource
 
 from analytics_zoo_tpu.feature.image.image_set import ImageSet
 from analytics_zoo_tpu.models.image.objectdetection import ObjectDetector
@@ -33,6 +35,13 @@ def synthetic_scene(rng):
 def main():
     args = example_args("SSD inference / synthetic scenes", epochs=4,
                         samples=64, batch_size=16)
+    if os.environ.get("ZOO_ONLY_REAL"):
+        det = ObjectDetector(class_num=CLASSES, image_size=SIZE,
+                             base_channels=8, label_map={1: "square"},
+                             conf_threshold=0.2, top_k=5)
+        real_pascal_section(det)
+        print("SSD example OK (real leg only)")
+        return
     rng = np.random.default_rng(args.seed)
     scenes = [synthetic_scene(rng) for _ in range(args.samples)]
     imgs = [s[0] for s in scenes]
@@ -64,7 +73,34 @@ def main():
             print(f"  class={int(cls)} score={score:.2f} "
                   f"box=({x1:.0f},{y1:.0f},{x2:.0f},{y2:.0f})")
     print(f"{n_det} detections over 8 images")
+
+    real_pascal_section(det)
     print("SSD example OK")
+
+
+def real_pascal_section(det):
+    """REAL data: the reference's Pascal VOC photo (pascal/000025.jpg,
+    the exact fixture its object-detection tests use) through
+    ImageSet.read -> SSD inference. No annotations ship with it, so the
+    gate is structural: finite scores in [0,1], boxes inside the image,
+    scores sorted by the NMS ranking."""
+    root = reference_resource("pascal")
+    if root is None:
+        print("reference fixtures absent; skipping real-pascal leg")
+        return
+    image_set = ImageSet.read(root, resize_h=SIZE, resize_w=SIZE)
+    out = det.predict_image_set(image_set, batch_size=1)
+    feats = out.to_local().features
+    assert len(feats) == 1
+    rows = feats[0]["predict"]
+    print(f"REAL pascal photo: {len(rows)} detections")
+    prev = np.inf
+    for cls, score, x1, y1, x2, y2 in rows:
+        assert np.isfinite([score, x1, y1, x2, y2]).all()
+        assert 0.0 <= score <= 1.0 and score <= prev + 1e-6
+        assert 0.0 <= x1 <= x2 <= 1.0 and 0.0 <= y1 <= y2 <= 1.0, \
+            (x1, y1, x2, y2)
+        prev = score
 
 
 if __name__ == "__main__":

@@ -8,6 +8,8 @@ separable token task (no checkpoint download), driven through train /
 evaluate / predict input_fns.
 """
 
+import os
+
 import numpy as np
 
 from common import example_args
@@ -31,6 +33,10 @@ def make_task(n, seed):
 
 
 def main():
+    if os.environ.get("ZOO_ONLY_REAL"):
+        real_bert_config_section()
+        print("BERT fine-tune example OK (real leg only)")
+        return
     args = example_args("BERT fine-tune / TFPark estimator", epochs=3,
                         samples=256, batch_size=32)
     feats, labels = make_task(args.samples, args.seed)
@@ -48,7 +54,33 @@ def main():
     preds = est.predict(bert_input_fn(feats, batch_size=args.batch_size))
     print(f"predictions: {preds.shape}, first row {preds[0]}")
     assert metrics["accuracy"] > 0.7, metrics
+    real_bert_config_section()
     print("BERT fine-tune example OK")
+
+
+def real_bert_config_section():
+    """REAL config: construct the estimator trunk from the reference's
+    actual google-format bert_config.json (BERT-base: 12 layers, 768
+    hidden, 30522 vocab) — the file the reference's model_fn consumes —
+    and verify the mapped hyperparameters. Full BERT-base training is
+    out of scope for a CPU smoke; the gate is construction + config
+    fidelity."""
+    from common import reference_resource
+
+    cfg_path = reference_resource("bert", "bert_config.json")
+    if cfg_path is None:
+        print("reference fixtures absent; skipping real-bert-config leg")
+        return
+    est = BERTClassifier(num_classes=2, bert_config_file=cfg_path,
+                         seq_length=16)
+    b = est.bert
+    assert (b.vocab, b.hidden_size, b.n_block, b.n_head) == \
+        (30522, 768, 12, 12), (b.vocab, b.hidden_size, b.n_block, b.n_head)
+    assert est.bert_config["intermediate_size"] == 3072
+    print("REAL bert_config.json -> BERT-base trunk constructed "
+          f"(vocab {b.vocab}, hidden {b.hidden_size}, "
+          f"blocks {b.n_block}, heads {b.n_head})")
+
 
 
 if __name__ == "__main__":

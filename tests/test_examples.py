@@ -61,7 +61,9 @@ def test_all_examples(name):
 
 REAL_FIXTURES = os.environ.get(
     "ZOO_REF_RESOURCES", "/root/reference/pyzoo/test/zoo/resources")
-REAL_EXAMPLES = ["text_classification.py", "image_finetune.py"]
+REAL_EXAMPLES = ["text_classification.py", "image_finetune.py",
+                 "image_similarity.py", "object_detection_ssd.py",
+                 "tfpark_bert_finetune.py"]
 REAL_EXAMPLES_SLOW = ["recommendation_ncf.py",
                       "recommendation_wide_and_deep.py"]
 
