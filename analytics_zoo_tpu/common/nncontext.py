@@ -33,6 +33,13 @@ class ZooConfig:
     sequence_parallel: int = 1
     pipeline_parallel: int = 1
     expert_parallel: int = 1
+    # long-context strategy when sequence_parallel > 1 (SURVEY §5.7):
+    # "auto" picks ulysses (all-to-all head/seq swap — 2 collectives,
+    # full-L local attention, flash-kernel friendly) when the head count
+    # divides the seq axis, else ring (ppermute ring, O(L/N) score
+    # memory, works for any head count). Explicit "ring" / "ulysses"
+    # force the choice.
+    sequence_parallel_mode: str = "auto"
     # compute dtype for matmul-heavy paths
     compute_dtype: str = "float32"
     # failure retry (reference: bigdl.failure.retryTimes, Topology.scala:1172)

@@ -108,16 +108,8 @@ def ring_attention_sharded(q, k, v, mesh, causal=False, sm_scale=None,
     """Convenience wrapper: q,k,v are global (B,H,L,D) arrays; runs
     ring_attention under shard_map with L sharded over ``seq_axis``.
     ``kbias``: optional global (B, L) additive key bias (padding mask)."""
-    from jax.sharding import PartitionSpec as P
+    from .ulysses import sharded_seq_attention
 
-    spec = P(None, None, seq_axis, None)
-    fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal,
-                           sm_scale=sm_scale)
-    if kbias is None:
-        return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                             out_specs=spec)(q, k, v)
-    kb_spec = P(None, seq_axis)
-    fn2 = lambda q, k, v, kb: fn(q, k, v, kbias=kb)  # noqa: E731
-    return jax.shard_map(fn2, mesh=mesh,
-                         in_specs=(spec, spec, spec, kb_spec),
-                         out_specs=spec)(q, k, v, kbias)
+    return sharded_seq_attention(ring_attention, q, k, v, mesh,
+                                 causal=causal, sm_scale=sm_scale,
+                                 seq_axis=seq_axis, kbias=kbias)

@@ -223,18 +223,32 @@ class TransformerLayer(KerasLayer):
 
         sp = self._seq_parallel()
         if sp > 1 and l % sp == 0:
-            # sequence parallelism: the O(L^2) attention runs as a ring
-            # over the 'seq' mesh axis — per-device score memory O(L/sp)
-            # (parallel/ring_attention.py; key-padding bias rides the ring)
+            # sequence parallelism over the 'seq' mesh axis: ulysses
+            # (all-to-all head/seq swap, full-L local attention — the
+            # flash kernel's favourite shape) when the head count splits
+            # across the axis, else the ppermute ring with O(L/sp) score
+            # memory (parallel/ulysses.py, parallel/ring_attention.py;
+            # key-padding bias rides along either way)
             from .....common.nncontext import get_nncontext
             from .....parallel.ring_attention import ring_attention_sharded
+            from .....parallel.ulysses import ulysses_attention_sharded
 
+            mode = str(getattr(get_nncontext().config,
+                               "sequence_parallel_mode", "auto")).lower()
+            if mode not in ("auto", "ring", "ulysses"):
+                raise ValueError(
+                    f"sequence_parallel_mode must be auto|ring|ulysses, "
+                    f"got {mode!r}")
+            use_ulysses = (mode == "ulysses" or
+                           (mode == "auto" and nh % sp == 0))
             kb = None
             if mask_bias is not None:
                 kb = jnp.broadcast_to(
                     mask_bias.reshape(mask_bias.shape[0], l),
                     (b, l)).astype(jnp.float32)
-            o = ring_attention_sharded(
+            sp_attn = ulysses_attention_sharded if use_ulysses \
+                else ring_attention_sharded
+            o = sp_attn(
                 heads(q), heads(k), heads(v), get_nncontext().mesh,
                 causal=not self.bidirectional, kbias=kb)
         else:

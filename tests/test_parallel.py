@@ -441,3 +441,120 @@ def test_flash_kernel_ineligible_shapes_route_to_xla(monkeypatch):
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-5, atol=1e-5)
     assert not calls, "ineligible shapes must never reach the kernel"
+
+
+class TestUlysses:
+    """r5: the all-to-all sequence-parallel strategy (parallel/ulysses.py)
+    — full-L local attention over head shards, parity vs the reference
+    for fwd/bwd, causal x kbias, plus the layer-level strategy routing."""
+
+    def _mesh(self):
+        from analytics_zoo_tpu.parallel.mesh import make_mesh
+        return make_mesh(data=1, seq=8)
+
+    def test_parity_fwd_bwd(self):
+        from analytics_zoo_tpu.parallel import ulysses_attention_sharded
+
+        mesh = self._mesh()
+        rng = np.random.default_rng(0)
+        b, h, l, d = 2, 8, 64, 16
+        q, k, v = (jnp.asarray(rng.standard_normal((b, h, l, d)),
+                               jnp.float32) for _ in range(3))
+        kbias = jnp.zeros((b, l)).at[:, 50:].set(-10000.0)
+        for causal in (False, True):
+            for kb in (None, kbias):
+                out = ulysses_attention_sharded(q, k, v, mesh,
+                                                causal=causal, kbias=kb)
+                bias4 = None if kb is None else kb[:, None, None, :]
+                ref = attention_reference(q, k, v, bias=bias4,
+                                          causal=causal)
+                np.testing.assert_allclose(np.asarray(out),
+                                           np.asarray(ref),
+                                           rtol=2e-5, atol=2e-5)
+
+        # backward coverage over the causal x kbias grid for BOTH
+        # strategies (the kbias cotangent flows through all_gather in
+        # ulysses and rides the ring otherwise)
+        from analytics_zoo_tpu.parallel import ring_attention_sharded
+
+        for sp_fn in (ulysses_attention_sharded, ring_attention_sharded):
+            for causal in (False, True):
+                for kb in (None, kbias):
+                    def loss(q, k, v, _fn=sp_fn, _c=causal, _kb=kb):
+                        return (_fn(q, k, v, mesh, causal=_c,
+                                    kbias=_kb) ** 2).mean()
+
+                    def loss_ref(q, k, v, _c=causal, _kb=kb):
+                        b4 = None if _kb is None else _kb[:, None, None, :]
+                        return (attention_reference(
+                            q, k, v, bias=b4, causal=_c) ** 2).mean()
+
+                    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+                    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+                    for a, b_ in zip(g, gr):
+                        np.testing.assert_allclose(
+                            np.asarray(a), np.asarray(b_),
+                            rtol=2e-4, atol=2e-4)
+
+    def test_head_count_guard(self):
+        from analytics_zoo_tpu.parallel import ulysses_attention_sharded
+
+        mesh = self._mesh()
+        rng = np.random.default_rng(1)
+        q = jnp.asarray(rng.standard_normal((1, 4, 64, 8)), jnp.float32)
+        with pytest.raises(ValueError, match="heads % devices"):
+            ulysses_attention_sharded(q, q, q, mesh)   # 4 heads, 8 devs
+
+    def test_layer_strategy_routing(self, monkeypatch):
+        """sequence_parallel_mode: auto picks ulysses when heads divide
+        the seq axis, ring otherwise; explicit modes force the choice."""
+        from analytics_zoo_tpu.common.nncontext import (ZooConfig,
+                                                        ZooContext,
+                                                        set_nncontext)
+        import importlib
+        # the package re-exports shadow the submodule names
+        R = importlib.import_module(
+            "analytics_zoo_tpu.parallel.ring_attention")
+        U = importlib.import_module("analytics_zoo_tpu.parallel.ulysses")
+        from analytics_zoo_tpu.pipeline.api.keras.layers.self_attention \
+            import TransformerLayer
+
+        calls = {"ring": 0, "ulysses": 0}
+        real_r, real_u = R.ring_attention_sharded, U.ulysses_attention_sharded
+
+        def spy_r(*a, **kw):
+            calls["ring"] += 1
+            return real_r(*a, **kw)
+
+        def spy_u(*a, **kw):
+            calls["ulysses"] += 1
+            return real_u(*a, **kw)
+
+        monkeypatch.setattr(R, "ring_attention_sharded", spy_r)
+        monkeypatch.setattr(U, "ulysses_attention_sharded", spy_u)
+
+        rng = np.random.default_rng(2)
+        tokens = rng.integers(0, 50, (2, 8)).astype(np.int32)
+
+        def run(mode, n_head):
+            set_nncontext(None)
+            set_nncontext(ZooContext(ZooConfig(
+                data_parallel=2, sequence_parallel=4,
+                sequence_parallel_mode=mode)))
+            layer = TransformerLayer(n_block=1, hidden_size=32,
+                                     n_head=n_head, vocab=50, seq_len=8)
+            import jax as _jax
+            params = layer.build(_jax.random.PRNGKey(0),
+                                 [(None, 8), (None, 1, 1, 8)])
+            layer.call(params, [tokens,
+                                np.ones((2, 1, 1, 8), np.float32)])
+
+        try:
+            run("auto", n_head=8)       # 8 % 4 == 0 -> ulysses
+            assert calls == {"ring": 0, "ulysses": 1}, calls
+            run("auto", n_head=2)       # 2 % 4 != 0 -> ring
+            assert calls == {"ring": 1, "ulysses": 1}, calls
+            run("ring", n_head=8)
+            assert calls == {"ring": 2, "ulysses": 1}, calls
+        finally:
+            set_nncontext(None)
