@@ -40,6 +40,15 @@ class ZooConfig:
     # memory, works for any head count). Explicit "ring" / "ulysses"
     # force the choice.
     sequence_parallel_mode: str = "auto"
+    # parameter layout applied when a model has no explicit
+    # set_param_sharding(): "auto" installs the annotation-driven layout
+    # (parallel.sharding DEFAULT_RULES) whenever the mesh has a
+    # non-data axis > 1 — so tp/pp/ep Just Work from Model.fit;
+    # "fsdp" additionally shards embed-annotated params over the DATA
+    # axis (ZeRO-3-style weight+optimizer-state sharding, XLA inserts
+    # the all-gathers); "default" forces the annotation layout even on
+    # pure-dp meshes; "none" restores the explicit-only behavior.
+    param_sharding: str = "auto"
     # compute dtype for matmul-heavy paths
     compute_dtype: str = "float32"
     # failure retry (reference: bigdl.failure.retryTimes, Topology.scala:1172)

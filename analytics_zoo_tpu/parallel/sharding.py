@@ -28,7 +28,12 @@ DEFAULT_RULES: Dict[str, Optional[str]] = {
     "stage": "pipe",      # stacked pipeline-stage weights over the PP axis
 }
 
-FSDP_RULES = dict(DEFAULT_RULES, embed="data")  # fully-sharded variant
+# Fully-sharded variant (ZeRO-3 style): weights (and therefore their
+# optimizer moments, which follow param sharding) spread over the DATA
+# axis — 'embed' covers transformer hidden dims, 'out' covers plain
+# Dense kernels; XLA inserts the all-gathers at use sites.
+FSDP_RULES = dict(DEFAULT_RULES, embed="data", out="data")
+FSDP_RULES["in"] = "data"   # ("in" is a keyword; no kwarg spelling)
 
 
 def make_param_sharding_fn(graph, mesh, rules: Optional[Dict] = None):
@@ -40,17 +45,52 @@ def make_param_sharding_fn(graph, mesh, rules: Optional[Dict] = None):
     annotations: Dict[str, Dict[str, tuple]] = {
         layer.name: layer.param_axes() for layer in graph.layers}
 
-    def spec_for(layer_name, path):
+    def spec_for(layer_name, path, shape):
         axes = annotations.get(layer_name, {})
         key = "/".join(path)
         logical = axes.get(key)
         if logical is None:
             return P()
         mesh_axes = []
-        for ax in logical:
+        for i, ax in enumerate(logical):
             mapped = rules.get(ax) if ax is not None else None
-            mesh_axes.append(mapped if mapped in mesh.axis_names else None)
-        # a dim can only be sharded if divisible; leave validation to runtime
+            if mapped not in mesh.axis_names:
+                mapped = None
+            # a dim can only be sharded if divisible by the axis size —
+            # fall back to replication for the small leaves (biases,
+            # tiny heads) instead of a runtime device_put error. For
+            # LARGE leaves that fallback defeats the layout's memory
+            # purpose, so it is loud.
+            if mapped is not None and (
+                    i >= len(shape) or
+                    shape[i] % mesh.shape[mapped] != 0):
+                import math as _math
+                if _math.prod(shape) >= 1_000_000:
+                    import logging
+                    logging.getLogger(
+                        "analytics_zoo_tpu.parallel").warning(
+                        "param %s/%s dim %d (size %s) is not divisible "
+                        "by mesh axis %r (%d) — REPLICATING a large "
+                        "tensor; pad the dim or change the layout",
+                        layer_name, key, i,
+                        shape[i] if i < len(shape) else "?",
+                        mapped, mesh.shape[mapped])
+                mapped = None
+            mesh_axes.append(mapped)
+        # one mesh axis may shard only ONE dim (fsdp maps several logical
+        # axes to 'data'): keep it on the largest divisible dim
+        seen: Dict[str, int] = {}
+        for i, mapped in enumerate(mesh_axes):
+            if mapped is None:
+                continue
+            j = seen.get(mapped)
+            if j is None:
+                seen[mapped] = i
+            elif shape[i] > shape[j]:
+                mesh_axes[j] = None
+                seen[mapped] = i
+            else:
+                mesh_axes[i] = None
         return P(*mesh_axes)
 
     def sharding_fn(params):
@@ -58,7 +98,8 @@ def make_param_sharding_fn(graph, mesh, rules: Optional[Dict] = None):
             if isinstance(subtree, dict):
                 return {k: walk(v, layer_name, path + [k])
                         for k, v in subtree.items()}
-            return NamedSharding(mesh, spec_for(layer_name, path))
+            shape = tuple(getattr(subtree, "shape", ()))
+            return NamedSharding(mesh, spec_for(layer_name, path, shape))
 
         return {layer_name: walk(sub, layer_name, [])
                 for layer_name, sub in params.items()}

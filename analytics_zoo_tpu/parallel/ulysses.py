@@ -28,7 +28,6 @@ import math
 from typing import Optional
 
 import jax
-import jax.numpy as jnp
 
 
 def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
@@ -40,7 +39,7 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
     gathered to full length for the local attention.
     """
     n = jax.lax.psum(1, axis_name)
-    b, h, l_loc, d = q.shape
+    h, d = q.shape[1], q.shape[3]
     if h % n != 0:
         raise ValueError(f"ulysses needs heads % devices == 0, got "
                          f"H={h} over {n} devices (use ring_attention)")

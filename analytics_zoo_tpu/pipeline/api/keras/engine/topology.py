@@ -156,10 +156,13 @@ class KerasNet(KerasLayer):
 
         optimizer = self.optimizer or get_optimizer("sgd")
         loss = self.loss if self.loss is not None else get_loss("mse")
+        sharding_fn = getattr(self, "_param_sharding_fn", None)
+        if sharding_fn is None:
+            sharding_fn = self._config_param_sharding(graph)
         self.trainer = SPMDTrainer(
             apply_fn, init_fn, loss, optimizer, metrics=self.metrics,
             compute_dtype=self._compute_dtype, clipping=self._clipping,
-            param_sharding_fn=getattr(self, "_param_sharding_fn", None))
+            param_sharding_fn=sharding_fn)
         if old_params is not None:
             self.trainer.set_params(old_params, old_state)
         if self._checkpoint_dir:
@@ -229,6 +232,35 @@ class KerasNet(KerasLayer):
         """Install a params->shardings fn (see parallel.sharding)."""
         self._param_sharding_fn = fn
         self.trainer = None
+
+    def _config_param_sharding(self, graph):
+        """Config-driven default layout (ZooConfig.param_sharding) when no
+        explicit set_param_sharding() was given: "auto" applies the
+        annotation-driven rules whenever the ambient mesh has a non-data
+        axis > 1; "fsdp" also shards embed-annotated params over the
+        data axis (ZeRO-3 style); "none" keeps the explicit-only
+        contract."""
+        from .....common import nncontext as _nn
+
+        ctx = _nn._global_context
+        if ctx is None:
+            return None
+        mode = str(getattr(ctx.config, "param_sharding", "auto")).lower()
+        if mode not in ("auto", "none", "default", "fsdp"):
+            raise ValueError(
+                f"param_sharding must be auto|none|default|fsdp, "
+                f"got {mode!r}")
+        if mode == "none":
+            return None
+        shape = dict(ctx.mesh.shape)
+        non_data = any(v > 1 for ax, v in shape.items() if ax != "data")
+        if mode == "auto" and not non_data:
+            return None
+        from .....parallel.sharding import (FSDP_RULES,
+                                            make_param_sharding_fn)
+
+        rules = FSDP_RULES if mode == "fsdp" else None
+        return make_param_sharding_fn(graph, ctx.mesh, rules=rules)
 
     # -- training surface ---------------------------------------------
     def fit(self, x, y=None, batch_size=32, nb_epoch=10,

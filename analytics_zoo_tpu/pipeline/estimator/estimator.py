@@ -130,11 +130,17 @@ class Estimator(AbstractEstimator):
             return graph.apply(params, inputs, state=state, training=training,
                                rng=rng, collect_state=True)
 
+        sharding_fn = getattr(self.model, "_param_sharding_fn", None)
+        if sharding_fn is None and hasattr(self.model,
+                                           "_config_param_sharding"):
+            # same config-driven fallback as Model.fit (auto TP / fsdp) —
+            # both documented training surfaces must lay params out
+            # identically
+            sharding_fn = self.model._config_param_sharding(graph)
         self.trainer = SPMDTrainer(
             apply_fn, graph.init, criterion, self.optimizer,
             metrics=metrics, clipping=self._clipping,
-            param_sharding_fn=getattr(self.model, "_param_sharding_fn",
-                                      None))
+            param_sharding_fn=sharding_fn)
         if getattr(self.model, "_built_params", None) is not None:
             self.trainer.set_params(*self.model._built_params)
         if getattr(self, "_pending_params", None) is not None:

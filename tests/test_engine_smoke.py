@@ -375,3 +375,91 @@ def test_async_checkpoint(tmp_path):
         trainer._write_flat_checkpoint = orig
     finally:
         set_nncontext(None)
+
+
+class TestConfigParamSharding:
+    """r5: tp/fsdp layouts reachable from plain Model.fit via
+    ZooConfig.param_sharding — no explicit set_param_sharding() call."""
+
+    def _fit_small(self, cfg):
+        from analytics_zoo_tpu.common.nncontext import (ZooContext,
+                                                        set_nncontext)
+        from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+        from analytics_zoo_tpu.pipeline.api.keras.models import Sequential
+
+        from analytics_zoo_tpu.pipeline.api.keras.layers import (Embedding,
+                                                                  Flatten)
+
+        set_nncontext(None)
+        set_nncontext(ZooContext(cfg))
+        m = Sequential()
+        # Embedding table carries ('vocab','embed') annotations: vocab
+        # maps to the model axis (tp), embed to data under fsdp
+        m.add(Embedding(32, 16, input_shape=(4,), name="emb"))
+        m.add(Flatten())
+        m.add(Dense(2, activation="softmax", name="head"))
+        m.compile("adam", "sparse_categorical_crossentropy")
+        rng = np.random.default_rng(0)
+        x = rng.integers(0, 32, (64, 4)).astype(np.int32)
+        y = rng.integers(0, 2, 64).astype(np.int32)
+        m.fit(x, y, batch_size=16, nb_epoch=1)
+        return m
+
+    def test_auto_applies_tp_layout(self):
+        from analytics_zoo_tpu.common.nncontext import (ZooConfig,
+                                                        set_nncontext)
+
+        try:
+            m = self._fit_small(ZooConfig(data_parallel=2,
+                                          model_parallel=4))
+            table = m.trainer.params["emb"]["table"]
+            assert "model" in tuple(table.sharding.spec), \
+                table.sharding.spec
+        finally:
+            set_nncontext(None)
+
+    def test_fsdp_shards_over_data_axis(self):
+        from analytics_zoo_tpu.common.nncontext import (ZooConfig,
+                                                        set_nncontext)
+
+        try:
+            m = self._fit_small(ZooConfig(data_parallel=8,
+                                          param_sharding="fsdp"))
+            kernel = m.trainer.params["head"]["kernel"]
+            assert "data" in tuple(kernel.sharding.spec), \
+                kernel.sharding.spec
+            table = m.trainer.params["emb"]["table"]
+            assert "data" in tuple(table.sharding.spec), \
+                table.sharding.spec
+            # optimizer moments follow the param layout (the ZeRO point)
+            import jax as _jax
+            mu_leaves = [l for l in _jax.tree_util.tree_leaves(
+                m.trainer.opt_state) if hasattr(l, "sharding")
+                and getattr(l, "ndim", 0) == 2]
+            assert any("data" in tuple(l.sharding.spec)
+                       for l in mu_leaves)
+        finally:
+            set_nncontext(None)
+
+    def test_none_keeps_explicit_contract(self):
+        from analytics_zoo_tpu.common.nncontext import (ZooConfig,
+                                                        set_nncontext)
+
+        try:
+            m = self._fit_small(ZooConfig(data_parallel=8,
+                                          param_sharding="none"))
+            spec = tuple(m.trainer.params["head"]["kernel"].sharding.spec)
+            assert all(s is None for s in spec), spec
+        finally:
+            set_nncontext(None)
+
+    def test_bad_mode_rejected(self):
+        from analytics_zoo_tpu.common.nncontext import (ZooConfig,
+                                                        set_nncontext)
+
+        try:
+            with pytest.raises(ValueError, match="param_sharding"):
+                self._fit_small(ZooConfig(data_parallel=8,
+                                          param_sharding="zero3"))
+        finally:
+            set_nncontext(None)

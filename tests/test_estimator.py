@@ -108,3 +108,37 @@ def test_local_estimator_fit_validate():
     assert "mae" in res and res["loss"] < 1.0
     preds = le.predict(x)
     assert preds.shape == (64, 1)
+
+
+def test_estimator_honors_config_param_sharding():
+    """r5 review finding: the Estimator path must apply the same
+    config-driven layout (ZooConfig.param_sharding) as Model.fit."""
+    from analytics_zoo_tpu.common.nncontext import (ZooConfig, ZooContext,
+                                                    set_nncontext)
+    from analytics_zoo_tpu.feature.feature_set import ArrayFeatureSet
+    from analytics_zoo_tpu.common.zoo_trigger import MaxIteration
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (Dense,
+                                                             Embedding,
+                                                             Flatten)
+    from analytics_zoo_tpu.pipeline.api.keras.models import Sequential
+    from analytics_zoo_tpu.pipeline.estimator import Estimator
+
+    set_nncontext(None)
+    set_nncontext(ZooContext(ZooConfig(data_parallel=8,
+                                       param_sharding="fsdp")))
+    try:
+        m = Sequential()
+        m.add(Embedding(32, 16, input_shape=(4,), name="e2"))
+        m.add(Flatten())
+        m.add(Dense(2, activation="softmax", name="h2"))
+        est = Estimator(m, "adam")
+        rng = np.random.default_rng(0)
+        x = rng.integers(0, 32, (32, 4)).astype(np.int32)
+        y = rng.integers(0, 2, 32).astype(np.int32)
+        est.train(ArrayFeatureSet(x, y),
+                  criterion="sparse_categorical_crossentropy",
+                  end_trigger=MaxIteration(1), batch_size=16)
+        table = est.trainer.params["e2"]["table"]
+        assert "data" in tuple(table.sharding.spec), table.sharding.spec
+    finally:
+        set_nncontext(None)
