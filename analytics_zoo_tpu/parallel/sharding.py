@@ -57,15 +57,15 @@ def make_param_sharding_fn(graph, mesh, rules: Optional[Dict] = None):
             if mapped not in mesh.axis_names:
                 mapped = None
             # a dim can only be sharded if divisible by the axis size —
-            # fall back to replication for the small leaves (biases,
-            # tiny heads) instead of a runtime device_put error. For
-            # LARGE leaves that fallback defeats the layout's memory
-            # purpose, so it is loud.
+            # fall back to replication for small leaves (biases, tiny
+            # heads) instead of a runtime device_put error. Above 16K
+            # elements the fallback defeats the layout's memory/compute
+            # purpose, so it logs a warning.
             if mapped is not None and (
                     i >= len(shape) or
                     shape[i] % mesh.shape[mapped] != 0):
                 import math as _math
-                if _math.prod(shape) >= 1_000_000:
+                if _math.prod(shape) >= 16_384:
                     import logging
                     logging.getLogger(
                         "analytics_zoo_tpu.parallel").warning(

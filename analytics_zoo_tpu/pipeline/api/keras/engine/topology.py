@@ -156,9 +156,7 @@ class KerasNet(KerasLayer):
 
         optimizer = self.optimizer or get_optimizer("sgd")
         loss = self.loss if self.loss is not None else get_loss("mse")
-        sharding_fn = getattr(self, "_param_sharding_fn", None)
-        if sharding_fn is None:
-            sharding_fn = self._config_param_sharding(graph)
+        sharding_fn = self._resolve_param_sharding_fn(graph)
         self.trainer = SPMDTrainer(
             apply_fn, init_fn, loss, optimizer, metrics=self.metrics,
             compute_dtype=self._compute_dtype, clipping=self._clipping,
@@ -232,6 +230,15 @@ class KerasNet(KerasLayer):
         """Install a params->shardings fn (see parallel.sharding)."""
         self._param_sharding_fn = fn
         self.trainer = None
+
+    def _resolve_param_sharding_fn(self, graph):
+        """Single precedence rule for BOTH training surfaces (Model.fit
+        and the Estimator): explicit set_param_sharding wins; otherwise
+        the config-driven layout (ZooConfig.param_sharding)."""
+        fn = getattr(self, "_param_sharding_fn", None)
+        if fn is not None:
+            return fn
+        return self._config_param_sharding(graph)
 
     def _config_param_sharding(self, graph):
         """Config-driven default layout (ZooConfig.param_sharding) when no

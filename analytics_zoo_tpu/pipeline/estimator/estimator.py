@@ -130,13 +130,10 @@ class Estimator(AbstractEstimator):
             return graph.apply(params, inputs, state=state, training=training,
                                rng=rng, collect_state=True)
 
-        sharding_fn = getattr(self.model, "_param_sharding_fn", None)
-        if sharding_fn is None and hasattr(self.model,
-                                           "_config_param_sharding"):
-            # same config-driven fallback as Model.fit (auto TP / fsdp) —
-            # both documented training surfaces must lay params out
-            # identically
-            sharding_fn = self.model._config_param_sharding(graph)
+        # one precedence rule shared with Model.fit (auto TP / fsdp)
+        sharding_fn = self.model._resolve_param_sharding_fn(graph) \
+            if hasattr(self.model, "_resolve_param_sharding_fn") else \
+            getattr(self.model, "_param_sharding_fn", None)
         self.trainer = SPMDTrainer(
             apply_fn, graph.init, criterion, self.optimizer,
             metrics=metrics, clipping=self._clipping,
