@@ -322,3 +322,36 @@ class TestCalibratedInt8:
             "preferred_element_type=int32" in jaxpr
         qacc = np.mean(np.argmax(inf.predict(x), 1) == y)
         assert facc - qacc <= 0.001, (facc, qacc)
+
+    def test_quant_conv2d_layouts_and_dn_forms(self):
+        """quant.conv2d must scale on the correct output-feature axis for
+        every dimension_numbers form conv_general_dilated accepts."""
+        import jax
+        import jax.numpy as jnp
+        from analytics_zoo_tpu.ops import quant
+
+        rng = np.random.default_rng(11)
+        w = rng.standard_normal((3, 3, 3, 8)).astype(np.float32)
+        qt = quant.quantize_weight(w, "k")
+        x_nchw = rng.standard_normal((2, 3, 12, 12)).astype(np.float32)
+        x_nhwc = np.transpose(x_nchw, (0, 2, 3, 1)).copy()
+        with quant.calibrating() as r:
+            quant.conv2d(x_nchw, qt, (1, 1), "SAME", (1, 1),
+                         ("NCHW", "HWIO", "NCHW"))
+        qt = qt.with_act_scale(quant.calibration_scales(r)["k"])
+
+        ref = jax.lax.conv_general_dilated(
+            x_nchw, w, (1, 1), "SAME", rhs_dilation=(1, 1),
+            dimension_numbers=("NCHW", "HWIO", "NCHW"))
+        for dn, x, transpose_back in (
+                (("NCHW", "HWIO", "NCHW"), x_nchw, None),
+                (("NHWC", "HWIO", "NHWC"), x_nhwc, (0, 3, 1, 2)),
+                (jax.lax.conv_dimension_numbers(
+                    x_nchw.shape, w.shape, ("NCHW", "HWIO", "NCHW")),
+                 x_nchw, None)):
+            out = np.asarray(quant.conv2d(x, qt, (1, 1), "SAME", (1, 1),
+                                          dn))
+            if transpose_back:
+                out = np.transpose(out, transpose_back)
+            err = np.max(np.abs(out - np.asarray(ref)))
+            assert err < 0.05 * float(jnp.max(jnp.abs(ref))), (dn, err)
