@@ -63,9 +63,6 @@ def _sigterm(_sig, _frm):
     os._exit(0)
 
 
-signal.signal(signal.SIGTERM, _sigterm)
-
-
 def _windows_stats(fn, n=3):
     """Run ``fn`` (one timed measurement window -> value) n times; return
     (median, {min, median, max}) so run-to-run tunnel noise is visible
@@ -634,6 +631,10 @@ def bench_automl(n_trials=3):
 
 
 def main():
+    # handler installed HERE, not at import: a helper process that merely
+    # imports bench (e.g. to run one leg) and gets killed must not
+    # clobber BENCH_partial.json with the pristine RESULT stub
+    signal.signal(signal.SIGTERM, _sigterm)
     info, err = probe_backend()
     if info is None:
         # TPU runtime unreachable: record the diagnosis, fall back to CPU so

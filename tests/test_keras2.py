@@ -313,7 +313,6 @@ class TestKeras2ModelDialect:
             m.fit(x, y, validation_split=1.0)
         # keras-2 precedence: explicit validation_data silences the split
         # even for non-array inputs
-        from analytics_zoo_tpu.feature.feature_set import ArrayFeatureSet
         m.fit(ArrayFeatureSet(x, y), batch_size=30, epochs=1,
               validation_data=(x[:10], y[:10]), validation_split=0.2)
         m.fit(x, y, batch_size=30, epochs=1)
