@@ -4,9 +4,10 @@ The reference materializes full O(L^2) attention per replica inside
 ``TransformerLayer.block``/``Attention`` (keras/layers/TransformerLayer.scala,
 utils/zoo Attention) — sequence length bounded by one worker's RAM
 (SURVEY.md §5.7). Here the hot path is a Pallas flash-attention kernel:
-blockwise online-softmax so the L×L score matrix never hits HBM, MXU-sized
-(128×128) tiles, f32 accumulation. ``ring`` sequence parallelism layers on
-top of this in ``parallel/ring_attention.py``.
+blockwise online-softmax so the L×L score matrix never hits HBM, wide
+MXU tiles (up to 512×1024, see ``_resolve_blocks``), bf16 MXU dots with
+f32 accumulation. ``ring`` sequence parallelism layers on top of this in
+``parallel/ring_attention.py``.
 
 The kernel takes an optional *key bias* — an additive (B, Lk) bias broadcast
 over heads and query positions, which is exactly the shape of the BERT/
@@ -74,9 +75,13 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, kb_ref, o_ref, lse_ref, m_scr,
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32)           # (block_q, d)
-        k = k_ref[0].astype(jnp.float32)           # (block_k, d)
-        v = v_ref[0].astype(jnp.float32)
+        # dots take q/k/v in their native dtype (bf16 on the hot path) with
+        # f32 accumulation via preferred_element_type — casting the inputs
+        # to f32 first forces the MXU onto its f32 path, measured 1.4-2x
+        # slower at BERT shapes on v5e (TPU_SESSION.jsonl r5 attn leg)
+        q = q_ref[0]                               # (block_q, d)
+        k = k_ref[0]                               # (block_k, d)
+        v = v_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
@@ -94,8 +99,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, kb_ref, o_ref, lse_ref, m_scr,
         correction = jnp.exp(m_prev - m_cur)
         p = jnp.exp(s - m_cur)
         l_cur = correction * l_prev + p.sum(axis=-1, keepdims=True)
+        # p rounds to the value dtype for the MXU (standard flash scheme;
+        # the accumulator stays f32)
         acc_scr[...] = acc_scr[...] * correction + jax.lax.dot(
-            p, v, preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
         m_scr[...] = m_cur
         l_scr[...] = l_cur
 
@@ -128,16 +135,49 @@ def _bias_specs_3d(num_heads, block_k):
                         lambda b, i, j, h=num_heads: (b // h, 0, j))
 
 
+def _resolve_blocks(lq, lk, block_q, block_k):
+    """Pick MXU-friendly block sizes: the largest of 512/256/128 dividing
+    the sequence length (bigger tiles amortize Mosaic per-iteration
+    overhead and fill the MXU — measured ~1.8x over 128x128 at BERT
+    shapes, TPU_SESSION.jsonl r5). ``ZOO_TPU_ATTN_BLOCK_Q/K`` override for
+    tuning sweeps."""
+    def pick(env, asked, n, cands):
+        # env/explicit choices must still divide the sequence length: the
+        # non-causal kernel has no partial-block bounds mask, so a
+        # non-dividing block would let Pallas-padded garbage k-columns
+        # into the softmax. Non-dividing (or malformed/non-positive)
+        # overrides fall through to auto.
+        try:
+            v = int(os.environ.get(env, "0"))
+        except ValueError:
+            v = 0
+        v = max(v, 0)
+        if v and n % min(v, n) == 0:
+            return min(v, n)
+        if not v and asked is not None and asked > 0 and \
+                n % min(asked, n) == 0:
+            return min(asked, n)
+        for cand in cands:
+            if n % cand == 0:
+                return cand
+        return min(128, n)
+    # measured optimum on v5e (ATTN_TUNE.jsonl): block_q 512, block_k 1024
+    # once L allows it — the (block_q, block_k) f32 score tile plus the
+    # double-buffered q/k/v blocks stay well inside the ~16 MB VMEM
+    return (pick("ZOO_TPU_ATTN_BLOCK_Q", block_q, lq, (512, 256, 128)),
+            pick("ZOO_TPU_ATTN_BLOCK_K", block_k, lk, (1024, 512, 256,
+                                                       128)))
+
+
 def _flash_forward(q, k, v, kbias, num_heads, causal, sm_scale,
-                   block_q=128, block_k=128):
+                   block_q=None, block_k=None):
     """Returns (o, lse) with o: (BH, Lq, d), lse: (BH, Lq, 1) f32."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     bh, lq, d = q.shape
     lk = k.shape[1]
-    block_q = min(block_q, lq)
-    block_k = min(block_k, lk)
+    block_q, block_k = _resolve_blocks(lq, lk, block_q, block_k)
     num_q = pl.cdiv(lq, block_q)
     num_k = pl.cdiv(lk, block_k)
 
@@ -200,10 +240,13 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref,
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32)            # (block_q, d)
-        k = k_ref[0].astype(jnp.float32)            # (block_k, d)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)          # (block_q, d)
+        # native-dtype (bf16) MXU dots with f32 accumulation — see the
+        # forward kernel note; ds rounds to bf16 for the final dot, the
+        # standard flash backward scheme
+        q = q_ref[0]                                # (block_q, d)
+        k = k_ref[0]                                # (block_k, d)
+        v = v_ref[0]
+        do = do_ref[0]                              # (block_q, d)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
@@ -220,7 +263,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref,
             preferred_element_type=jnp.float32)     # (block_q, block_k)
         ds = p * (dp - delta_ref[0])                # delta: (block_q, 1)
         dq_scr[...] += jax.lax.dot(
-            ds, k, preferred_element_type=jnp.float32) * sm_scale
+            ds.astype(k.dtype), k,
+            preferred_element_type=jnp.float32) * sm_scale
 
     if causal:
         pl.when(ki * block_k <= (qi + 1) * block_q - 1)(_compute)
@@ -248,10 +292,10 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref,
         db_scr[...] = jnp.zeros_like(db_scr)
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32)            # (block_q, d)
-        k = k_ref[0].astype(jnp.float32)            # (block_k, d)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)          # (block_q, d)
+        q = q_ref[0]                                # (block_q, d)
+        k = k_ref[0]                                # (block_k, d)
+        v = v_ref[0]
+        do = do_ref[0]                              # (block_q, d)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
@@ -264,14 +308,14 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref,
             s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
         p = jnp.exp(s - lse_ref[0])                 # (block_q, block_k)
         dv_scr[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)     # (block_k, d)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)     # (block_q, block_k)
         ds = p * (dp - delta_ref[0])
         dk_scr[...] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
         db_scr[...] += ds.sum(axis=0, keepdims=True)   # (1, block_k)
 
@@ -288,15 +332,14 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref,
 
 
 def _flash_backward(q, k, v, kbias, o, lse, do, num_heads, causal, sm_scale,
-                    block_q=128, block_k=128):
+                    block_q=None, block_k=None):
     """Blockwise dq/dk/dv/dbias. Returns grads matching (q, k, v, kbias)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     bh, lq, d = q.shape
     lk = k.shape[1]
-    block_q = min(block_q, lq)
-    block_k = min(block_k, lk)
+    block_q, block_k = _resolve_blocks(lq, lk, block_q, block_k)
     num_q = pl.cdiv(lq, block_q)
     num_k = pl.cdiv(lk, block_k)
 
@@ -368,17 +411,22 @@ def _flash_backward(q, k, v, kbias, o, lse, do, num_heads, causal, sm_scale,
     return dq, dk, dv, dkb
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flash_attention_bhld(q, k, v, kbias, num_heads, causal, sm_scale):
-    return _flash_forward(q, k, v, kbias, num_heads, causal, sm_scale)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_attention_bhld(q, k, v, kbias, num_heads, causal, sm_scale,
+                          block_q=None, block_k=None):
+    return _flash_forward(q, k, v, kbias, num_heads, causal, sm_scale,
+                          block_q, block_k)[0]
 
 
-def _flash_fwd_rule(q, k, v, kbias, num_heads, causal, sm_scale):
-    o, lse = _flash_forward(q, k, v, kbias, num_heads, causal, sm_scale)
+def _flash_fwd_rule(q, k, v, kbias, num_heads, causal, sm_scale,
+                    block_q=None, block_k=None):
+    o, lse = _flash_forward(q, k, v, kbias, num_heads, causal, sm_scale,
+                            block_q, block_k)
     return o, (q, k, v, kbias, o, lse)
 
 
-def _flash_bwd_rule(num_heads, causal, sm_scale, res, do):
+def _flash_bwd_rule(num_heads, causal, sm_scale, block_q, block_k, res,
+                    do):
     """Backward via the dedicated Pallas kernels (O(L) memory, two-pass
     recompute). ``ZOO_TPU_FLASH_BWD=xla`` restores the round-3 behavior of
     recomputing through the reference math (materializes O(L^2) probs;
@@ -396,7 +444,7 @@ def _flash_bwd_rule(num_heads, causal, sm_scale, res, do):
 
         return jax.vjp(ref, q, k, v, kbias)[1](do)
     return _flash_backward(q, k, v, kbias, o, lse, do, num_heads, causal,
-                           sm_scale)
+                           sm_scale, block_q, block_k)
 
 
 _flash_attention_bhld.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -405,7 +453,8 @@ _flash_attention_bhld.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 _SHAPE_OK: dict = {}
 
 
-def _kernel_ok_for(b, h, lq, lk, d, causal, dtype) -> bool:
+def _kernel_ok_for(b, h, lq, lk, d, causal, dtype, block_q=None,
+                   block_k=None) -> bool:
     """Per-shape hardware probe: AOT-lower + compile the forward AND
     backward kernels for this exact (B,H,Lq,Lk,d,causal,dtype) signature in
     a try/except, caching the verdict. Interpret mode does not model Mosaic
@@ -423,7 +472,9 @@ def _kernel_ok_for(b, h, lq, lk, d, causal, dtype) -> bool:
         return True
     if os.environ.get("ZOO_TPU_FORCE_PALLAS", "0") == "1":
         return True
-    key = (b, h, lq, lk, d, causal, jnp.dtype(dtype).name)
+    block_q, block_k = _resolve_blocks(lq, lk, block_q, block_k)
+    key = (b, h, lq, lk, d, causal, jnp.dtype(dtype).name, block_q,
+           block_k)
     if key not in _SHAPE_OK:
         try:
             bh = b * h
@@ -432,14 +483,15 @@ def _kernel_ok_for(b, h, lq, lk, d, causal, dtype) -> bool:
             kbs = jax.ShapeDtypeStruct((b, lk), jnp.float32)
             sc = 1.0 / math.sqrt(d)
             jax.jit(functools.partial(
-                _flash_forward, num_heads=h, causal=causal,
-                sm_scale=sc)).lower(qs, ks, ks, kbs).compile()
+                _flash_forward, num_heads=h, causal=causal, sm_scale=sc,
+                block_q=block_q, block_k=block_k)).lower(
+                qs, ks, ks, kbs).compile()
             os_ = jax.ShapeDtypeStruct((bh, lq, d), dtype)
             lses = jax.ShapeDtypeStruct((bh, lq, 1), jnp.float32)
             jax.jit(functools.partial(
-                _flash_backward, num_heads=h, causal=causal,
-                sm_scale=sc)).lower(qs, ks, ks, kbs, os_, lses,
-                                    os_).compile()
+                _flash_backward, num_heads=h, causal=causal, sm_scale=sc,
+                block_q=block_q, block_k=block_k)).lower(
+                qs, ks, ks, kbs, os_, lses, os_).compile()
             _SHAPE_OK[key] = True
         except Exception as e:  # noqa: BLE001 - any compile failure
             import logging
@@ -473,29 +525,34 @@ def _as_key_bias(bias, b, lk) -> Optional[jnp.ndarray]:
 
 
 # Below this query length the fused-XLA path (with rematerialized probs,
-# see flash_attention) beats the Pallas kernel on the MXU — measured on a
-# v5e at BERT-base shapes: 214 ms/step (XLA, 22% MFU) vs 265 ms/step
-# (kernel, 18% MFU) at B=32 L=512. The kernel's win is O(L) memory, which
-# only starts to matter when the transient L^2 block no longer fits.
-KERNEL_MIN_SEQ = 2048
+# see flash_attention) beats the Pallas kernel. Retuned r5 on a v5e after
+# the bf16-MXU-dot + 512-wide-block kernel fixes (ATTN_TUNE.jsonl,
+# fwd+bwd wall ms at constant tokens, bias present):
+#   L=512  B=32: kernel 10.7 vs XLA 12.3     L=2048 B=8: 15.0 vs 27.6
+#   L=1024 B=16: kernel 11.7 vs XLA 18.2     L=4096 B=4: 20.9 vs 46.8
+# (r3's threshold of 2048 was measured against the old f32-dot 128-block
+# kernel with O(L^2) recompute backward, which lost everywhere below it.)
+# Below 512 the shapes are dispatch-bound and unmeasured — XLA keeps them.
+KERNEL_MIN_SEQ = 512
 
 
 def flash_attention(q, k, v, bias=None, causal=False, sm_scale=None,
-                    block_q=128, block_k=128):
+                    block_q=None, block_k=None):
     """q,k,v: (B, H, L, D) -> (B, H, L, D).
 
-    Long sequences route to the Pallas kernel on TPU (or interpreter mode
-    when ``ZOO_TPU_PALLAS_INTERPRET=1``) whenever the bias is absent or a
-    key-padding bias; short sequences and full (B,H,Lq,Lk) biases use the
+    Sequences of L >= KERNEL_MIN_SEQ (512, retuned r5 — ATTN_TUNE.jsonl)
+    route to the Pallas kernel on TPU (or interpreter mode when
+    ``ZOO_TPU_PALLAS_INTERPRET=1``) whenever the bias is absent or a
+    key-padding bias — BERT-base B=32 L=512 now takes the kernel, which
+    also removes its saved-probs HBM cost entirely (O(L) memory both
+    directions). Shorter sequences and full (B,H,Lq,Lk) biases use the
     fused-XLA reference path. That path runs under ``jax.checkpoint`` only
     once the *per-call* saved probs exceed 512 MB (or always, with
-    ``ZOO_TPU_ATTN_REMAT=1``): probs are saved once per transformer layer,
-    so e.g. BERT-base B=32 L=512 stays on the fast no-remat path while
-    accumulating ~4.6 GB of probs across its 12 layers — the threshold
-    trades that HBM for the ~15% step-time cost of remat only when a single
-    call's probs threaten memory (the saved-probs variant OOMs BERT-base at
-    batch 64 on a 16G chip). Deeper stacks or smaller chips may need
-    ``ZOO_TPU_ATTN_REMAT=1`` explicitly.
+    ``ZOO_TPU_ATTN_REMAT=1``): the threshold trades HBM for the ~15%
+    step-time cost of remat only when a single call's probs threaten
+    memory (the saved-probs variant OOMs BERT-base at batch 64 on a 16G
+    chip when forced through XLA). Deeper stacks or smaller chips on the
+    XLA path may need ``ZOO_TPU_ATTN_REMAT=1`` explicitly.
     ``ZOO_TPU_FORCE_PALLAS=1`` routes every eligible shape to the kernel;
     ``ZOO_TPU_DISABLE_PALLAS=1`` disables it entirely.
     """
@@ -511,16 +568,17 @@ def flash_attention(q, k, v, bias=None, causal=False, sm_scale=None,
     # cheap eligibility gates first — the per-shape probe compiles the
     # kernel for this exact signature, so it must run only for shapes the
     # router would actually send to the kernel (i.e. after the
-    # KERNEL_MIN_SEQ check, or a sub-2048 BERT warmup would pay a Mosaic
+    # KERNEL_MIN_SEQ check, or a short-sequence warmup would pay a Mosaic
     # compile per shape just to be routed to XLA anyway)
     eligible = (on_tpu and kb is not None and lq >= 128 and lk >= 128 and
-                lq % block_q == 0 and lk % block_k == 0 and
+                lq % 128 == 0 and lk % 128 == 0 and
                 d % 64 == 0 and (not causal or lq == lk))
     if os.environ.get("ZOO_TPU_FORCE_PALLAS", "0") != "1" and \
             lq < KERNEL_MIN_SEQ:
         eligible = False
+    block_q, block_k = _resolve_blocks(lq, lk, block_q, block_k)
     use_kernel = eligible and _kernel_ok_for(b, h, lq, lk, d, causal,
-                                             q.dtype)
+                                             q.dtype, block_q, block_k)
     if not use_kernel:
         ref = functools.partial(attention_reference, causal=causal,
                                 sm_scale=sm_scale)
@@ -544,5 +602,6 @@ def flash_attention(q, k, v, bias=None, causal=False, sm_scale=None,
     qf = q.reshape(b * h, lq, d)
     kf = k.reshape(b * h, lk, d)
     vf = v.reshape(b * h, lk, d)
-    o = _flash_attention_bhld(qf, kf, vf, kb, h, causal, sm_scale)
+    o = _flash_attention_bhld(qf, kf, vf, kb, h, causal, sm_scale,
+                              block_q, block_k)
     return o.reshape(b, h, lq, d)

@@ -135,6 +135,14 @@ class SPMDTrainer:
         self.tx = optimizer.to_optax()
         self.lr_schedule = optimizer.lr_schedule()
         self.metrics = metrics or []
+        # precedence: explicit per-model dtype (Model.set_compute_dtype)
+        # over the context config. compute_dtype=None means "unset" — fall
+        # back to ZooConfig.compute_dtype; an explicit "float32" stays f32.
+        # (r5 fix: this fallback was missing, so ZooConfig(compute_dtype=
+        # "bfloat16") silently trained every model in f32 — half MXU rate
+        # and double HBM traffic on v5e, confirmed in the BERT step HLO.)
+        if compute_dtype is None:
+            compute_dtype = getattr(self.ctx.config, "compute_dtype", None)
         self.compute_dtype = (jnp.bfloat16 if str(compute_dtype) in
                               ("bfloat16", "bf16") else None)
         self.clipping = clipping or GradientClipping()

@@ -558,3 +558,26 @@ class TestUlysses:
             assert calls == {"ring": 2, "ulysses": 1}, calls
         finally:
             set_nncontext(None)
+
+
+def test_attn_block_resolution(monkeypatch):
+    """Wide-block defaults (512 q / 1024 k, ATTN_TUNE.jsonl) with the
+    divisibility fallback and env overrides."""
+    from analytics_zoo_tpu.ops.attention import _resolve_blocks
+    assert _resolve_blocks(512, 512, None, None) == (512, 512)
+    assert _resolve_blocks(2048, 2048, None, None) == (512, 1024)
+    assert _resolve_blocks(384, 384, None, None) == (128, 128)
+    assert _resolve_blocks(640, 640, None, None) == (128, 128)
+    # explicit args win over auto, env wins over both
+    assert _resolve_blocks(2048, 2048, 256, 256) == (256, 256)
+    monkeypatch.setenv("ZOO_TPU_ATTN_BLOCK_Q", "128")
+    monkeypatch.setenv("ZOO_TPU_ATTN_BLOCK_K", "256")
+    assert _resolve_blocks(2048, 2048, 512, 512) == (128, 256)
+    # overrides that do not divide L fall back to auto — a non-dividing
+    # block would admit Pallas-padded garbage k-columns (no bounds mask)
+    monkeypatch.setenv("ZOO_TPU_ATTN_BLOCK_Q", "512")
+    monkeypatch.setenv("ZOO_TPU_ATTN_BLOCK_K", "512")
+    assert _resolve_blocks(640, 640, None, None) == (128, 128)
+    monkeypatch.delenv("ZOO_TPU_ATTN_BLOCK_Q")
+    monkeypatch.delenv("ZOO_TPU_ATTN_BLOCK_K")
+    assert _resolve_blocks(640, 640, 512, 512) == (128, 128)
