@@ -1,0 +1,32 @@
+"""Varying-manual-axes (shard_map) helper for custom-VJP ops.
+
+Inside a ``shard_map`` region, jax's autodiff transposes the implicit
+broadcast of a replicated parameter into a ``psum`` over the mesh axes
+the cotangent varies over. A ``custom_vjp`` bwd rule is opaque to that
+machinery, so parameter gradients it computes from device-varying
+cotangents keep the extra varying axes — mathematically missing the
+cross-shard reduction and tripping the scan/shard_map vma checker (seen
+as "Scan carry input and output got mismatched varying manual axes" in
+the GPipe path). Custom bwd rules call :func:`psum_grad_like` to insert
+exactly the psum autodiff would have.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def _vma(x):
+    try:
+        return frozenset(getattr(jax.typeof(x), "vma", ()) or ())
+    except Exception:  # noqa: BLE001 — outside a trace / old jax
+        return frozenset()
+
+
+def psum_grad_like(grad, param, cotangent):
+    """Reduce ``grad`` over mesh axes ``cotangent`` varies over but
+    ``param`` does not (no-op outside shard_map)."""
+    extra = tuple(sorted(_vma(cotangent) - _vma(param)))
+    if not extra:
+        return grad
+    return jax.lax.psum(grad, extra)

@@ -152,10 +152,10 @@ def _resolve_blocks(lq, lk, block_q, block_k):
         except ValueError:
             v = 0
         v = max(v, 0)
+        # fallback order: env -> explicit arg -> auto
         if v and n % min(v, n) == 0:
             return min(v, n)
-        if not v and asked is not None and asked > 0 and \
-                n % min(asked, n) == 0:
+        if asked is not None and asked > 0 and n % min(asked, n) == 0:
             return min(asked, n)
         for cand in cands:
             if n % cand == 0:
@@ -527,7 +527,8 @@ def _as_key_bias(bias, b, lk) -> Optional[jnp.ndarray]:
 # Below this query length the fused-XLA path (with rematerialized probs,
 # see flash_attention) beats the Pallas kernel. Retuned r5 on a v5e after
 # the bf16-MXU-dot + 512-wide-block kernel fixes (ATTN_TUNE.jsonl,
-# fwd+bwd wall ms at constant tokens, bias present):
+# fwd+bwd wall ms at constant tokens, bias present; the XLA legs at
+# L>=2048 run the auto-remat path, as a real model would):
 #   L=512  B=32: kernel 10.7 vs XLA 12.3     L=2048 B=8: 15.0 vs 27.6
 #   L=1024 B=16: kernel 11.7 vs XLA 18.2     L=4096 B=4: 20.9 vs 46.8
 # (r3's threshold of 2048 was measured against the old f32-dot 128-block

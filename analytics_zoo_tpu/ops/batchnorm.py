@@ -1,0 +1,139 @@
+"""Fused batch-norm training op (single-pass statistics + hand-written
+VJP).
+
+Replaces the jnp.mean + jnp.var + autodiff formulation inside
+``BatchNormalization`` (parity target: BatchNormalization.scala — the
+reference delegates to BigDL's fused MKL-DNN batch norm; this is the
+XLA:TPU equivalent). The naive version cost ~58 of ResNet-50's 95 ms
+device step in BN statistics reductions on a v5e (r5 profiler trace,
+``multiply_reduce_fusion`` x312): ``jnp.var`` re-reads the activation
+after ``jnp.mean``, the normalize pass reads it again, and autodiff
+through the two-pass moments adds further full-tensor reductions in
+backward — ~7 HBM passes over the activation per layer per step.
+
+This op does the textbook minimum:
+
+- forward: ONE multi-output reduce fusion produces sum(x) and sum(x*x)
+  in f32 (XLA fuses the bf16->f32 convert into the reduce loop), then
+  one elementwise pass normalizes — 2 reads + 1 write.
+- backward: ONE fused reduce over (dy, x) produces sum(dy) and
+  sum(dy * xhat), then one elementwise pass emits dx — 2 reads + 1
+  write.
+
+Statistics use the single-pass E[x^2] - E[x]^2 form (same choice as the
+fused cudnn/MKL-DNN kernels); accumulation is f32 regardless of input
+dtype, and var is clamped at 0 against cancellation.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _moments(x, reduce_axes, n):
+    xf = x.astype(jnp.float32)
+    s1 = jnp.sum(xf, axis=reduce_axes)
+    s2 = jnp.sum(xf * xf, axis=reduce_axes)
+    mean = s1 / n
+    var = jnp.maximum(s2 / n - mean * mean, 0.0)
+    return mean, var
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def batch_norm_train(x, gamma, beta, axis, eps):
+    """Training-mode batch norm over all axes but ``axis``.
+
+    Returns ``(y, mean, var)`` with y in x.dtype and f32 batch moments
+    (the caller folds mean/var into its moving statistics).
+    """
+    y, mean, var, _ = _bn_fwd_impl(x, gamma, beta, axis, eps)
+    return y, mean, var
+
+
+def _bn_fwd_impl(x, gamma, beta, axis, eps):
+    reduce_axes = tuple(i for i in range(x.ndim) if i != axis)
+    n = 1
+    for i in reduce_axes:
+        n *= x.shape[i]
+    mean, var = _moments(x, reduce_axes, n)
+    inv = jax.lax.rsqrt(var + eps)
+    bshape = [1] * x.ndim
+    bshape[axis] = x.shape[axis]
+    xf = x.astype(jnp.float32)
+    xhat = (xf - mean.reshape(bshape)) * inv.reshape(bshape)
+    y = (xhat * gamma.astype(jnp.float32).reshape(bshape) +
+         beta.astype(jnp.float32).reshape(bshape)).astype(x.dtype)
+    return y, mean, var, inv
+
+
+def _bn_fwd_rule(x, gamma, beta, axis, eps):
+    # symbolic_zeros=True wraps primals in CustomVJPPrimal
+    x, gamma, beta = x.value, gamma.value, beta.value
+    y, mean, var, inv = _bn_fwd_impl(x, gamma, beta, axis, eps)
+    return (y, mean, var), (x, gamma, mean, inv)
+
+
+def _bn_bwd_rule(axis, eps, res, cts):
+    x, gamma, mean, inv = res
+    dy, dmean, dvar = cts
+    SZ = jax.custom_derivatives.SymbolicZero
+    if isinstance(dy, SZ):
+        dy = jnp.zeros(dy.aval.shape, dy.aval.dtype)
+    reduce_axes = tuple(i for i in range(x.ndim) if i != axis)
+    n = 1
+    for i in reduce_axes:
+        n *= x.shape[i]
+    bshape = [1] * x.ndim
+    bshape[axis] = x.shape[axis]
+
+    xf = x.astype(jnp.float32)
+    dyf = dy.astype(jnp.float32)
+    xhat = (xf - mean.reshape(bshape)) * inv.reshape(bshape)
+
+    # one fused multi-output reduction over (dy, x). dx uses the SHARD-
+    # LOCAL sums (batch statistics are shard-local under data-parallel
+    # shard_map, so their transpose is too); the returned param grads
+    # additionally reduce over the cotangent's extra mesh axes — the psum
+    # jax autodiff would have inserted for the replicated-param broadcast
+    from ._vma import psum_grad_like
+    dbeta_local = jnp.sum(dyf, axis=reduce_axes)
+    dgamma_local = jnp.sum(dyf * xhat, axis=reduce_axes)
+    dbeta = psum_grad_like(dbeta_local, gamma, dy)
+    dgamma = psum_grad_like(dgamma_local, gamma, dy)
+
+    g32 = gamma.astype(jnp.float32)
+    # dL/dx through y: the standard fused form
+    dx = (g32 * inv).reshape(bshape) * (
+        dyf - (dbeta_local / n).reshape(bshape) -
+        xhat * (dgamma_local / n).reshape(bshape))
+    # cotangents of the mean/var outputs: zero on the training path
+    # (moving statistics are not differentiated), arriving as
+    # SymbolicZero thanks to symbolic_zeros=True — the guards skip two
+    # whole-activation HBM passes there, while staying exact for anyone
+    # who does differentiate the moments:
+    # d mean/dx = 1/n ; d var/dx = 2(x - mean)/n
+    if not isinstance(dmean, SZ):
+        dx = dx + (dmean / n).reshape(bshape)
+    if not isinstance(dvar, SZ):
+        dx = dx + (dvar * 2.0 / n).reshape(bshape) * \
+            (xf - mean.reshape(bshape))
+    return (dx.astype(x.dtype), dgamma.astype(gamma.dtype),
+            dbeta.astype(gamma.dtype))
+
+
+batch_norm_train.defvjp(_bn_fwd_rule, _bn_bwd_rule, symbolic_zeros=True)
+
+
+def batch_norm_inference(x, gamma, beta, mean, var, axis, eps):
+    """Inference-mode normalize with given (moving) statistics — one
+    elementwise pass; scale/shift fold into per-channel constants."""
+    bshape = [1] * x.ndim
+    bshape[axis] = x.shape[axis]
+    inv = jax.lax.rsqrt(var.astype(jnp.float32) + eps)
+    scale = gamma.astype(jnp.float32) * inv
+    shift = beta.astype(jnp.float32) - mean.astype(jnp.float32) * scale
+    return (x.astype(jnp.float32) * scale.reshape(bshape) +
+            shift.reshape(bshape)).astype(x.dtype)

@@ -42,27 +42,28 @@ class BatchNormalization(KerasLayer):
                 "moving_var": jnp.ones((d,))}
 
     def call(self, params, x, training=False, state=None, **kw):
+        # fused single-pass op (ops/batchnorm.py): the naive mean+var+
+        # autodiff form cost ~7 HBM passes over the activation per layer
+        # per step — 58 of ResNet-50's 95 ms device step on v5e (r5)
+        from .....ops.batchnorm import (batch_norm_inference,
+                                        batch_norm_train)
         axis, d = self._dim((None,) + x.shape[1:])
-        reduce_axes = tuple(i for i in range(x.ndim) if i != axis)
-        bshape = [1] * x.ndim
-        bshape[axis] = d
         state = state or self.init_state((None,) + x.shape[1:])
         if training:
-            mean = jnp.mean(x, axis=reduce_axes)
-            var = jnp.var(x, axis=reduce_axes)
+            y, mean, var = batch_norm_train(
+                x, params["gamma"], params["beta"], axis, self.epsilon)
             m = self.momentum
             new_state = {
-                "moving_mean": m * state["moving_mean"] + (1 - m) * mean,
-                "moving_var": m * state["moving_var"] + (1 - m) * var,
+                "moving_mean": m * state["moving_mean"] +
+                (1 - m) * mean.astype(state["moving_mean"].dtype),
+                "moving_var": m * state["moving_var"] +
+                (1 - m) * var.astype(state["moving_var"].dtype),
             }
-        else:
-            mean, var = state["moving_mean"], state["moving_var"]
-            new_state = state
-        inv = jax.lax.rsqrt(var + self.epsilon)
-        y = (x - mean.reshape(bshape)) * inv.reshape(bshape)
-        y = y * params["gamma"].reshape(bshape) + \
-            params["beta"].reshape(bshape)
-        return y.astype(x.dtype), new_state
+            return y, new_state
+        y = batch_norm_inference(x, params["gamma"], params["beta"],
+                                 state["moving_mean"],
+                                 state["moving_var"], axis, self.epsilon)
+        return y, state
 
 
 class LayerNorm(KerasLayer):
@@ -80,13 +81,10 @@ class LayerNorm(KerasLayer):
         return {"gamma": jnp.ones((d,)), "beta": jnp.zeros((d,))}
 
     def call(self, params, x, training=False, **kw):
-        # compute moments in f32 for bf16 inputs (TPU numerics guardrail)
-        xf = x.astype(jnp.float32)
-        mean = jnp.mean(xf, axis=-1, keepdims=True)
-        var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
-        y = (xf - mean) * jax.lax.rsqrt(var + self.epsilon)
-        y = y * params["gamma"] + params["beta"]
-        return y.astype(x.dtype)
+        # fused single-pass op with f32 statistics (ops/layernorm.py)
+        from .....ops.layernorm import layer_norm
+        return layer_norm(x, params["gamma"], params["beta"],
+                          self.epsilon)
 
 
 class LRN2D(KerasLayer):

@@ -195,10 +195,8 @@ class TransformerLayer(KerasLayer):
 
     # -- compute -------------------------------------------------------
     def _ln(self, x, g, b, eps=1e-5):
-        xf = x.astype(jnp.float32)
-        mu = xf.mean(-1, keepdims=True)
-        var = jnp.square(xf - mu).mean(-1, keepdims=True)
-        return ((xf - mu) * jax.lax.rsqrt(var + eps) * g + b).astype(x.dtype)
+        from .....ops.layernorm import layer_norm
+        return layer_norm(x, g, b, eps)
 
     def _gelu(self, x):
         return jax.nn.gelu(x, approximate=self.gelu_approximate)

@@ -1,0 +1,55 @@
+"""Fused layer-norm op parity (values + grads) with the naive two-pass
+formulation it replaced in LayerNorm/BERT._ln (ops/layernorm.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.ops.layernorm import layer_norm
+
+
+def _naive(x, g, b, eps):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps) * \
+        g.astype(jnp.float32) + b.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+@pytest.mark.parametrize("dtype,tol", [(jnp.float32, 2e-5),
+                                       (jnp.bfloat16, 3e-2)])
+@pytest.mark.parametrize("shape", [(6, 16), (4, 7, 32)])
+def test_fused_ln_matches_naive(dtype, tol, shape):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal(shape) * 2 + 1.0, dtype)
+    g = jnp.asarray(rng.standard_normal(shape[-1]) * 0.5 + 1, jnp.float32)
+    b = jnp.asarray(rng.standard_normal(shape[-1]), jnp.float32)
+
+    y1 = layer_norm(x, g, b, 1e-5)
+    y2 = _naive(x, g, b, 1e-5)
+    assert float(jnp.abs(y1.astype(jnp.float32) -
+                         y2.astype(jnp.float32)).max()) < tol
+
+    def loss(fn):
+        return lambda x, g, b: (fn(x, g, b, 1e-5)
+                                .astype(jnp.float32) ** 2).mean()
+
+    g1 = jax.grad(loss(layer_norm), argnums=(0, 1, 2))(x, g, b)
+    g2 = jax.grad(loss(_naive), argnums=(0, 1, 2))(x, g, b)
+    for a, c, name in zip(g1, g2, ("dx", "dgamma", "dbeta")):
+        err = float(jnp.abs(a.astype(jnp.float32) -
+                            c.astype(jnp.float32)).max())
+        assert err < tol, (name, err)
+
+
+def test_ln_layer_uses_fused_op():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import LayerNorm
+    rng = np.random.default_rng(1)
+    layer = LayerNorm(hidden_size=12, input_shape=(5, 12))
+    params = layer.build(jax.random.PRNGKey(0), (None, 5, 12))
+    x = jnp.asarray(rng.standard_normal((3, 5, 12)), jnp.float32)
+    y = layer.call(params, x)
+    ref = _naive(x, params["gamma"], params["beta"], layer.epsilon)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=2e-5)
