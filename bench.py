@@ -136,10 +136,16 @@ def make_data(seed=0):
 
 
 def bench_ncf(x, y):
+    from analytics_zoo_tpu.common.nncontext import (ZooConfig, ZooContext,
+                                                    set_nncontext)
     from analytics_zoo_tpu.models.recommendation import NeuralCF
     from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
     from analytics_zoo_tpu.utils.profiling import device_sync
 
+    # bf16 compute (the TPU design point; r5: this config now actually
+    # reaches the trainer — earlier rounds' NCF numbers were f32)
+    set_nncontext(None)
+    set_nncontext(ZooContext(ZooConfig(compute_dtype="bfloat16")))
     ncf = NeuralCF(N_USERS, N_ITEMS, N_CLASSES, user_embed=USER_EMBED,
                    item_embed=ITEM_EMBED, hidden_layers=HIDDEN,
                    include_mf=True, mf_embed=MF_EMBED)
@@ -230,7 +236,10 @@ def _bert_flops_per_step(batch, seq, hidden, blocks, n_classes):
     return 3 * fwd
 
 
-def bench_bert_mfu(peak_flops, batch_candidates=(BERT_BATCH, 16)):
+def bench_bert_mfu(peak_flops, batch_candidates=(64, BERT_BATCH, 16)):
+    # 64 first: the flash kernel's O(L) attention memory makes BERT-base
+    # B=64 fit on a 16G chip (the saved-probs XLA path OOM'd it, r3), and
+    # larger GEMMs run closer to MXU peak; OOM falls through to 32/16.
     from analytics_zoo_tpu.utils.profiling import device_sync
 
     last_err = None
