@@ -5,14 +5,16 @@ Legs, each independently emitted to ``TPU_SESSION.jsonl`` as it finishes
 
 1. ``bench``      — the driver benchmark (``python bench.py``), first so a
                     later tunnel death cannot cost the round its numbers.
-2. ``attn``       — flash-kernel vs XLA attention A/B (fwd+bwd train-step
-                    proxy) across sequence lengths, to re-tune
-                    ``KERNEL_MIN_SEQ`` now that the backward runs in the
-                    Pallas kernels too (r3 routing was measured with the
-                    O(L^2) recompute backward).
-3. ``resnet_layout`` — NCHW vs NHWC conv-tower proxy (XLA TPU layout
+2. ``attn_parity`` — on-chip numerics of the r5 wide-block bf16-dot
+                    kernel vs the XLA path at 3 shapes (~6 jit compiles;
+                    Mosaic differs from interpret mode, r2/r3 history).
+3. ``attn``       — flash-kernel vs XLA attention A/B (fwd+bwd train-step
+                    proxy) across sequence lengths (its r5 run retuned
+                    ``KERNEL_MIN_SEQ`` to 512; kept to re-validate on
+                    every future window).
+4. ``resnet_layout`` — NCHW vs NHWC conv-tower proxy (XLA TPU layout
                     assignment cost of the reference's "th" ordering).
-4. ``resnet_profile`` — ResNet-50 step decomposition: full step vs fwd
+5. ``resnet_profile`` — ResNet-50 step decomposition: full step vs fwd
                     vs BN-less fwd, infeed wait; optional profiler trace.
 
 Usage: python tools/tpu_perf_session.py [leg ...]   (default: all)
@@ -73,6 +75,64 @@ def _time_fn(fn, *args, iters=8, warmup=2):
         out = fn(*args)
     _sync(out)
     return (time.perf_counter() - t0) / iters
+
+
+def leg_attn_parity():
+    """On-chip numerics of the (r5) wide-block bf16-dot kernel vs the XLA
+    reference at BERT shapes — Mosaic behavior differs from interpret
+    mode (r2/r3 history), so the first live window must prove
+    correctness, not just speed."""
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.ops import attention as A
+
+    rng = np.random.default_rng(0)
+    for b, l, causal in [(32, 512, False), (4, 2048, False),
+                         (2, 2048, True)]:
+        h, d = 12, 64
+        q = jnp.asarray(rng.standard_normal((b, h, l, d)), jnp.bfloat16)
+        bias = jnp.asarray(
+            (rng.random((b, 1, 1, l)) > 0.9) * -10000.0, jnp.float32)
+        row = {"B": b, "L": l, "causal": causal}
+        results = {}
+        for mode in ("kernel", "xla"):
+            # fresh closures per routing mode: jax.jit caches on function
+            # identity, so jitting a shared callable would hand the
+            # second mode the first mode's compiled executable
+            os.environ["ZOO_TPU_FORCE_PALLAS"] = \
+                "1" if mode == "kernel" else "0"
+            os.environ["ZOO_TPU_DISABLE_PALLAS"] = \
+                "1" if mode == "xla" else "0"
+            try:
+                def loss(q, bias=bias, causal=causal):
+                    return (A.flash_attention(q, q, q, bias=bias,
+                                              causal=causal)
+                            .astype(jnp.float32) ** 2).sum()
+                out = jax.jit(lambda q, bias=bias, causal=causal:
+                              A.flash_attention(q, q, q, bias=bias,
+                                                causal=causal))(q)
+                grad = jax.jit(jax.grad(loss))(q)
+                results[mode] = (out, grad)
+            except Exception as e:  # noqa: BLE001
+                row[f"{mode}_err"] = str(e).splitlines()[0][:200]
+            finally:
+                os.environ.pop("ZOO_TPU_FORCE_PALLAS", None)
+                os.environ.pop("ZOO_TPU_DISABLE_PALLAS", None)
+        if len(results) == 2:
+            ok, gk = results["kernel"]
+            ox, gx = results["xla"]
+            gxf = gx.astype(jnp.float32)
+            row["out_max_err"] = float(jnp.abs(
+                ok.astype(jnp.float32) - ox.astype(jnp.float32)).max())
+            # relative grad error: sum-loss grads scale with o, so an
+            # absolute tolerance would be vacuous (or shape-dependent)
+            row["grad_rel_err"] = float(
+                jnp.abs(gk.astype(jnp.float32) - gxf).max() /
+                jnp.maximum(jnp.abs(gxf).max(), 1e-20))
+            row["ok"] = (row["out_max_err"] < 4e-2 and
+                         row["grad_rel_err"] < 4e-2)
+        emit("attn_parity", row)
 
 
 def leg_attn():
@@ -241,7 +301,8 @@ def leg_resnet_profile():
                                 "err": str(e).splitlines()[0][:300]})
 
 
-LEGS = {"bench": leg_bench, "attn": leg_attn,
+LEGS = {"bench": leg_bench, "attn_parity": leg_attn_parity,
+        "attn": leg_attn,
         "resnet_layout": leg_resnet_layout,
         "resnet_profile": leg_resnet_profile}
 
