@@ -51,6 +51,13 @@ class ZooConfig:
     param_sharding: str = "auto"
     # compute dtype for matmul-heavy paths
     compute_dtype: str = "float32"
+    # PRNG implementation for the training rng (dropout etc.):
+    # "auto" = hardware rng_bit_generator ("rbg") on TPU, threefry on
+    # CPU/GPU. jax's default threefry is counter-based VPU arithmetic —
+    # the r5 BERT-base step HLO carried 13k threefry instructions for
+    # its 37 dropout sites; rbg uses the TPU's native generator. Set
+    # "threefry2x32" for cross-backend reproducible streams.
+    rng_impl: str = "auto"
     # failure retry (reference: bigdl.failure.retryTimes, Topology.scala:1172)
     failure_retry_times: int = 5
     checkpoint_dir: Optional[str] = None

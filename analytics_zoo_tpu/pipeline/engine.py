@@ -328,9 +328,24 @@ class SPMDTrainer:
             self.loss_fn(preds_f, None, w)
         return loss, (preds_f, new_state)
 
+    def _train_root_key(self):
+        """Per-step rng root. Weight init stays on threefry (bit-stable
+        across backends, test-visible); the training stream (dropout) is
+        hot-path and switches to the TPU hardware generator under
+        ``ZooConfig.rng_impl="auto"`` — see the config field note."""
+        impl = str(getattr(self.ctx.config, "rng_impl", "auto"))
+        if impl not in ("auto", "rbg", "unsafe_rbg", "threefry2x32"):
+            raise ValueError(
+                f"rng_impl must be auto|rbg|unsafe_rbg|threefry2x32, "
+                f"got {impl!r}")
+        if impl == "auto":
+            impl = "rbg" if jax.default_backend() == "tpu" \
+                else "threefry2x32"
+        return jax.random.key(self.seed, impl=impl)
+
     def _step_body(self, params, opt_state, net_state, batch, step):
         """One optimization step (traced): fwd, bwd, clip, update."""
-        rng = jax.random.fold_in(jax.random.PRNGKey(self.seed), step)
+        rng = jax.random.fold_in(self._train_root_key(), step)
         (loss, (_, new_state)), grads = jax.value_and_grad(
             lambda p: self._loss_and_preds(p, net_state, batch, rng,
                                            True), has_aux=True)(params)

@@ -532,3 +532,53 @@ class TestComputeDtypePlumbing:
             trainer.params)
         assert seen["param_dtype"] == jnp.bfloat16
         assert seen["x_dtype"] == jnp.bfloat16
+
+
+class TestRngImpl:
+    """ZooConfig.rng_impl: training rng uses the hardware generator on
+    TPU ("auto") without changing CPU test streams; forcing "rbg" on CPU
+    must still train (dropout path)."""
+
+    def teardown_method(self, method):
+        from analytics_zoo_tpu.common.nncontext import set_nncontext
+        set_nncontext(None)
+
+    def _fit_once(self, config):
+        from analytics_zoo_tpu.common.nncontext import (
+            ZooConfig, ZooContext, set_nncontext)
+        set_nncontext(None)
+        set_nncontext(ZooContext(config))
+        x, y = _xor_data(128)
+        model = Sequential()
+        model.add(Dense(8, activation="relu", input_shape=(8,)))
+        model.add(Dropout(0.3))
+        model.add(Dense(1, activation="sigmoid"))
+        model.compile(optimizer="sgd", loss="mse")
+        model.fit(x, y, batch_size=64, nb_epoch=1)
+        return model
+
+    def test_auto_is_threefry_on_cpu(self):
+        import jax
+        from analytics_zoo_tpu.common.nncontext import ZooConfig
+        m = self._fit_once(ZooConfig())
+        key = m._ensure_trainer()._train_root_key()
+        assert "threefry" in str(jax.random.key_impl(key))
+
+    def test_forced_rbg_trains(self):
+        import jax
+        import numpy as np
+        from analytics_zoo_tpu.common.nncontext import ZooConfig
+        m = self._fit_once(ZooConfig(rng_impl="rbg"))
+        key = m._ensure_trainer()._train_root_key()
+        assert "rbg" in str(jax.random.key_impl(key))
+        preds = np.asarray(m.predict(np.zeros((4, 8), np.float32)))
+        assert np.all(np.isfinite(preds))
+
+    def test_bad_rng_impl_rejected(self):
+        import pytest
+        from analytics_zoo_tpu.common.nncontext import ZooConfig
+        m = self._fit_once(ZooConfig())
+        tr = m._ensure_trainer()
+        tr.ctx.config.rng_impl = "threefry"   # common typo
+        with pytest.raises(ValueError, match="rng_impl"):
+            tr._train_root_key()
