@@ -340,7 +340,10 @@ def _bench_bert_mfu_at(peak_flops, bert_batch, seq_len=BERT_SEQ):
 RESNET_FWD_FLOPS_PER_IMAGE = 2 * 4.09e9   # 4.09 GMACs @ 224x224 (public)
 
 
-def bench_resnet_mfu(peak_flops, batch_candidates=(128, 64, 32)):
+def bench_resnet_mfu(peak_flops, batch_candidates=(256, 128, 64, 32)):
+    # 256 first (r5): with BN's activation re-reads gone the step is
+    # conv-dominated, and bigger batches run the convs closer to MXU
+    # peak; OOM falls through to the smaller sizes.
     from analytics_zoo_tpu.utils.profiling import device_sync  # noqa: F401
 
     last_err = None
