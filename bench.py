@@ -35,6 +35,15 @@ import numpy as np
 T_START = time.time()
 TOTAL_BUDGET_S = float(os.environ.get("ZOO_BENCH_BUDGET_S", "2100"))
 
+
+def _bench_dtype():
+    """bf16 on the MXU, f32 elsewhere: XLA:CPU emulates bf16 (measured
+    r5: the NCF CPU fallback dropped 111.8 -> 50.7 steps/s once the
+    compute_dtype plumbing actually started working), so the CPU
+    fallback must keep the f32 numbers comparable with earlier rounds."""
+    import jax
+    return "bfloat16" if jax.default_backend() == "tpu" else "float32"
+
 # Results accumulate here and are flushed to BENCH_partial.json after every
 # completed leg (plus printed on SIGTERM), so a mid-run tunnel death or
 # driver timeout still leaves the legs that DID finish on disk — round 3
@@ -145,7 +154,8 @@ def bench_ncf(x, y):
     # bf16 compute (the TPU design point; r5: this config now actually
     # reaches the trainer — earlier rounds' NCF numbers were f32)
     set_nncontext(None)
-    set_nncontext(ZooContext(ZooConfig(compute_dtype="bfloat16")))
+    set_nncontext(ZooContext(ZooConfig(
+        compute_dtype=_bench_dtype())))
     ncf = NeuralCF(N_USERS, N_ITEMS, N_CLASSES, user_embed=USER_EMBED,
                    item_embed=ITEM_EMBED, hidden_layers=HIDDEN,
                    include_mf=True, mf_embed=MF_EMBED)
@@ -293,7 +303,8 @@ def _bench_bert_mfu_at(peak_flops, bert_batch, seq_len=BERT_SEQ):
     from analytics_zoo_tpu.utils.profiling import device_sync
 
     set_nncontext(None)
-    set_nncontext(ZooContext(ZooConfig(compute_dtype="bfloat16")))
+    set_nncontext(ZooContext(ZooConfig(
+        compute_dtype=_bench_dtype())))
 
     bert = BERT(vocab=BERT_VOCAB, hidden_size=BERT_H, n_block=BERT_BLOCKS,
                 n_head=BERT_HEADS, seq_len=seq_len,
@@ -395,7 +406,8 @@ def _bench_resnet_mfu_at(peak_flops, batch):
     from analytics_zoo_tpu.utils.profiling import device_sync
 
     set_nncontext(None)
-    set_nncontext(ZooContext(ZooConfig(compute_dtype="bfloat16")))
+    set_nncontext(ZooContext(ZooConfig(
+        compute_dtype=_bench_dtype())))
 
     clf = ImageClassifier(class_num=1000, model_name="resnet-50")
     clf.compile(optimizer="sgd", loss="sparse_categorical_crossentropy")
