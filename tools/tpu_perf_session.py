@@ -284,10 +284,43 @@ def _resnet_step_times(data_format, batch=128, with_extras=False):
         with jax.profiler.trace(trace_dir):
             p, o, s, logs = step(p, o, s, dev_batch, 0)
             _sync(logs["loss"])
-        emit("resnet_profile", {"what": "trace", "dir": trace_dir})
+        emit("resnet_profile", {"what": "trace", "dir": trace_dir,
+                                "top_ops": _trace_top_ops(trace_dir)})
     except Exception as e:  # noqa: BLE001
         emit("resnet_profile", {"what": "trace",
                                 "err": str(e).splitlines()[0][:200]})
+
+
+def _trace_top_ops(trace_dir, top=8):
+    """Aggregate device-op time by op-kind from the newest profiler trace
+    so the session output itself carries the step decomposition (r5: this
+    is how the BN-reduction mass — 58 of 95 ms — was found)."""
+    import collections
+    import glob
+    import gzip
+    import re
+
+    try:
+        path = sorted(glob.glob(os.path.join(
+            trace_dir, "plugins/profile/*/*.trace.json.gz")))[-1]
+        with gzip.open(path) as f:
+            data = json.load(f)
+        ev = data.get("traceEvents", [])
+        pids = {e["pid"]: e["args"].get("name", "") for e in ev
+                if e.get("ph") == "M" and e.get("name") == "process_name"}
+        dur = collections.Counter()
+        for e in ev:
+            if e.get("ph") != "X" or \
+                    "TPU" not in pids.get(e.get("pid"), ""):
+                continue
+            n = e["name"]
+            if n.startswith(("jit_", "PjitF", "$")) or n == "0":
+                continue
+            dur[re.sub(r"[.\d]+$", "", n)] += e.get("dur", 0)
+        return [{"op": k or "(unnamed)", "ms": round(us / 1000, 2)}
+                for k, us in dur.most_common(top)]
+    except Exception as e:  # noqa: BLE001
+        return [{"err": str(e).splitlines()[0][:160]}]
 
 
 def leg_resnet_profile():
