@@ -15,7 +15,10 @@ Legs, each independently emitted to ``TPU_SESSION.jsonl`` as it finishes
 4. ``resnet_layout`` — NCHW vs NHWC conv-tower proxy (XLA TPU layout
                     assignment cost of the reference's "th" ordering).
 5. ``resnet_profile`` — ResNet-50 step decomposition: full step vs fwd
-                    vs BN-less fwd, infeed wait; optional profiler trace.
+                    vs BN-less fwd, infeed wait; profiler trace with a
+                    top-device-ops summary emitted inline.
+6. ``bert_profile`` — BERT-base single-step time + the same top-ops
+                    decomposition (baseline r5: 216 ms, f32 GEMMs).
 
 Usage: python tools/tpu_perf_session.py [leg ...]   (default: all)
 """
@@ -323,6 +326,69 @@ def _trace_top_ops(trace_dir, top=8):
         return [{"err": str(e).splitlines()[0][:160]}]
 
 
+def leg_bert_profile():
+    """BERT-base single train step + device-op decomposition — the r5
+    baseline was 216 ms with ~155 ms in GEMM fusions (f32!) and ~34 ms
+    in LN reductions; this leg documents where the step lands after the
+    bf16/kernel/fused-LN/rbg fixes."""
+    import jax
+
+    from analytics_zoo_tpu.common.nncontext import (ZooConfig, ZooContext,
+                                                    set_nncontext)
+    from analytics_zoo_tpu.feature.feature_set import ArrayFeatureSet
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense, Input
+    from analytics_zoo_tpu.pipeline.api.keras.layers.self_attention \
+        import BERT
+    from analytics_zoo_tpu.pipeline.api.keras.models import Model
+
+    set_nncontext(None)
+    set_nncontext(ZooContext(ZooConfig(compute_dtype="bfloat16")))
+    B, L, H = 32, 512, 768
+    bert = BERT(vocab=30522, hidden_size=H, n_block=12, n_head=12,
+                seq_len=L, intermediate_size=4 * H,
+                output_all_block=False)
+    tokens = Input(shape=(L,), name="tokens")
+    positions = Input(shape=(L,), name="positions")
+    segments = Input(shape=(L,), name="segments")
+    mask = Input(shape=(1, 1, L), name="mask")
+    _, pooled = bert([tokens, positions, segments, mask])
+    out = Dense(5, activation="softmax")(pooled)
+    model = Model([tokens, positions, segments, mask], out)
+    model.compile(optimizer="adam",
+                  loss="sparse_categorical_crossentropy")
+    rng = np.random.default_rng(0)
+    xs = [rng.integers(0, 30522, (B, L)).astype(np.int32),
+          np.tile(np.arange(L, dtype=np.int32), (B, 1)),
+          np.zeros((B, L), np.int32),
+          np.ones((B, 1, 1, L), np.float32)]
+    ys = rng.integers(0, 5, (B,)).astype(np.int32)
+    trainer = model._ensure_trainer()
+    trainer.ensure_initialized()
+    fs = ArrayFeatureSet(xs, ys)
+    dev_batch = trainer._put_batch(next(iter(fs.batches(B))))
+    step = trainer.build_train_step()
+    p, o, s = trainer.params, trainer.opt_state, trainer.net_state
+    times = []
+    for _ in range(6):
+        t0 = time.perf_counter()
+        p, o, s, logs = step(p, o, s, dev_batch, 0)
+        _sync(logs["loss"])
+        times.append(time.perf_counter() - t0)
+    step_ms = sorted(times)[len(times) // 2] * 1e3
+    emit("bert_profile", {"what": "train_step_ms",
+                          "ms": round(step_ms, 2)})
+    trace_dir = os.path.join(os.path.dirname(OUT), "bert_trace")
+    try:
+        with jax.profiler.trace(trace_dir):
+            p, o, s, logs = step(p, o, s, dev_batch, 0)
+            _sync(logs["loss"])
+        emit("bert_profile", {"what": "trace", "dir": trace_dir,
+                              "top_ops": _trace_top_ops(trace_dir)})
+    except Exception as e:  # noqa: BLE001
+        emit("bert_profile", {"what": "trace",
+                              "err": str(e).splitlines()[0][:200]})
+
+
 def leg_resnet_profile():
     # NCHW (the reference ordering, current bench path) with the full
     # decomposition, then the NHWC variant head-to-head
@@ -337,7 +403,8 @@ def leg_resnet_profile():
 LEGS = {"bench": leg_bench, "attn_parity": leg_attn_parity,
         "attn": leg_attn,
         "resnet_layout": leg_resnet_layout,
-        "resnet_profile": leg_resnet_profile}
+        "resnet_profile": leg_resnet_profile,
+        "bert_profile": leg_bert_profile}
 
 
 def main():
