@@ -152,10 +152,16 @@ def bench_ncf(x, y):
     from analytics_zoo_tpu.utils.profiling import device_sync
 
     # bf16 compute (the TPU design point; r5: this config now actually
-    # reaches the trainer — earlier rounds' NCF numbers were f32)
+    # reaches the trainer — earlier rounds' NCF numbers were f32). NCF's
+    # per-step compute is tiny, so on the tunneled chip the step time is
+    # mostly dispatch RTT: fuse a whole 32-step epoch into one dispatch
+    # (the auto default of 16 pays two round-trips per epoch).
+    import jax
     set_nncontext(None)
     set_nncontext(ZooContext(ZooConfig(
-        compute_dtype=_bench_dtype())))
+        compute_dtype=_bench_dtype(),
+        steps_per_dispatch=(N_SAMPLES // BATCH)
+        if jax.default_backend() == "tpu" else 0)))
     ncf = NeuralCF(N_USERS, N_ITEMS, N_CLASSES, user_embed=USER_EMBED,
                    item_embed=ITEM_EMBED, hidden_layers=HIDDEN,
                    include_mf=True, mf_embed=MF_EMBED)
