@@ -1,0 +1,105 @@
+"""On-chip A/B: fused batch-norm (ops/batchnorm.py) vs the naive
+jnp.mean+jnp.var formulation it replaced, on a ResNet-stage conv tower
+train step (b=128, bf16). Attributes the BN share of the ResNet step
+directly (r5 profile: 58 of 95 ms before the fix).
+
+Appends JSON lines to BN_TUNE.jsonl. Run serialized with nothing else
+on the chip.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+import numpy as np
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))), "BN_TUNE.jsonl")
+
+
+def emit(payload):
+    rec = {"t": round(time.time()), **payload}
+    with open(OUT, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print("EMIT", json.dumps(rec), flush=True)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.ops.batchnorm import batch_norm_train
+
+    d = jax.devices()[0]
+    emit({"what": "start", "platform": d.platform,
+          "device_kind": d.device_kind})
+
+    def naive_bn(x, g, b, axis, eps):
+        ra = tuple(i for i in range(x.ndim) if i != axis)
+        bs = [1] * x.ndim
+        bs[axis] = x.shape[axis]
+        mean = jnp.mean(x, axis=ra)
+        var = jnp.var(x, axis=ra)
+        inv = jax.lax.rsqrt(var + eps)
+        y = (x - mean.reshape(bs)) * inv.reshape(bs)
+        return (y * g.reshape(bs) + b.reshape(bs)).astype(x.dtype)
+
+    def fused_bn(x, g, b, axis, eps):
+        return batch_norm_train(x, g, b, axis, eps)[0]
+
+    rng = np.random.default_rng(0)
+    batch = 128
+    # (channels, spatial, conv+bn+relu repeats) — the resnet-50 stage
+    # shape classes, each stage an independent tower from its own input
+    specs = [(64, 56, 3), (128, 28, 4), (256, 14, 6), (512, 7, 3)]
+
+    stages = []
+    for c, hw, reps in specs:
+        kern = jnp.asarray(rng.standard_normal((c, c, 3, 3)) * 0.05,
+                           jnp.bfloat16)
+        g = jnp.ones((c,), jnp.float32)
+        b = jnp.zeros((c,), jnp.float32)
+        x = jnp.asarray(rng.standard_normal((batch, c, hw, hw)),
+                        jnp.bfloat16)
+        stages.append((kern, g, b, x, reps))
+
+    def total_loss(bn, xs):
+        loss = 0.0
+        for (kern, g, b, _, reps), x in zip(stages, xs):
+            for _ in range(reps):
+                x = jax.lax.conv_general_dilated(
+                    x, kern, (1, 1), "SAME",
+                    dimension_numbers=("NCHW", "OIHW", "NCHW"))
+                x = bn(x, g, b, 1, 1e-3)
+                x = jnp.maximum(x, 0)
+            loss = loss + (x.astype(jnp.float32) ** 2).mean()
+        return loss
+
+    xs0 = tuple(s[3] for s in stages)
+
+    from analytics_zoo_tpu.utils.profiling import device_sync
+
+    for name, bn in (("fused", fused_bn), ("naive", naive_bn)):
+        def step(xs, bn=bn):
+            return jax.grad(lambda xs: total_loss(bn, xs))(xs)
+        try:
+            fn = jax.jit(step)
+            out = fn(xs0)
+            device_sync(out)
+            t0 = time.perf_counter()
+            for _ in range(6):
+                out = fn(xs0)
+            device_sync(out)
+            emit({"what": "tower_train_step", "bn": name,
+                  "ms": round((time.perf_counter() - t0) / 6 * 1e3, 2)})
+        except Exception as e:  # noqa: BLE001
+            emit({"what": "tower_train_step", "bn": name,
+                  "err": str(e).splitlines()[0][:200]})
+
+
+if __name__ == "__main__":
+    main()
