@@ -578,6 +578,15 @@ def bench_serving(iters=60):
             float(np.percentile(rts, 99)), 3)
     finally:
         srv.stop()
+    import jax
+    if jax.default_backend() == "tpu" and \
+            out.get("serving_f32_b1_p50_ms", 0) > 20:
+        # a local-chip b=1 MLP predict is sub-ms; tens of ms means the
+        # per-call wire latency of the tunneled dev backend dominates
+        # every number in this leg (r5: p50 64 ms vs 0.71 ms CPU-local)
+        out["serving_note"] = ("latencies dominated by the dev-tunnel "
+                               "RTT, not device compute; see "
+                               "BENCH_NOTES.md r5 serving caveat")
     return out
 
 
