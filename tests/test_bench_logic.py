@@ -1,0 +1,67 @@
+"""Driver-bench harness logic (bench.py) — the selection/fallback rules
+the round's numbers depend on, exercised with stubbed measurement legs
+(no model runs).
+"""
+
+import sys
+
+import pytest
+
+
+@pytest.fixture()
+def bench(monkeypatch):
+    sys.path.insert(0, "/root/repo")
+    import bench as b
+    yield b
+
+
+def test_bert_candidates_keep_best_mfu(bench, monkeypatch):
+    calls = []
+
+    def fake(peak, bb, seq_len=512):
+        calls.append(bb)
+        return {"bert_batch": bb,
+                "bert_mfu": {64: 0.31, 32: 0.35}[bb],
+                "bert_tokens_per_sec": 1.0}
+
+    monkeypatch.setattr(bench, "_bench_bert_mfu_at", fake)
+    r = bench.bench_bert_mfu(197e12)
+    assert calls == [64, 32]
+    assert r["bert_batch"] == 32
+    assert r["bert_runner_up"]["batch"] == 64
+
+
+def test_bert_all_candidates_fail_falls_to_16(bench, monkeypatch):
+    def fake(peak, bb, seq_len=512):
+        if bb == 16:
+            return {"bert_batch": 16, "bert_mfu": 0.2,
+                    "bert_tokens_per_sec": 1.0}
+        raise RuntimeError("oom")
+
+    monkeypatch.setattr(bench, "_bench_bert_mfu_at", fake)
+    r = bench.bench_bert_mfu(197e12)
+    assert r["bert_batch"] == 16
+    assert "bert_runner_up" not in r
+
+
+def test_bert_cpu_fallback_uses_b16_only(bench, monkeypatch):
+    calls = []
+
+    def fake(peak, bb, seq_len=512):
+        calls.append(bb)
+        return {"bert_batch": bb, "bert_tokens_per_sec": 1.0}
+
+    monkeypatch.setattr(bench, "_bench_bert_mfu_at", fake)
+    r = bench.bench_bert_mfu(None)
+    assert calls == [16] and r["bert_batch"] == 16
+
+
+def test_bench_dtype_by_backend(bench):
+    # conftest pins the cpu backend for tests
+    assert bench._bench_dtype() == "float32"
+
+
+def test_peak_flops_table(bench):
+    assert bench._peak_flops("TPU v5 lite") == 197e12
+    assert bench._peak_flops("TPU v4") == 275e12
+    assert bench._peak_flops("weird accelerator") is None
