@@ -534,7 +534,20 @@ def _as_key_bias(bias, b, lk) -> Optional[jnp.ndarray]:
 # (r3's threshold of 2048 was measured against the old f32-dot 128-block
 # kernel with O(L^2) recompute backward, which lost everywhere below it.)
 # Below 512 the shapes are dispatch-bound and unmeasured — XLA keeps them.
-KERNEL_MIN_SEQ = 512
+# The two L=512 measurements disagree within noise across tunnel windows
+# (session 2: kernel 10.7 vs XLA 12.3; session 3: 16.6 vs 15.3) and the
+# kernel path additionally pays operand-relayout copies inside a full
+# model (~12 ms/step at BERT-base shapes, bert_trace session 3) that the
+# proxy A/B can't see — the perf session's full-model ``bert_routing``
+# leg is the decider, and the threshold is env-overridable so a window's
+# verdict can be applied without a code change.
+try:
+    KERNEL_MIN_SEQ = int(os.environ.get("ZOO_TPU_KERNEL_MIN_SEQ", "512"))
+except ValueError:
+    import warnings
+    warnings.warn("ZOO_TPU_KERNEL_MIN_SEQ=%r is not an integer; using 512"
+                  % os.environ.get("ZOO_TPU_KERNEL_MIN_SEQ"))
+    KERNEL_MIN_SEQ = 512
 
 
 def flash_attention(q, k, v, bias=None, causal=False, sm_scale=None,

@@ -287,17 +287,52 @@ def _resnet_step_times(data_format, batch=128, with_extras=False):
         with jax.profiler.trace(trace_dir):
             p, o, s, logs = step(p, o, s, dev_batch, 0)
             _sync(logs["loss"])
+        hlo = _step_hlo(step, p, o, s, dev_batch, 0)
         emit("resnet_profile", {"what": "trace", "dir": trace_dir,
-                                "top_ops": _trace_top_ops(trace_dir)})
+                                "top_ops": _trace_top_ops(
+                                    trace_dir, top=14, hlo_text=hlo)})
     except Exception as e:  # noqa: BLE001
         emit("resnet_profile", {"what": "trace",
                                 "err": str(e).splitlines()[0][:200]})
 
 
-def _trace_top_ops(trace_dir, top=8):
+def _hlo_defs(hlo_text):
+    """instruction name -> "opkind -> shape" from optimized-HLO text, so
+    trace op names (fusion.1416, convert_reduce_fusion.14, ...) resolve
+    to what they compute — session 3 spent a manual pass matching the two
+    by hand; this makes every future trace self-explaining."""
+    import re
+    defs = {}
+    for m in re.finditer(r"^\s*%([\w.\-]+) = (\S+?)(?:\{[^}]*\})? "
+                         r"(\w[\w\-]*)\(", hlo_text, re.M):
+        defs[m.group(1)] = f"{m.group(3)} -> {m.group(2)}"
+    return defs
+
+
+def _step_hlo(step, *args):
+    """Optimized-HLO text of a jitted step, for trace-name resolution.
+
+    ``lower().compile()`` is a SECOND full XLA compile (jax's AOT path
+    does not reuse the jit executable, and no persistent compilation
+    cache is configured) — ~1-2 min over the tunnel per model. That is
+    accepted here because the profile legs run LAST in the session (a
+    window death costs only the decomposition, never a bench number),
+    and skippable outright with ZOO_SESSION_NO_HLO=1."""
+    if os.environ.get("ZOO_SESSION_NO_HLO", "0") == "1":
+        return None
+    try:
+        return step.lower(*args).compile().as_text()
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def _trace_top_ops(trace_dir, top=8, hlo_text=None):
     """Aggregate device-op time by op-kind from the newest profiler trace
     so the session output itself carries the step decomposition (r5: this
-    is how the BN-reduction mass — 58 of 95 ms — was found)."""
+    is how the BN-reduction mass — 58 of 95 ms — was found). With
+    ``hlo_text`` (the compiled step's ``as_text()``), ops aggregate by
+    their resolved HLO definition (op kind + output shape) instead of by
+    name prefix — "copy -> bf16[32,12,512,64] x96" instead of "copy"."""
     import collections
     import glob
     import gzip
@@ -311,7 +346,9 @@ def _trace_top_ops(trace_dir, top=8):
         ev = data.get("traceEvents", [])
         pids = {e["pid"]: e["args"].get("name", "") for e in ev
                 if e.get("ph") == "M" and e.get("name") == "process_name"}
+        defs = _hlo_defs(hlo_text) if hlo_text else {}
         dur = collections.Counter()
+        cnt = collections.Counter()
         for e in ev:
             if e.get("ph") != "X" or \
                     "TPU" not in pids.get(e.get("pid"), ""):
@@ -319,8 +356,11 @@ def _trace_top_ops(trace_dir, top=8):
             n = e["name"]
             if n.startswith(("jit_", "PjitF", "$")) or n == "0":
                 continue
-            dur[re.sub(r"[.\d]+$", "", n)] += e.get("dur", 0)
-        return [{"op": k or "(unnamed)", "ms": round(us / 1000, 2)}
+            key = defs.get(n) or re.sub(r"[.\d]+$", "", n)
+            dur[key] += e.get("dur", 0)
+            cnt[key] += 1
+        return [{"op": k or "(unnamed)", "ms": round(us / 1000, 2),
+                 "n": cnt[k]}
                 for k, us in dur.most_common(top)]
     except Exception as e:  # noqa: BLE001
         return [{"err": str(e).splitlines()[0][:160]}]
@@ -382,8 +422,10 @@ def leg_bert_profile():
         with jax.profiler.trace(trace_dir):
             p, o, s, logs = step(p, o, s, dev_batch, 0)
             _sync(logs["loss"])
+        hlo = _step_hlo(step, p, o, s, dev_batch, 0)
         emit("bert_profile", {"what": "trace", "dir": trace_dir,
-                              "top_ops": _trace_top_ops(trace_dir)})
+                              "top_ops": _trace_top_ops(
+                                  trace_dir, top=14, hlo_text=hlo)})
     except Exception as e:  # noqa: BLE001
         emit("bert_profile", {"what": "trace",
                               "err": str(e).splitlines()[0][:200]})
@@ -400,8 +442,64 @@ def leg_resnet_profile():
                                 "err": str(e).splitlines()[0][:300]})
 
 
+def leg_bert_routing():
+    """Full-model BERT-base b32 L512 attention-routing A/B: Pallas kernel
+    (KERNEL_MIN_SEQ=512 default) vs the fused-XLA saved-probs path
+    (ZOO_TPU_DISABLE_PALLAS=1). The standalone ``attn`` A/B disagrees
+    with itself across tunnel windows at L=512 (session 2: kernel 10.7
+    vs 12.3; session 3: 16.6 vs 15.3 — inside window noise) and cannot
+    see the ~12 ms/step of operand-relayout copies the kernel's custom
+    calls force inside a real model (bert_trace, session 3) while XLA
+    folds the same transposes into its dots for free. Subprocess per arm
+    (the routing env var is read at trace time; a fresh process kills
+    any cache ambiguity) through the exact bench code path, so the
+    verdict maps 1:1 onto the driver number. Apply a flip with
+    ZOO_TPU_KERNEL_MIN_SEQ=1024 — no code change needed."""
+    import subprocess
+
+    import jax
+
+    device_kind = jax.devices()[0].device_kind
+    code = ("import json, sys, bench\n"
+            "peak = bench._peak_flops(sys.argv[1])\n"
+            "r = bench._bench_bert_mfu_at(peak, 32)\n"
+            "print('RR', json.dumps(r))\n")
+    # each arm pins EVERY routing knob: ambient ZOO_TPU_KERNEL_MIN_SEQ /
+    # DISABLE_PALLAS / FORCE_PALLAS (e.g. a verdict applied after an
+    # earlier window, or leftovers from manual experiments) would
+    # otherwise make both arms silently measure the same path — the
+    # in-process attn leg pins both pallas vars per mode for the same
+    # reason
+    for arm, extra in (("kernel", {"ZOO_TPU_KERNEL_MIN_SEQ": "512",
+                                   "ZOO_TPU_DISABLE_PALLAS": "0",
+                                   "ZOO_TPU_FORCE_PALLAS": "0"}),
+                       ("xla", {"ZOO_TPU_DISABLE_PALLAS": "1",
+                                "ZOO_TPU_FORCE_PALLAS": "0"})):
+        env = dict(os.environ, ZOO_BENCH_BUDGET_S="100000", **extra)
+        t0 = time.time()
+        payload = {"arm": arm}
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", code, device_kind],
+                cwd=os.path.dirname(OUT),
+                env=env, capture_output=True, text=True, timeout=1500)
+            payload["rc"] = proc.returncode
+            line = next((ln for ln in proc.stdout.splitlines()
+                         if ln.startswith("RR ")), None)
+            if line:
+                payload.update(json.loads(line[3:]))
+            else:
+                payload["err"] = (proc.stderr.strip().splitlines()
+                                  or ["no output"])[-1][:200]
+        except subprocess.TimeoutExpired:
+            payload["err"] = "timeout"
+        payload["seconds"] = round(time.time() - t0)
+        emit("bert_routing", payload)
+
+
 LEGS = {"bench": leg_bench, "attn_parity": leg_attn_parity,
         "attn": leg_attn,
+        "bert_routing": leg_bert_routing,
         "resnet_layout": leg_resnet_layout,
         "resnet_profile": leg_resnet_profile,
         "bert_profile": leg_bert_profile}
