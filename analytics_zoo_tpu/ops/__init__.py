@@ -1,3 +1,5 @@
-from .attention import attention_reference, flash_attention
+from .attention import (attention_reference, flash_attention,
+                        flash_attention_blhd)
 
-__all__ = ["attention_reference", "flash_attention"]
+__all__ = ["attention_reference", "flash_attention",
+           "flash_attention_blhd"]

@@ -171,7 +171,10 @@ def _resolve_blocks(lq, lk, block_q, block_k):
 
 def _flash_forward(q, k, v, kbias, num_heads, causal, sm_scale,
                    block_q=None, block_k=None):
-    """Returns (o, lse) with o: (BH, Lq, d), lse: (BH, Lq, 1) f32."""
+    """Returns (o, lse) with o: (BH, Lq, d), lse: (BH, Lq, 1) f32.
+    NOTE: mirrored by the blhd wrapper family below — scheme fixes must
+    land in both (see _flash_forward_blhd docstring for why they are
+    not yet unified)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -450,11 +453,196 @@ def _flash_bwd_rule(num_heads, causal, sm_scale, block_q, block_k, res,
 _flash_attention_bhld.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
+# ---------------------------------------------------------------------------
+# Transpose-free (B, L, H, d) entry — the layout a fused QKV projection
+# produces naturally. The (BH, L, d) kernels above force XLA to materialize
+# [B,H,L,d] relayout copies of q/k/v/do and transpose o back (~12 ms/step at
+# BERT-base b32 L512, 96 copies — bert_trace, r5 session 3) because a
+# pallas custom call pins its operand layouts while XLA folds the same
+# logical transposes into plain attention dots for free. These wrappers run
+# the SAME kernel bodies over the blhd arrays directly: the head axis is a
+# None (squeezed) block dim, so each ref keeps its (1, block, d) shape —
+# identical Mosaic tile shapes to the bhld path, only the row DMA becomes
+# strided. Head block index = grid (b*h) axis decomposed with //, %.
+# ---------------------------------------------------------------------------
+
+def _blhd_spec(block_l, d, num_heads, grid_order):
+    """4-D BlockSpec over a (B, L, H, d) array with the head dim squeezed.
+    ``grid_order``: which grid axis carries this operand's L-block index —
+    "qi" for axis 1 (dq/fwd grids), "qj" for axis 2, "ki" / "kj" likewise
+    for k/v operands."""
+    from jax.experimental import pallas as pl
+    h = num_heads
+    maps = {
+        "qi": lambda g, i, j: (g // h, i, g % h, 0),
+        "qj": lambda g, j, i: (g // h, i, g % h, 0),
+        "ki": lambda g, i, j: (g // h, j, g % h, 0),
+        "kj": lambda g, j, i: (g // h, j, g % h, 0),
+    }
+    return pl.BlockSpec((1, block_l, None, d), maps[grid_order])
+
+
+def _flash_forward_blhd(q, k, v, kbias, causal, sm_scale,
+                        block_q=None, block_k=None):
+    """q,k,v: (B, L, H, d). Returns (o: (B, L, H, d), lse: (BH, L, 1)).
+
+    MIRROR OF ``_flash_forward``/``_flash_backward`` (same kernel bodies,
+    same grids/scratch; only BlockSpecs, out_shapes and the delta/dkb
+    massaging differ): a fix to the flash scheme must land in BOTH
+    wrapper families. They stay separate because the bhld path is the
+    measured-and-shipped fallback (r5 session 3) — collapsing it onto
+    the blhd specs (a (BH, L, d) array IS blhd with h=1) would re-route
+    proven code through unproven specs right before its next
+    measurement window; unify after the session's attn_parity/bert_routing
+    legs prove the blhd path on Mosaic."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    bh = b * h
+    block_q, block_k = _resolve_blocks(lq, lk, block_q, block_k)
+    num_q = pl.cdiv(lq, block_q)
+    num_k = pl.cdiv(lk, block_k)
+
+    kernel = functools.partial(
+        _flash_fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, num_k_blocks=num_k)
+
+    kbias3 = kbias.reshape(kbias.shape[0], 1, lk)
+    q_spec = _blhd_spec(block_q, d, h, "qi")
+
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, num_q, num_k),
+        in_specs=[
+            q_spec,
+            _blhd_spec(block_k, d, h, "ki"),
+            _blhd_spec(block_k, d, h, "ki"),
+            _bias_specs_3d(h, block_k),
+        ],
+        out_specs=[
+            q_spec,
+            pl.BlockSpec((1, block_q, 1), lambda g, i, j: (g, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, lq, h, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, lq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret_mode(),
+    )(q, k, v, kbias3)
+
+
+def _flash_backward_blhd(q, k, v, kbias, o, lse, do, causal, sm_scale,
+                         block_q=None, block_k=None):
+    """Blockwise dq/dk/dv/dbias over (B, L, H, d) operands."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    bh = b * h
+    block_q, block_k = _resolve_blocks(lq, lk, block_q, block_k)
+    num_q = pl.cdiv(lq, block_q)
+    num_k = pl.cdiv(lk, block_k)
+
+    # delta_i = rowsum(dO_i * O_i); tiny (B*H*L f32), so the transpose to
+    # the kernels' (BH, Lq, 1) row layout is noise next to the relayout
+    # copies this path exists to kill.
+    delta = (do.astype(jnp.float32) * o.astype(jnp.float32)).sum(axis=-1)
+    delta = delta.transpose(0, 2, 1).reshape(bh, lq, 1)
+    kbias3 = kbias.reshape(kbias.shape[0], 1, lk)
+
+    q_spec = _blhd_spec(block_q, d, h, "qi")
+    k_spec = _blhd_spec(block_k, d, h, "ki")
+    row_spec_q = pl.BlockSpec((1, block_q, 1), lambda g, i, j: (g, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, num_k_blocks=num_k),
+        grid=(bh, num_q, num_k),
+        in_specs=[q_spec, k_spec, k_spec, _bias_specs_3d(h, block_k),
+                  q_spec, row_spec_q, row_spec_q],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b, lq, h, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret_mode(),
+    )(q, k, v, kbias3, do, lse, delta)
+
+    kv_spec_k = _blhd_spec(block_k, d, h, "kj")
+    kv_spec_q = _blhd_spec(block_q, d, h, "qj")
+    row_spec = pl.BlockSpec((1, block_q, 1), lambda g, j, i: (g, i, 0))
+    dk, dv, db = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, num_q_blocks=num_q),
+        grid=(bh, num_k, num_q),
+        in_specs=[kv_spec_q, kv_spec_k, kv_spec_k,
+                  pl.BlockSpec((1, 1, block_k),
+                               lambda g, j, i, hh=h: (g // hh, 0, j)),
+                  kv_spec_q, row_spec, row_spec],
+        out_specs=[
+            kv_spec_k,
+            kv_spec_k,
+            pl.BlockSpec((1, 1, block_k), lambda g, j, i: (g, 0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, lk, h, d), k.dtype),
+            jax.ShapeDtypeStruct((b, lk, h, d), v.dtype),
+            jax.ShapeDtypeStruct((bh, 1, lk), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((1, block_k), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret_mode(),
+    )(q, k, v, kbias3, do, lse, delta)
+
+    dkb = db.reshape(b, h, lk).sum(axis=1).astype(kbias.dtype)
+    return dq, dk, dv, dkb
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_attention_blhd(q, k, v, kbias, causal, sm_scale,
+                          block_q=None, block_k=None):
+    return _flash_forward_blhd(q, k, v, kbias, causal, sm_scale,
+                               block_q, block_k)[0]
+
+
+def _flash_fwd_rule_blhd(q, k, v, kbias, causal, sm_scale,
+                         block_q=None, block_k=None):
+    o, lse = _flash_forward_blhd(q, k, v, kbias, causal, sm_scale,
+                                 block_q, block_k)
+    return o, (q, k, v, kbias, o, lse)
+
+
+def _flash_bwd_rule_blhd(causal, sm_scale, block_q, block_k, res, do):
+    q, k, v, kbias, o, lse = res
+    return _flash_backward_blhd(q, k, v, kbias, o, lse, do, causal,
+                                sm_scale, block_q, block_k)
+
+
+_flash_attention_blhd.defvjp(_flash_fwd_rule_blhd, _flash_bwd_rule_blhd)
+
+
 _SHAPE_OK: dict = {}
 
 
 def _kernel_ok_for(b, h, lq, lk, d, causal, dtype, block_q=None,
-                   block_k=None) -> bool:
+                   block_k=None, layout="bhld") -> bool:
     """Per-shape hardware probe: AOT-lower + compile the forward AND
     backward kernels for this exact (B,H,Lq,Lk,d,causal,dtype) signature in
     a try/except, caching the verdict. Interpret mode does not model Mosaic
@@ -474,31 +662,48 @@ def _kernel_ok_for(b, h, lq, lk, d, causal, dtype, block_q=None,
         return True
     block_q, block_k = _resolve_blocks(lq, lk, block_q, block_k)
     key = (b, h, lq, lk, d, causal, jnp.dtype(dtype).name, block_q,
-           block_k)
+           block_k, layout)
     if key not in _SHAPE_OK:
         try:
             bh = b * h
-            qs = jax.ShapeDtypeStruct((bh, lq, d), dtype)
-            ks = jax.ShapeDtypeStruct((bh, lk, d), dtype)
             kbs = jax.ShapeDtypeStruct((b, lk), jnp.float32)
             sc = 1.0 / math.sqrt(d)
-            jax.jit(functools.partial(
-                _flash_forward, num_heads=h, causal=causal, sm_scale=sc,
-                block_q=block_q, block_k=block_k)).lower(
-                qs, ks, ks, kbs).compile()
-            os_ = jax.ShapeDtypeStruct((bh, lq, d), dtype)
-            lses = jax.ShapeDtypeStruct((bh, lq, 1), jnp.float32)
-            jax.jit(functools.partial(
-                _flash_backward, num_heads=h, causal=causal, sm_scale=sc,
-                block_q=block_q, block_k=block_k)).lower(
-                qs, ks, ks, kbs, os_, lses, os_).compile()
+            if layout == "blhd":
+                qs = jax.ShapeDtypeStruct((b, lq, h, d), dtype)
+                ks = jax.ShapeDtypeStruct((b, lk, h, d), dtype)
+                os_ = qs
+                lses = jax.ShapeDtypeStruct((bh, lq, 1), jnp.float32)
+                jax.jit(functools.partial(
+                    _flash_forward_blhd, causal=causal, sm_scale=sc,
+                    block_q=block_q, block_k=block_k)).lower(
+                    qs, ks, ks, kbs).compile()
+                jax.jit(functools.partial(
+                    _flash_backward_blhd, causal=causal, sm_scale=sc,
+                    block_q=block_q, block_k=block_k)).lower(
+                    qs, ks, ks, kbs, os_, lses, os_).compile()
+            else:
+                qs = jax.ShapeDtypeStruct((bh, lq, d), dtype)
+                ks = jax.ShapeDtypeStruct((bh, lk, d), dtype)
+                jax.jit(functools.partial(
+                    _flash_forward, num_heads=h, causal=causal,
+                    sm_scale=sc,
+                    block_q=block_q, block_k=block_k)).lower(
+                    qs, ks, ks, kbs).compile()
+                os_ = jax.ShapeDtypeStruct((bh, lq, d), dtype)
+                lses = jax.ShapeDtypeStruct((bh, lq, 1), jnp.float32)
+                jax.jit(functools.partial(
+                    _flash_backward, num_heads=h, causal=causal,
+                    sm_scale=sc,
+                    block_q=block_q, block_k=block_k)).lower(
+                    qs, ks, ks, kbs, os_, lses, os_).compile()
             _SHAPE_OK[key] = True
         except Exception as e:  # noqa: BLE001 - any compile failure
             import logging
             logging.getLogger("analytics_zoo_tpu.ops").warning(
-                "Pallas flash-attention kernel unavailable for shape "
+                "Pallas flash-attention kernel (%s) unavailable for shape "
                 "B=%d H=%d Lq=%d Lk=%d d=%d causal=%s (%s); using XLA "
-                "reference attention for this shape", b, h, lq, lk, d,
+                "reference attention for this shape", layout, b, h, lq,
+                lk, d,
                 causal, str(e).splitlines()[0] if str(e) else repr(e))
             _SHAPE_OK[key] = False
     return _SHAPE_OK[key]
@@ -550,6 +755,56 @@ except ValueError:
     KERNEL_MIN_SEQ = 512
 
 
+def _route_eligible(on_tpu, kb, lq, lk, d, causal) -> bool:
+    """Shared cheap routing gates, checked BEFORE the per-shape probe (a
+    short-sequence warmup must not pay a Mosaic compile just to be routed
+    to XLA anyway). d=64 (the common head dim) is allowed: Mosaic pads
+    the lane dim. causal requires lq == lk: the kernel masks top-left
+    aligned while the reference masks bottom-right aligned."""
+    eligible = (on_tpu and kb is not None and lq >= 128 and lk >= 128 and
+                lq % 128 == 0 and lk % 128 == 0 and
+                d % 64 == 0 and (not causal or lq == lk))
+    if os.environ.get("ZOO_TPU_FORCE_PALLAS", "0") != "1" and \
+            lq < KERNEL_MIN_SEQ:
+        eligible = False
+    return eligible
+
+
+def flash_attention_blhd(q, k, v, bias=None, causal=False, sm_scale=None,
+                         block_q=None, block_k=None):
+    """q,k,v: (B, L, H, D) -> (B, L, H, D) — the layout a fused QKV
+    projection's reshape produces with no transpose. Kernel-eligible
+    shapes run the blhd Pallas wrappers directly, which kills the
+    [B,H,L,d] operand-relayout copies the bhld custom calls force inside
+    a jitted model (~12 ms/step, 96 copies, at BERT-base b32 L512 —
+    bert_trace r5 session 3). Everything else falls back to
+    ``flash_attention`` on transposed operands: on the XLA path those
+    transposes fold into the attention dots for free, and if the bhld
+    kernel takes them the behavior is exactly the pre-blhd path.
+    ``ZOO_TPU_ATTN_LAYOUT=bhld`` forces the fallback (A/B + escape
+    hatch)."""
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    on_tpu = jax.default_backend() == "tpu" or _interpret_mode()
+    kb = _as_key_bias(bias, b, lk) if on_tpu else None
+    eligible = (_route_eligible(on_tpu, kb, lq, lk, d, causal) and
+                os.environ.get("ZOO_TPU_ATTN_LAYOUT", "blhd") != "bhld")
+    block_q, block_k = _resolve_blocks(lq, lk, block_q, block_k)
+    if eligible and _kernel_ok_for(b, h, lq, lk, d, causal, q.dtype,
+                                   block_q, block_k, layout="blhd"):
+        return _flash_attention_blhd(q, k, v, kb, causal, sm_scale,
+                                     block_q, block_k)
+
+    def tr(t):
+        return t.transpose(0, 2, 1, 3)
+
+    return tr(flash_attention(tr(q), tr(k), tr(v), bias=bias,
+                              causal=causal, sm_scale=sm_scale,
+                              block_q=block_q, block_k=block_k))
+
+
 def flash_attention(q, k, v, bias=None, causal=False, sm_scale=None,
                     block_q=None, block_k=None):
     """q,k,v: (B, H, L, D) -> (B, H, L, D).
@@ -576,20 +831,7 @@ def flash_attention(q, k, v, bias=None, causal=False, sm_scale=None,
     b, h, lq, d = q.shape
     lk = k.shape[2]
     kb = _as_key_bias(bias, b, lk) if on_tpu else None
-    # d=64 (the common head dim) is allowed: Mosaic pads the lane dim.
-    # causal requires lq == lk: the kernel masks top-left aligned while the
-    # reference (and the bwd recompute) masks bottom-right aligned.
-    # cheap eligibility gates first — the per-shape probe compiles the
-    # kernel for this exact signature, so it must run only for shapes the
-    # router would actually send to the kernel (i.e. after the
-    # KERNEL_MIN_SEQ check, or a short-sequence warmup would pay a Mosaic
-    # compile per shape just to be routed to XLA anyway)
-    eligible = (on_tpu and kb is not None and lq >= 128 and lk >= 128 and
-                lq % 128 == 0 and lk % 128 == 0 and
-                d % 64 == 0 and (not causal or lq == lk))
-    if os.environ.get("ZOO_TPU_FORCE_PALLAS", "0") != "1" and \
-            lq < KERNEL_MIN_SEQ:
-        eligible = False
+    eligible = _route_eligible(on_tpu, kb, lq, lk, d, causal)
     block_q, block_k = _resolve_blocks(lq, lk, block_q, block_k)
     use_kernel = eligible and _kernel_ok_for(b, h, lq, lk, d, causal,
                                              q.dtype, block_q, block_k)

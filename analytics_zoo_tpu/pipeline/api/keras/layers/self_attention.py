@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .....ops.attention import flash_attention
+from .....ops.attention import flash_attention_blhd
 from ..engine.base import KerasLayer, init_tensor
 
 
@@ -249,11 +249,19 @@ class TransformerLayer(KerasLayer):
             o = sp_attn(
                 heads(q), heads(k), heads(v), get_nncontext().mesh,
                 causal=not self.bidirectional, kbias=kb)
+            o = o.transpose(0, 2, 1, 3)
         else:
-            o = flash_attention(heads(q), heads(k), heads(v),
-                                bias=mask_bias,
-                                causal=not self.bidirectional)
-        o = o.transpose(0, 2, 1, 3).reshape(b, l, h)
+            # blhd entry: the (B, L, H, d) reshape of the fused QKV
+            # projection feeds the kernel directly — no [B,H,L,d]
+            # relayout copies in, no transpose back out (ops/attention.py
+            # blhd section; falls back to the transposed path when the
+            # kernel is ineligible, where XLA folds the transposes into
+            # its dots anyway)
+            o = flash_attention_blhd(
+                q.reshape(b, l, nh, d), k.reshape(b, l, nh, d),
+                v.reshape(b, l, nh, d), bias=mask_bias,
+                causal=not self.bidirectional)
+        o = o.reshape(b, l, h)
         if rng is not None:
             rng, sub = jax.random.split(rng)
             o = _dropout(o, self.attn_p_drop, sub, training)

@@ -411,6 +411,101 @@ def test_flash_kernel_parity_grid(monkeypatch, b, h, l, d, causal, dtype):
                                    rtol=tol, atol=tol)
 
 
+@pytest.mark.parametrize(
+    "b,h,l,d,causal,dtype",
+    [
+        (2, 2, 256, 64, False, "bfloat16"),
+        (2, 2, 256, 128, True, "bfloat16"),
+        (2, 2, 384, 64, True, "float32"),
+        (1, 2, 384, 128, False, "bfloat16"),
+        (6, 8, 128, 64, False, "float32"),
+        (4, 4, 128, 128, True, "float32"),
+    ])
+def test_flash_kernel_blhd_parity_grid(monkeypatch, b, h, l, d, causal,
+                                       dtype):
+    """The transpose-free (B, L, H, d) entry over the same pre-hardening
+    grid as the bhld test above: fwd + all input cotangents vs the
+    reference math on transposed operands, asserting the blhd kernel
+    (not a fallback) ran. The head-squeezed BlockSpecs put the head
+    index in the DMA, which interpret mode does model at the indexing
+    level — Mosaic-level layout legality is covered by the per-shape
+    probe + the session's attn_parity leg on first chip contact."""
+    from analytics_zoo_tpu.ops import attention as A
+
+    monkeypatch.setenv("ZOO_TPU_PALLAS_INTERPRET", "1")
+    monkeypatch.setenv("ZOO_TPU_FORCE_PALLAS", "1")
+    calls = []
+    real = A._flash_attention_blhd
+
+    def spy(*args, **kw):
+        calls.append(1)
+        return real(*args, **kw)
+
+    monkeypatch.setattr(A, "_flash_attention_blhd", spy)
+
+    qt, kt, vt = _qkv(b=b, h=h, l=l, d=d, seed=l + d + 1)
+    dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+
+    def blhd(t):
+        return t.transpose(0, 2, 1, 3).astype(dt)
+
+    q, k, v = blhd(qt), blhd(kt), blhd(vt)
+    bias = jnp.zeros((b, 1, 1, l), jnp.float32)
+    bias = bias.at[:, :, :, l - l // 5:].set(-10000.0)
+
+    def loss_flash(q, k, v, bias):
+        return (A.flash_attention_blhd(q, k, v, bias=bias,
+                                       causal=causal).astype(jnp.float32)
+                ** 2).mean()
+
+    def loss_ref(q, k, v, bias):
+        # reference math works in (B, H, L, d); transpose in and out so
+        # the cotangents land in the blhd layout for direct comparison
+        return (attention_reference(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), bias=bias,
+            causal=causal).astype(jnp.float32) ** 2).mean()
+
+    out = A.flash_attention_blhd(q, k, v, bias=bias, causal=causal)
+    assert calls, "grid point must exercise the blhd kernel, not XLA"
+    ref = attention_reference(qt.astype(dt), kt.astype(dt), vt.astype(dt),
+                              bias=bias, causal=causal)
+    tol = 2e-2 if dtype == "bfloat16" else 2e-3
+    np.testing.assert_allclose(
+        np.asarray(out.transpose(0, 2, 1, 3), np.float32),
+        np.asarray(ref, np.float32), rtol=tol, atol=tol)
+    g = jax.grad(loss_flash, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    for a, bb in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(bb, np.float32),
+                                   rtol=tol, atol=tol)
+
+
+def test_flash_blhd_layout_env_forces_fallback(monkeypatch):
+    """ZOO_TPU_ATTN_LAYOUT=bhld must route blhd inputs through the
+    transposed flash_attention path (escape hatch + A/B arm), bit-equal
+    to calling it directly."""
+    from analytics_zoo_tpu.ops import attention as A
+
+    monkeypatch.setenv("ZOO_TPU_PALLAS_INTERPRET", "1")
+    monkeypatch.setenv("ZOO_TPU_ATTN_LAYOUT", "bhld")
+    calls = []
+    monkeypatch.setattr(
+        A, "_flash_attention_blhd",
+        lambda *a, **kw: calls.append(1) or (_ for _ in ()).throw(
+            AssertionError("blhd kernel must not run")))
+    qt, kt, vt = _qkv(b=2, h=2, l=256, d=64, seed=9)
+    bias = jnp.zeros((2, 1, 1, 256), jnp.float32)
+    out = A.flash_attention_blhd(
+        qt.transpose(0, 2, 1, 3), kt.transpose(0, 2, 1, 3),
+        vt.transpose(0, 2, 1, 3), bias=bias)
+    ref = A.flash_attention(qt, kt, vt, bias=bias)
+    assert not calls
+    np.testing.assert_array_equal(
+        np.asarray(out.transpose(0, 2, 1, 3)), np.asarray(ref))
+
+
 def test_flash_kernel_ineligible_shapes_route_to_xla(monkeypatch):
     """The eligibility gates the grid above relies on: d=32,
     L-not-multiple-of-128, and full per-query bias (not key-broadcast)

@@ -137,6 +137,52 @@ def leg_attn_parity():
                          row["grad_rel_err"] < 4e-2)
         emit("attn_parity", row)
 
+    # blhd first-Mosaic-contact check (r5: head-squeezed BlockSpecs,
+    # strided head DMA — the layout interpret mode cannot vouch for):
+    # fwd + grad vs the same math through the bhld kernel path
+    for b, l, causal in [(32, 512, False), (4, 2048, True)]:
+        h, d = 12, 64
+        q4 = jnp.asarray(rng.standard_normal((b, l, h, d)), jnp.bfloat16)
+        bias = jnp.asarray(
+            (rng.random((b, 1, 1, l)) > 0.9) * -10000.0, jnp.float32)
+        row = {"B": b, "L": l, "causal": causal, "layout": "blhd"}
+        try:
+            probed = A._kernel_ok_for(b, h, l, l, d, causal, q4.dtype,
+                                      layout="blhd")
+            row["probe_ok"] = bool(probed)
+            if probed:
+                def loss4(q, bias=bias, causal=causal):
+                    return (A._flash_attention_blhd(
+                        q, q, q, bias.reshape(b, l), causal,
+                        1.0 / math.sqrt(d)).astype(jnp.float32) ** 2).sum()
+
+                def loss_t(q, bias=bias, causal=causal):
+                    t = q.transpose(0, 2, 1, 3)
+                    return (A.attention_reference(
+                        t, t, t, bias=bias, causal=causal)
+                        .astype(jnp.float32) ** 2).sum()
+                ob = jax.jit(lambda q: A._flash_attention_blhd(
+                    q, q, q, bias.reshape(b, l), causal,
+                    1.0 / math.sqrt(d)))(q4)
+                orf = jax.jit(lambda q: A.attention_reference(
+                    q.transpose(0, 2, 1, 3), q.transpose(0, 2, 1, 3),
+                    q.transpose(0, 2, 1, 3), bias=bias, causal=causal)
+                    .transpose(0, 2, 1, 3))(q4)
+                gb = jax.jit(jax.grad(loss4))(q4)
+                gr = jax.jit(jax.grad(loss_t))(q4)
+                grf = gr.astype(jnp.float32)
+                row["out_max_err"] = float(jnp.abs(
+                    ob.astype(jnp.float32) - orf.astype(jnp.float32))
+                    .max())
+                row["grad_rel_err"] = float(
+                    jnp.abs(gb.astype(jnp.float32) - grf).max() /
+                    jnp.maximum(jnp.abs(grf).max(), 1e-20))
+                row["ok"] = (row["out_max_err"] < 4e-2 and
+                             row["grad_rel_err"] < 4e-2)
+        except Exception as e:  # noqa: BLE001
+            row["err"] = str(e).splitlines()[0][:200]
+        emit("attn_parity", row)
+
 
 def leg_attn():
     import jax
@@ -470,9 +516,14 @@ def leg_bert_routing():
     # otherwise make both arms silently measure the same path — the
     # in-process attn leg pins both pallas vars per mode for the same
     # reason
-    for arm, extra in (("kernel", {"ZOO_TPU_KERNEL_MIN_SEQ": "512",
-                                   "ZOO_TPU_DISABLE_PALLAS": "0",
-                                   "ZOO_TPU_FORCE_PALLAS": "0"}),
+    for arm, extra in (("kernel-blhd", {"ZOO_TPU_KERNEL_MIN_SEQ": "512",
+                                        "ZOO_TPU_DISABLE_PALLAS": "0",
+                                        "ZOO_TPU_FORCE_PALLAS": "0",
+                                        "ZOO_TPU_ATTN_LAYOUT": "blhd"}),
+                       ("kernel-bhld", {"ZOO_TPU_KERNEL_MIN_SEQ": "512",
+                                        "ZOO_TPU_DISABLE_PALLAS": "0",
+                                        "ZOO_TPU_FORCE_PALLAS": "0",
+                                        "ZOO_TPU_ATTN_LAYOUT": "bhld"}),
                        ("xla", {"ZOO_TPU_DISABLE_PALLAS": "1",
                                 "ZOO_TPU_FORCE_PALLAS": "0"})):
         env = dict(os.environ, ZOO_BENCH_BUDGET_S="100000", **extra)
