@@ -30,16 +30,15 @@ from typing import Optional
 import jax
 
 
-def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
-                      sm_scale: Optional[float] = None, kbias=None):
-    """Per-shard q,k,v: (B, H, L_local, D); returns (B, H, L_local, D).
-
-    Must run inside ``shard_map`` over ``axis_name``. ``kbias``: optional
-    per-shard additive key bias (B, L_local) — the padding-mask form —
-    gathered to full length for the local attention.
-    """
+def _ulysses_impl(q, k, v, axis_name, head_axis, seq_axis, attn_fn,
+                  causal, sm_scale, kbias):
+    """Shared all-to-all head/seq swap: split the head axis N ways,
+    exchange so each device holds a head subset at full L, run the local
+    attention, swap back. ``head_axis``/``seq_axis`` locate those dims in
+    the operand layout; ``attn_fn(q, k, v, bias, causal, sm_scale)`` is
+    the matching full-L local attention."""
     n = jax.lax.psum(1, axis_name)
-    h, d = q.shape[1], q.shape[3]
+    h, d = q.shape[head_axis], q.shape[3]
     if h % n != 0:
         raise ValueError(f"ulysses needs heads % devices == 0, got "
                          f"H={h} over {n} devices (use ring_attention)")
@@ -47,14 +46,12 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
         sm_scale = 1.0 / math.sqrt(d)
 
     def seq_to_head(x):
-        # (B, H, L/N, D) -> (B, H/N, L, D): split the head dim N ways,
-        # exchange, concatenate the sequence chunks
-        return jax.lax.all_to_all(x, axis_name, split_axis=1,
-                                  concat_axis=2, tiled=True)
+        return jax.lax.all_to_all(x, axis_name, split_axis=head_axis,
+                                  concat_axis=seq_axis, tiled=True)
 
     def head_to_seq(x):
-        return jax.lax.all_to_all(x, axis_name, split_axis=2,
-                                  concat_axis=1, tiled=True)
+        return jax.lax.all_to_all(x, axis_name, split_axis=seq_axis,
+                                  concat_axis=head_axis, tiled=True)
 
     qh, kh, vh = seq_to_head(q), seq_to_head(k), seq_to_head(v)
 
@@ -63,23 +60,64 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
         kb_full = jax.lax.all_gather(kbias, axis_name, axis=1, tiled=True)
         bias = kb_full[:, None, None, :]          # (B, 1, 1, L)
 
+    return head_to_seq(attn_fn(qh, kh, vh, bias, causal, sm_scale))
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
+                      sm_scale: Optional[float] = None, kbias=None):
+    """Per-shard q,k,v: (B, H, L_local, D); returns (B, H, L_local, D).
+
+    Must run inside ``shard_map`` over ``axis_name``. ``kbias``: optional
+    per-shard additive key bias (B, L_local) — the padding-mask form —
+    gathered to full length for the local attention.
+    """
     from ..ops.attention import flash_attention
 
-    out = flash_attention(qh, kh, vh, bias=bias, causal=causal,
-                          sm_scale=sm_scale)
-    return head_to_seq(out)
+    def attn(q, k, v, bias, causal, sm_scale):
+        return flash_attention(q, k, v, bias=bias, causal=causal,
+                               sm_scale=sm_scale)
+
+    return _ulysses_impl(q, k, v, axis_name, head_axis=1, seq_axis=2,
+                         attn_fn=attn, causal=causal, sm_scale=sm_scale,
+                         kbias=kbias)
+
+
+def ulysses_attention_blhd(q, k, v, axis_name: str, causal: bool = False,
+                           sm_scale: Optional[float] = None, kbias=None):
+    """Per-shard q,k,v: (B, L_local, H, D); returns (B, L_local, H, D).
+
+    The transpose-free twin of ``ulysses_attention``: activations stay in
+    the (B, L, H, d) layout the QKV projection produces, the all-to-alls
+    swap the head/seq axes of THAT layout, and local attention runs
+    through ``flash_attention_blhd`` — so neither the collective nor the
+    kernel forces a [B,H,L,d] relayout copy (the bhld variant pays both:
+    the layer transpose feeding all_to_all materializes, then the pallas
+    custom call's pinned operand layouts materialize again).
+    """
+    from ..ops.attention import flash_attention_blhd
+
+    def attn(q, k, v, bias, causal, sm_scale):
+        return flash_attention_blhd(q, k, v, bias=bias, causal=causal,
+                                    sm_scale=sm_scale)
+
+    return _ulysses_impl(q, k, v, axis_name, head_axis=2, seq_axis=1,
+                         attn_fn=attn, causal=causal, sm_scale=sm_scale,
+                         kbias=kbias)
 
 
 def sharded_seq_attention(per_shard_fn, q, k, v, mesh, causal=False,
                           sm_scale=None, seq_axis: str = "seq",
-                          kbias=None):
+                          kbias=None, layout: str = "bhld"):
     """Shared shard_map wrapper for the sequence-parallel strategies:
-    q,k,v are global (B,H,L,D) arrays, L sharded over ``seq_axis``;
-    ``per_shard_fn`` is ``ring_attention`` or ``ulysses_attention``.
-    ``kbias``: optional global (B, L) additive key bias (padding mask)."""
+    q,k,v are global arrays with L sharded over ``seq_axis`` —
+    (B,H,L,D) for ``layout="bhld"`` (``ring_attention`` /
+    ``ulysses_attention``), (B,L,H,D) for ``layout="blhd"``
+    (``ulysses_attention_blhd``). ``kbias``: optional global (B, L)
+    additive key bias (padding mask)."""
     from jax.sharding import PartitionSpec as P
 
-    spec = P(None, None, seq_axis, None)
+    spec = P(None, seq_axis, None, None) if layout == "blhd" \
+        else P(None, None, seq_axis, None)
     fn = functools.partial(per_shard_fn, axis_name=seq_axis,
                            causal=causal, sm_scale=sm_scale)
     if kbias is None:
@@ -97,3 +135,13 @@ def ulysses_attention_sharded(q, k, v, mesh, causal=False, sm_scale=None,
     return sharded_seq_attention(ulysses_attention, q, k, v, mesh,
                                  causal=causal, sm_scale=sm_scale,
                                  seq_axis=seq_axis, kbias=kbias)
+
+
+def ulysses_attention_blhd_sharded(q, k, v, mesh, causal=False,
+                                   sm_scale=None, seq_axis: str = "seq",
+                                   kbias=None):
+    """(B, L, H, D) global arrays, L sharded over ``seq_axis``."""
+    return sharded_seq_attention(ulysses_attention_blhd, q, k, v, mesh,
+                                 causal=causal, sm_scale=sm_scale,
+                                 seq_axis=seq_axis, kbias=kbias,
+                                 layout="blhd")

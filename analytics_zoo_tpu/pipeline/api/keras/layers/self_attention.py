@@ -229,7 +229,8 @@ class TransformerLayer(KerasLayer):
             # key-padding bias rides along either way)
             from .....common.nncontext import get_nncontext
             from .....parallel.ring_attention import ring_attention_sharded
-            from .....parallel.ulysses import ulysses_attention_sharded
+            from .....parallel.ulysses import \
+                ulysses_attention_blhd_sharded
 
             mode = str(getattr(get_nncontext().config,
                                "sequence_parallel_mode", "auto")).lower()
@@ -244,12 +245,19 @@ class TransformerLayer(KerasLayer):
                 kb = jnp.broadcast_to(
                     mask_bias.reshape(mask_bias.shape[0], l),
                     (b, l)).astype(jnp.float32)
-            sp_attn = ulysses_attention_sharded if use_ulysses \
-                else ring_attention_sharded
-            o = sp_attn(
-                heads(q), heads(k), heads(v), get_nncontext().mesh,
-                causal=not self.bidirectional, kbias=kb)
-            o = o.transpose(0, 2, 1, 3)
+            if use_ulysses:
+                # blhd twin: all-to-alls swap the head/seq axes of the
+                # projection's natural layout, so neither the collective
+                # nor the kernel forces a relayout copy
+                o = ulysses_attention_blhd_sharded(
+                    q.reshape(b, l, nh, d), k.reshape(b, l, nh, d),
+                    v.reshape(b, l, nh, d), get_nncontext().mesh,
+                    causal=not self.bidirectional, kbias=kb)
+            else:
+                o = ring_attention_sharded(
+                    heads(q), heads(k), heads(v), get_nncontext().mesh,
+                    causal=not self.bidirectional, kbias=kb)
+                o = o.transpose(0, 2, 1, 3)
         else:
             # blhd entry: the (B, L, H, d) reshape of the fused QKV
             # projection feeds the kernel directly — no [B,H,L,d]

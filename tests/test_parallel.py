@@ -591,6 +591,49 @@ class TestUlysses:
                             np.asarray(a), np.asarray(b_),
                             rtol=2e-4, atol=2e-4)
 
+    def test_blhd_parity_fwd_bwd(self):
+        """The transpose-free (B, L, H, d) twin the layer's ulysses
+        branch now uses: fwd + input/kbias cotangents vs the reference
+        math over the causal x kbias grid."""
+        from analytics_zoo_tpu.parallel.ulysses import \
+            ulysses_attention_blhd_sharded
+
+        mesh = self._mesh()
+        rng = np.random.default_rng(3)
+        b, h, l, d = 2, 8, 64, 16
+        qt, kt, vt = (jnp.asarray(rng.standard_normal((b, h, l, d)),
+                                  jnp.float32) for _ in range(3))
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (qt, kt, vt))
+        kbias = jnp.zeros((b, l)).at[:, 50:].set(-10000.0)
+        for causal in (False, True):
+            for kb in (None, kbias):
+                out = ulysses_attention_blhd_sharded(
+                    q, k, v, mesh, causal=causal, kbias=kb)
+                bias4 = None if kb is None else kb[:, None, None, :]
+                ref = attention_reference(qt, kt, vt, bias=bias4,
+                                          causal=causal)
+                np.testing.assert_allclose(
+                    np.asarray(out.transpose(0, 2, 1, 3)),
+                    np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+                def loss(q, k, v, _c=causal, _kb=kb):
+                    return (ulysses_attention_blhd_sharded(
+                        q, k, v, mesh, causal=_c, kbias=_kb) ** 2).mean()
+
+                def loss_ref(q, k, v, _c=causal, _kb=kb):
+                    b4 = None if _kb is None else _kb[:, None, None, :]
+                    return (attention_reference(
+                        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                        v.transpose(0, 2, 1, 3), bias=b4,
+                        causal=_c) ** 2).mean()
+
+                g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+                gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+                for a, b_ in zip(g, gr):
+                    np.testing.assert_allclose(
+                        np.asarray(a), np.asarray(b_),
+                        rtol=2e-4, atol=2e-4)
+
     def test_head_count_guard(self):
         from analytics_zoo_tpu.parallel import ulysses_attention_sharded
 
@@ -599,6 +642,16 @@ class TestUlysses:
         q = jnp.asarray(rng.standard_normal((1, 4, 64, 8)), jnp.float32)
         with pytest.raises(ValueError, match="heads % devices"):
             ulysses_attention_sharded(q, q, q, mesh)   # 4 heads, 8 devs
+
+    def test_blhd_head_count_guard(self):
+        from analytics_zoo_tpu.parallel.ulysses import \
+            ulysses_attention_blhd_sharded
+
+        mesh = self._mesh()
+        rng = np.random.default_rng(1)
+        q = jnp.asarray(rng.standard_normal((1, 64, 4, 8)), jnp.float32)
+        with pytest.raises(ValueError, match="heads % devices"):
+            ulysses_attention_blhd_sharded(q, q, q, mesh)
 
     def test_layer_strategy_routing(self, monkeypatch):
         """sequence_parallel_mode: auto picks ulysses when heads divide
@@ -615,7 +668,9 @@ class TestUlysses:
             import TransformerLayer
 
         calls = {"ring": 0, "ulysses": 0}
-        real_r, real_u = R.ring_attention_sharded, U.ulysses_attention_sharded
+        # the layer's ulysses branch goes through the blhd twin (r5)
+        real_r = R.ring_attention_sharded
+        real_u = U.ulysses_attention_blhd_sharded
 
         def spy_r(*a, **kw):
             calls["ring"] += 1
@@ -626,7 +681,7 @@ class TestUlysses:
             return real_u(*a, **kw)
 
         monkeypatch.setattr(R, "ring_attention_sharded", spy_r)
-        monkeypatch.setattr(U, "ulysses_attention_sharded", spy_u)
+        monkeypatch.setattr(U, "ulysses_attention_blhd_sharded", spy_u)
 
         rng = np.random.default_rng(2)
         tokens = rng.integers(0, 50, (2, 8)).astype(np.int32)
