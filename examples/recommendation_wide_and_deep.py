@@ -35,6 +35,20 @@ def featurize(rows):
     return [wide, indicator, embed, cont]
 
 
+def census_column_info() -> ColumnFeatureInfo:
+    """The census workload's feature schema — shared with the perf
+    session's baseline_rows leg so both measure the same model."""
+    return ColumnFeatureInfo(
+        wide_base_cols=["education", "occupation"],
+        wide_base_dims=[EDU_DIM, OCC_BUCKETS],
+        wide_cross_cols=["edu_x_occ"], wide_cross_dims=[CROSS_DIM],
+        indicator_cols=["gender"], indicator_dims=[2],
+        embed_cols=["education", "occupation"],
+        embed_in_dims=[EDU_DIM + 1, OCC_BUCKETS + 1],
+        embed_out_dims=[8, 8],
+        continuous_cols=["age", "hours_per_week"])
+
+
 def main():
     args = example_args("Wide&Deep / Census-style income classification",
                         epochs=6)
@@ -46,16 +60,7 @@ def main():
     inputs = featurize(rows)
     y = rows["label"]
 
-    column_info = ColumnFeatureInfo(
-        wide_base_cols=["education", "occupation"],
-        wide_base_dims=[EDU_DIM, OCC_BUCKETS],
-        wide_cross_cols=["edu_x_occ"], wide_cross_dims=[CROSS_DIM],
-        indicator_cols=["gender"], indicator_dims=[2],
-        embed_cols=["education", "occupation"],
-        embed_in_dims=[EDU_DIM + 1, OCC_BUCKETS + 1],
-        embed_out_dims=[8, 8],
-        continuous_cols=["age", "hours_per_week"])
-    model = WideAndDeep(class_num=2, column_info=column_info,
+    model = WideAndDeep(class_num=2, column_info=census_column_info(),
                         model_type="wide_n_deep",
                         hidden_layers=(32, 16))
     model.compile(optimizer=Adam(lr=1e-3),

@@ -548,9 +548,124 @@ def leg_bert_routing():
         emit("bert_routing", payload)
 
 
+def leg_baseline_rows():
+    """The BASELINE.md 'measure' rows without a dedicated number yet:
+    Wide&Deep/census steps/s, TextClassifier/news20 steps/s, and
+    ResNet-50 fine-tune (frozen backbone, trainable head) images/s —
+    all through the public compile/fit path, with the engine's k-step
+    dispatch fusion doing its normal job. Shapes mirror the reference
+    workloads (census featurization dims from
+    examples/recommendation_wide_and_deep.py; news20 + glove.6B.200d
+    scale for the classifier)."""
+    import jax
+
+    from analytics_zoo_tpu.common.nncontext import (ZooConfig, ZooContext,
+                                                    set_nncontext)
+
+    # ZOO_BASELINE_SMOKE=1: tiny shapes so the leg is testable on the
+    # 1-core CPU box; full sizes are the measurement configuration
+    smoke = os.environ.get("ZOO_BASELINE_SMOKE", "0") == "1"
+    rng = np.random.default_rng(0)
+
+    def timed_fit(model, xs, ys, batch, n, tag, unit_scale=1.0,
+                  unit="steps_per_sec", epochs=3):
+        n_batches = n // batch
+        model.fit(xs, ys, batch_size=batch, nb_epoch=1)   # compile+warm
+        windows = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            model.fit(xs, ys, batch_size=batch, nb_epoch=epochs)
+            dt = time.perf_counter() - t0
+            windows.append(n_batches * epochs / dt * unit_scale)
+        windows.sort()
+        emit("baseline_rows", {
+            "row": tag, unit: round(windows[1], 2),
+            "windows": [round(w, 2) for w in windows],
+            "batch": batch})
+
+    def err_str(e):
+        return ((str(e).splitlines() or [repr(e)])[0] or repr(e))[:200]
+
+    # -- Wide&Deep / census-style rows (BASELINE row 3) ----------------
+    # featurization + schema come from the example itself, so this leg
+    # measures exactly the workload it claims to mirror
+    try:
+        set_nncontext(ZooContext(ZooConfig()))
+        from analytics_zoo_tpu.models.recommendation import WideAndDeep
+        ex_dir = os.path.join(os.path.dirname(OUT), "examples")
+        sys.path.insert(0, ex_dir)
+        try:
+            import common as _ex_common
+            import recommendation_wide_and_deep as _wd_ex
+        finally:
+            sys.path.remove(ex_dir)
+        n, batch = (512, 64) if smoke else (16384, 1024)
+        rows = _ex_common.census_like(n, seed=0)
+        inputs = _wd_ex.featurize(rows)
+        ys = rows["label"]
+        wnd = WideAndDeep(class_num=2,
+                          column_info=_wd_ex.census_column_info(),
+                          hidden_layers=(40, 20, 10))
+        wnd.compile(optimizer="adam",
+                    loss="sparse_categorical_crossentropy")
+        timed_fit(wnd, inputs, ys, batch, n, "wide_and_deep_census")
+    except Exception as e:  # noqa: BLE001
+        emit("baseline_rows", {"row": "wide_and_deep_census",
+                               "err": err_str(e)})
+
+    # -- TextClassifier / news20 scale (BASELINE row 5) ----------------
+    try:
+        set_nncontext(ZooContext(ZooConfig(compute_dtype="bfloat16")))
+        from analytics_zoo_tpu.models.textclassification import \
+            TextClassifier
+        vocab, seq, emb_d, classes = (200, 32, 16, 5) if smoke \
+            else (20000, 500, 200, 20)
+        n, batch = (256, 64) if smoke else (2048, 128)
+        table = (rng.standard_normal((vocab + 1, emb_d))
+                 .astype(np.float32) * 0.1)
+        docs = rng.integers(1, vocab + 1, (n, seq)).astype(np.int32)
+        labels = rng.integers(0, classes, n).astype(np.int32)
+        clf = TextClassifier(class_num=classes, embedding=table,
+                             sequence_length=seq, encoder="cnn",
+                             encoder_output_dim=256)
+        clf.compile(optimizer="adam",
+                    loss="sparse_categorical_crossentropy")
+        timed_fit(clf, docs, labels, batch, n, "text_classifier_news20")
+    except Exception as e:  # noqa: BLE001
+        emit("baseline_rows", {"row": "text_classifier_news20",
+                               "err": err_str(e)})
+
+    # -- ResNet-50 fine-tune: frozen backbone (BASELINE row 4) ---------
+    if jax.default_backend() != "tpu" and not smoke:
+        emit("baseline_rows", {"row": "resnet50_finetune",
+                               "skipped": "needs a TPU (CPU fallback "
+                                          "cannot finish a window)"})
+        return
+    try:
+        set_nncontext(ZooContext(ZooConfig(compute_dtype="bfloat16")))
+        from analytics_zoo_tpu.models.image.imageclassification import \
+            ImageClassifier
+        n, batch = (8, 4) if smoke else (512, 128)
+        clf = ImageClassifier(class_num=37, model_name="resnet-50")
+        net = clf.model
+        last = net.graph_function().layers[-1].name
+        net.compile(optimizer="adam",
+                    loss="sparse_categorical_crossentropy")
+        net.freeze(None)
+        net.unfreeze([last])
+        xs = rng.standard_normal((n, 3, 224, 224)).astype(np.float32)
+        ys = rng.integers(0, 37, n).astype(np.int32)
+        timed_fit(net, xs, ys, batch, n, "resnet50_finetune",
+                  unit_scale=batch, unit="images_per_sec", epochs=1)
+    except Exception as e:  # noqa: BLE001
+        emit("baseline_rows", {"row": "resnet50_finetune",
+                               "err": err_str(e)})
+
+
 LEGS = {"bench": leg_bench, "attn_parity": leg_attn_parity,
         "attn": leg_attn,
         "bert_routing": leg_bert_routing,
+        "baseline_rows": leg_baseline_rows,
         "resnet_layout": leg_resnet_layout,
         "resnet_profile": leg_resnet_profile,
         "bert_profile": leg_bert_profile}
