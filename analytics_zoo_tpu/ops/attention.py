@@ -709,6 +709,26 @@ def _kernel_ok_for(b, h, lq, lk, d, causal, dtype, block_q=None,
     return _SHAPE_OK[key]
 
 
+def kernel_layouts_ok(b=None, h=None, lq=None, lk=None, d=None):
+    """Which kernel layouts passed their per-shape probe, optionally
+    scoped to a signature (None = wildcard). Returns ``["forced"]`` when
+    ZOO_TPU_FORCE_PALLAS / interpret mode skip probing entirely — the
+    kernel ran, nothing was probed, and an empty list would read as an
+    XLA fallback. Owns the probe-cache key layout so measurement
+    harnesses don't depend on the private tuple format."""
+    if _interpret_mode() or \
+            os.environ.get("ZOO_TPU_FORCE_PALLAS", "0") == "1":
+        return ["forced"]
+    out = set()
+    for key, ok in _SHAPE_OK.items():
+        kb, kh, klq, klk, kd = key[:5]
+        if ok and (b is None or kb == b) and (h is None or kh == h) and \
+                (lq is None or klq == lq) and (lk is None or klk == lk) \
+                and (d is None or kd == d):
+            out.add(key[-1])
+    return sorted(out)
+
+
 def _kernel_available() -> bool:
     """Process-level probe at a tiny representative shape (kept for tests
     and cheap capability checks; routing itself uses the per-shape

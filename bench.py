@@ -367,6 +367,14 @@ def _bench_bert_mfu_at(peak_flops, bert_batch, seq_len=BERT_SEQ):
     flops = _bert_flops_per_step(bert_batch, seq_len, BERT_H, BERT_BLOCKS,
                                  BERT_CLASSES)
     achieved = flops / dt
+    # which pallas layouts actually passed their per-shape probe FOR
+    # THIS leg's shapes — if the blhd path fell back on Mosaic, the
+    # number is still valid but attributes to the old kernel path, and
+    # the record must say so (the probe's fallback is otherwise a log
+    # line nobody re-reads)
+    from analytics_zoo_tpu.ops.attention import kernel_layouts_ok
+    layouts = kernel_layouts_ok(b=bert_batch, h=BERT_HEADS, lq=seq_len,
+                                lk=seq_len, d=BERT_H // BERT_HEADS)
     return {
         "bert_batch": bert_batch,
         "bert_step_time_ms": round(dt * 1e3, 2),
@@ -375,6 +383,7 @@ def _bench_bert_mfu_at(peak_flops, bert_batch, seq_len=BERT_SEQ):
         "bert_model_tflops_per_sec": round(achieved / 1e12, 2),
         "bert_mfu": (round(achieved / peak_flops, 4)
                      if peak_flops else None),
+        "bert_kernel_layouts_ok": layouts,
     }
 
 

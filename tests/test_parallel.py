@@ -482,6 +482,28 @@ def test_flash_kernel_blhd_parity_grid(monkeypatch, b, h, l, d, causal,
                                    rtol=tol, atol=tol)
 
 
+def test_kernel_layouts_ok_scoping(monkeypatch):
+    """The probe-cache accessor bench.py records per leg: scoped to a
+    signature (a blhd pass at another batch must not mask this batch's
+    fallback), and 'forced' when FORCE_PALLAS/interpret skip probing."""
+    from analytics_zoo_tpu.ops import attention as A
+
+    monkeypatch.setattr(A, "_SHAPE_OK", {
+        (64, 12, 512, 512, 64, False, "bfloat16", 512, 512, "blhd"): True,
+        (32, 12, 512, 512, 64, False, "bfloat16", 512, 512, "blhd"): False,
+        (32, 12, 512, 512, 64, False, "bfloat16", 512, 512, "bhld"): True,
+    })
+    monkeypatch.delenv("ZOO_TPU_FORCE_PALLAS", raising=False)
+    monkeypatch.delenv("ZOO_TPU_PALLAS_INTERPRET", raising=False)
+    assert A.kernel_layouts_ok(b=32, h=12, lq=512, lk=512,
+                               d=64) == ["bhld"]
+    assert A.kernel_layouts_ok(b=64, h=12, lq=512, lk=512,
+                               d=64) == ["blhd"]
+    assert A.kernel_layouts_ok() == ["bhld", "blhd"]
+    monkeypatch.setenv("ZOO_TPU_FORCE_PALLAS", "1")
+    assert A.kernel_layouts_ok() == ["forced"]
+
+
 def test_flash_blhd_layout_env_forces_fallback(monkeypatch):
     """ZOO_TPU_ATTN_LAYOUT=bhld must route blhd inputs through the
     transposed flash_attention path (escape hatch + A/B arm), bit-equal
