@@ -363,8 +363,14 @@ class SPMDTrainer:
                            if k in self.frozen_names else u)
                        for k, u in updates.items()}
         params = optax.apply_updates(params, updates)
-        logs = {"loss": loss,
-                "grad_norm": optax.global_norm(grads)}
+        # logs carries only what a consumer reads (the fit loop and the
+        # scan body use just the loss). A grad_norm output used to ride
+        # along "for free": in the fused k-step path XLA dead-code
+        # eliminated it, but every SINGLE-step dispatch materialized an
+        # unconsumed full-gradient read + serializing global reduce as a
+        # jit output. Norm logging belongs to the clipping path, which
+        # already computes it.
+        logs = {"loss": loss}
         return params, opt_state, new_state, logs
 
     def build_train_step(self):
