@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .....ops.attention import flash_attention_blhd
+from .....ops.fused_dropout_ln import dropout_add_layer_norm
 from ..engine.base import KerasLayer, init_tensor
 
 
@@ -278,12 +279,17 @@ class TransformerLayer(KerasLayer):
         return o
 
     def _block(self, p, x, mask_bias, rng, training):
+        # both residual sites run the fused dropout+add+LN op: one
+        # bandwidth pass on the TPU kernel path (ops/fused_dropout_ln.py
+        # — the composed XLA fusions measured ~4x off ideal, 17.6 ms of
+        # the BERT-base step, r5 session 3), the exact pre-existing
+        # bernoulli+layer_norm composition everywhere else
         r1 = r2 = r3 = None
         if rng is not None:
             r1, r2, r3 = jax.random.split(rng, 3)
         a = self._attention(p, x, mask_bias, r1, training)
-        a = _dropout(a, self.hidden_p_drop, r2, training)
-        n = self._ln(x + a, p["ln1_g"], p["ln1_b"])
+        n = dropout_add_layer_norm(a, x, p["ln1_g"], p["ln1_b"], r2,
+                                   self.hidden_p_drop, training)
         if self.moe_experts:
             m = self._moe.call(p["moe"], n, training=training)
         else:
@@ -292,8 +298,8 @@ class TransformerLayer(KerasLayer):
             m = self._gelu(m)
             m = jnp.matmul(m, p["mlp_out_w"].astype(x.dtype)) + \
                 p["mlp_out_b"].astype(x.dtype)
-        m = _dropout(m, self.hidden_p_drop, r3, training)
-        return self._ln(n + m, p["ln2_g"], p["ln2_b"])
+        return dropout_add_layer_norm(m, n, p["ln2_g"], p["ln2_b"], r3,
+                                      self.hidden_p_drop, training)
 
     def _embed(self, params, inputs, rng, training):
         if self.embedding_layer is not None:

@@ -404,8 +404,10 @@ def bench_resnet_mfu(peak_flops, batch_candidates=(512, 256, 128, 64, 32)):
     from analytics_zoo_tpu.utils.profiling import device_sync  # noqa: F401
 
     results = []
+    tried = []
     last_err = None
     for bb in batch_candidates:
+        tried.append(bb)
         try:
             results.append(_bench_resnet_mfu_at(peak_flops, bb))
         except Exception as e:  # noqa: BLE001 - e.g. OOM at the big batch
@@ -419,10 +421,15 @@ def bench_resnet_mfu(peak_flops, batch_candidates=(512, 256, 128, 64, 32)):
                 time.time() - T_START > TOTAL_BUDGET_S * 0.7:
             break
     if not results:
-        # last resort (mirrors the BERT leg): a small batch that
-        # survives most OOM situations and measures in seconds
+        # last resort (mirrors the BERT leg) — only when the budget
+        # break skipped the small candidates; re-running a batch that
+        # just failed would burn chip time on a known failure
+        fallback = next((bb for bb in batch_candidates
+                         if bb <= 64 and bb not in tried), None)
+        if fallback is None:
+            raise last_err
         try:
-            results.append(_bench_resnet_mfu_at(peak_flops, 64))
+            results.append(_bench_resnet_mfu_at(peak_flops, fallback))
         except Exception:  # noqa: BLE001
             raise last_err
     key = (lambda r: r.get("resnet_mfu") or 0) if peak_flops else \

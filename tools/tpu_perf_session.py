@@ -183,6 +183,60 @@ def leg_attn_parity():
             row["err"] = str(e).splitlines()[0][:200]
         emit("attn_parity", row)
 
+    # fused dropout+add+LN first-Mosaic-contact (r5,
+    # ops/fused_dropout_ln.py): kernel vs the same bits-threshold
+    # dropout composed with the fused layer_norm, fwd + grads, at the
+    # BERT-base residual-site shape
+    try:
+        from analytics_zoo_tpu.ops import fused_dropout_ln as F
+        from analytics_zoo_tpu.ops.layernorm import layer_norm
+
+        n_rows, dmod = 32 * 512, 768
+        x = jnp.asarray(rng.standard_normal((n_rows, dmod)),
+                        jnp.bfloat16)
+        r = jnp.asarray(rng.standard_normal((n_rows, dmod)),
+                        jnp.bfloat16)
+        g = jnp.asarray(rng.standard_normal(dmod), jnp.float32)
+        bb_ = jnp.asarray(rng.standard_normal(dmod), jnp.float32)
+        bits = jnp.asarray(rng.integers(
+            0, 2 ** 32, (n_rows, dmod), dtype=np.uint64).astype(
+            np.uint32))
+        keep, eps = 0.9, 1e-5
+        br = F._pick_rows(n_rows)
+        probed = F._kernel_ok(n_rows, dmod, jnp.bfloat16, keep, br)
+        row = {"what": "dln", "n": n_rows, "d": dmod,
+               "probe_ok": bool(probed)}
+        if probed:
+            def ref(x, r, g, b):
+                mask = bits < F._thresh(keep)
+                z = jnp.where(mask, x.astype(jnp.float32) / keep,
+                              0.0) + r.astype(jnp.float32)
+                return layer_norm(z.astype(x.dtype), g, b, eps)
+
+            y = jax.jit(lambda x, r, g, b: F._dln(
+                x, r, bits, g, b, keep, eps, br))(x, r, g, bb_)
+            yr = jax.jit(ref)(x, r, g, bb_)
+            row["out_max_err"] = float(jnp.abs(
+                y.astype(jnp.float32) - yr.astype(jnp.float32)).max())
+
+            def loss_k(x):
+                return (F._dln(x, r, bits, g, bb_, keep, eps,
+                               br).astype(jnp.float32) ** 2).sum()
+
+            def loss_r(x):
+                return (ref(x, r, g, bb_).astype(jnp.float32) ** 2).sum()
+            gk = jax.jit(jax.grad(loss_k))(x)
+            gref = jax.jit(jax.grad(loss_r))(x).astype(jnp.float32)
+            row["grad_rel_err"] = float(
+                jnp.abs(gk.astype(jnp.float32) - gref).max() /
+                jnp.maximum(jnp.abs(gref).max(), 1e-20))
+            row["ok"] = (row["out_max_err"] < 4e-2 and
+                         row["grad_rel_err"] < 4e-2)
+    except Exception as e:  # noqa: BLE001
+        row = {"what": "dln",
+               "err": (str(e).splitlines() or [repr(e)])[0][:200]}
+    emit("attn_parity", row)
+
 
 def leg_attn():
     import jax
