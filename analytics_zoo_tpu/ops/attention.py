@@ -775,6 +775,60 @@ except ValueError:
     KERNEL_MIN_SEQ = 512
 
 
+_PARTITION_WARNED = [False]
+
+
+def mosaic_partition_ok() -> bool:
+    """Mosaic custom calls cannot be auto-partitioned: under a
+    multi-device jit they only compile when ALL mesh axes are manual —
+    i.e. inside a plain (fully-manual) ``shard_map`` — and jax raises
+    ``NotImplementedError`` otherwise (jax._src.tpu_custom_call). The
+    per-shape probe compiles with unsharded avals in a single-device
+    context, so it cannot catch this; routing itself must fall back to
+    the XLA paths (which partition automatically) for multi-device
+    global-jit contexts. The sp/pp paths wrap blocks in fully-manual
+    shard_maps, so long-context and pipeline runs keep the kernels.
+
+    Detection caveat (measured on jax 0.9): inside the engine's own
+    multi-device jit the abstract mesh reads EMPTY — same as a plain
+    single-device jit — so outside a shard_map the only usable signals
+    are process-level: the framework context's mesh size when one is
+    active, else ``jax.device_count()``. A single-chip user on a
+    multi-device host without a ZooContext is therefore blocked
+    conservatively (warned once); ``ZOO_TPU_FORCE_PALLAS=1`` keeps its
+    contract — the user insists, so a partitioning failure surfaces
+    loudly instead of being silently rerouted."""
+    if _interpret_mode() or \
+            os.environ.get("ZOO_TPU_FORCE_PALLAS", "0") == "1":
+        return True
+    try:
+        from jax._src import mesh as _jmesh
+        am = _jmesh.get_abstract_mesh()
+        manual = set(getattr(am, "manual_axes", ()) or ())
+        axes = set(getattr(am, "axis_names", ()) or ())
+        if axes and manual == axes:
+            return True
+    except Exception:  # noqa: BLE001 - private API moved; be conservative
+        pass
+    from ..common import nncontext as _nn
+    ctx = _nn._global_context
+    if ctx is not None:
+        ok = int(np.prod(list(ctx.mesh.shape.values()) or [1])) == 1
+    else:
+        ok = jax.device_count() == 1
+    if not ok and not _PARTITION_WARNED[0]:
+        _PARTITION_WARNED[0] = True
+        import logging
+        logging.getLogger("analytics_zoo_tpu.ops").warning(
+            "Pallas kernels disabled outside shard_map on a multi-device"
+            " mesh (Mosaic custom calls cannot be auto-partitioned; the"
+            " XLA paths take over). Single-chip use on a multi-device"
+            " host can override with ZOO_TPU_FORCE_PALLAS=1; multi-chip"
+            " kernel use goes through the sequence-parallel/pipeline"
+            " shard_map paths.")
+    return ok
+
+
 def _route_eligible(on_tpu, kb, lq, lk, d, causal) -> bool:
     """Shared cheap routing gates, checked BEFORE the per-shape probe (a
     short-sequence warmup must not pay a Mosaic compile just to be routed
@@ -783,7 +837,8 @@ def _route_eligible(on_tpu, kb, lq, lk, d, causal) -> bool:
     aligned while the reference masks bottom-right aligned."""
     eligible = (on_tpu and kb is not None and lq >= 128 and lk >= 128 and
                 lq % 128 == 0 and lk % 128 == 0 and
-                d % 64 == 0 and (not causal or lq == lk))
+                d % 64 == 0 and (not causal or lq == lk) and
+                mosaic_partition_ok())
     if os.environ.get("ZOO_TPU_FORCE_PALLAS", "0") != "1" and \
             lq < KERNEL_MIN_SEQ:
         eligible = False

@@ -238,9 +238,11 @@ def dropout_add_layer_norm(x, resid, gamma, beta, rng, p_drop,
     d = x.shape[-1]
     n = int(np.prod(x.shape[:-1]))
     block_rows = _pick_rows(n)
+    from .attention import mosaic_partition_ok
+
     on_tpu = jax.default_backend() == "tpu" or _interpret_mode()
     eligible = (on_tpu and keep < 1.0 and d % 128 == 0 and d <= 4096 and
-                block_rows > 0 and
+                block_rows > 0 and mosaic_partition_ok() and
                 os.environ.get("ZOO_TPU_DISABLE_FUSED_DLN", "0") != "1")
     if eligible and _kernel_ok(n, d, x.dtype, keep, block_rows):
         bits = jax.random.bits(rng, (n, d), jnp.uint32)

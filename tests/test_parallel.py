@@ -324,6 +324,10 @@ def test_per_shape_probe_silent_fallback(monkeypatch):
     monkeypatch.setattr(A, "_interpret_mode", lambda: False)
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu",
                         raising=False)
+    # this test exercises the probe; pin the (separately tested)
+    # multi-device partition guard open — the 8-device CPU runtime
+    # would otherwise block eligibility before the probe runs
+    monkeypatch.setattr(A, "mosaic_partition_ok", lambda: True)
 
     def boom(*a, **kw):
         raise RuntimeError("Mosaic lowering failed for this shape")
@@ -563,6 +567,61 @@ def test_fused_dropout_ln_fallbacks(monkeypatch):
     np.testing.assert_array_equal(
         np.asarray(out),
         np.asarray(layer_norm(dropped + res, g, b, 1e-5)))
+
+
+def test_mosaic_partition_guard(monkeypatch):
+    """Mosaic custom calls raise under a multi-device jit unless ALL
+    mesh axes are manual (jax._src.tpu_custom_call) — the probe can't
+    catch it (it compiles unsharded avals), so routing must. On this
+    8-device CPU runtime: blocked outside shard_map, allowed inside a
+    fully-manual shard_map, bypassed in interpret mode."""
+    from analytics_zoo_tpu.common import nncontext as NN
+    from analytics_zoo_tpu.common.nncontext import (ZooConfig, ZooContext,
+                                                    set_nncontext)
+    from analytics_zoo_tpu.ops import attention as A
+    from analytics_zoo_tpu.parallel.mesh import make_mesh
+
+    monkeypatch.delenv("ZOO_TPU_PALLAS_INTERPRET", raising=False)
+    monkeypatch.delenv("ZOO_TPU_FORCE_PALLAS", raising=False)
+    monkeypatch.setattr(NN, "_global_context", None)
+    assert jax.device_count() == 8
+    assert not A.mosaic_partition_ok()     # no context, 8-device host
+
+    seen = []
+    mesh = make_mesh(data=8)
+
+    def f(x):
+        seen.append(A.mosaic_partition_ok())
+        return x * 2
+
+    jax.jit(jax.shard_map(f, mesh=mesh, in_specs=P("data"),
+                          out_specs=P("data")))(jnp.ones((8,)))
+    assert seen == [True]                  # fully-manual shard_map
+
+    # the framework context's mesh size decides outside shard_map: the
+    # engine's multi-device jit shows an EMPTY abstract mesh (measured,
+    # jax 0.9), so process-level signals are the only ones available
+    set_nncontext(ZooContext(ZooConfig(data_parallel=8)))
+    try:
+        assert not A.mosaic_partition_ok()
+    finally:
+        set_nncontext(None)
+    # a 1-device mesh context allows the kernels (a real ZooContext must
+    # cover all visible devices, so stub the mesh shape on this 8-device
+    # runtime)
+    import types
+    monkeypatch.setattr(
+        NN, "_global_context",
+        types.SimpleNamespace(mesh=types.SimpleNamespace(
+            shape={"data": 1})))
+    assert A.mosaic_partition_ok()
+
+    monkeypatch.setattr(NN, "_global_context", None)
+    monkeypatch.setenv("ZOO_TPU_FORCE_PALLAS", "1")
+    assert A.mosaic_partition_ok()         # loud-failure contract kept
+    monkeypatch.delenv("ZOO_TPU_FORCE_PALLAS", raising=False)
+    monkeypatch.setenv("ZOO_TPU_PALLAS_INTERPRET", "1")
+    assert A.mosaic_partition_ok()
 
 
 def test_kernel_layouts_ok_scoping(monkeypatch):
