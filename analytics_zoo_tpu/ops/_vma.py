@@ -30,3 +30,19 @@ def psum_grad_like(grad, param, cotangent):
     if not extra:
         return grad
     return jax.lax.psum(grad, extra)
+
+
+def out_struct(shape, dtype, *like):
+    """``ShapeDtypeStruct`` for a ``pallas_call`` output whose ``vma``
+    is the union of the operands' varying axes. Inside ``shard_map``
+    (``check_vma=True``, the jax 0.9 default) pallas outputs must
+    declare how they vary across mesh axes or tracing fails with
+    "vma on jax.ShapeDtypeStruct must not be None"; a kernel output
+    varies over exactly the axes its operands do. No-op outside
+    shard_map (empty vma)."""
+    vma = frozenset().union(*[_vma(x) for x in like]) if like \
+        else frozenset()
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except TypeError:   # older jax without the vma argument
+        return jax.ShapeDtypeStruct(shape, dtype)

@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ._vma import out_struct
+
 DEFAULT_MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
 
 
@@ -207,8 +209,8 @@ def _flash_forward(q, k, v, kbias, num_heads, causal, sm_scale,
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, lq, 1), jnp.float32),
+            out_struct((bh, lq, d), q.dtype, q, k, v, kbias),
+            out_struct((bh, lq, 1), jnp.float32, q, k, v, kbias),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -366,7 +368,7 @@ def _flash_backward(q, k, v, kbias, o, lse, do, num_heads, causal, sm_scale,
                   _bias_specs_3d(num_heads, block_k),
                   qkv_spec_q, row_spec_q, row_spec_q],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
+        out_shape=out_struct((bh, lq, d), q.dtype, q, k, v, do),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
@@ -393,9 +395,9 @@ def _flash_backward(q, k, v, kbias, o, lse, do, num_heads, causal, sm_scale,
             pl.BlockSpec((1, 1, block_k), lambda b, j, i: (b, 0, j)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, lk, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, lk, d), v.dtype),
-            jax.ShapeDtypeStruct((bh, 1, lk), jnp.float32),
+            out_struct((bh, lk, d), k.dtype, q, k, v, do),
+            out_struct((bh, lk, d), v.dtype, q, k, v, do),
+            out_struct((bh, 1, lk), jnp.float32, q, k, v, do),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -526,8 +528,8 @@ def _flash_forward_blhd(q, k, v, kbias, causal, sm_scale,
             pl.BlockSpec((1, block_q, 1), lambda g, i, j: (g, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, lq, h, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, lq, 1), jnp.float32),
+            out_struct((b, lq, h, d), q.dtype, q, k, v, kbias),
+            out_struct((bh, lq, 1), jnp.float32, q, k, v, kbias),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -572,7 +574,7 @@ def _flash_backward_blhd(q, k, v, kbias, o, lse, do, causal, sm_scale,
         in_specs=[q_spec, k_spec, k_spec, _bias_specs_3d(h, block_k),
                   q_spec, row_spec_q, row_spec_q],
         out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct((b, lq, h, d), q.dtype),
+        out_shape=out_struct((b, lq, h, d), q.dtype, q, k, v, do),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
@@ -597,9 +599,9 @@ def _flash_backward_blhd(q, k, v, kbias, o, lse, do, causal, sm_scale,
             pl.BlockSpec((1, 1, block_k), lambda g, j, i: (g, 0, j)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, lk, h, d), k.dtype),
-            jax.ShapeDtypeStruct((b, lk, h, d), v.dtype),
-            jax.ShapeDtypeStruct((bh, 1, lk), jnp.float32),
+            out_struct((b, lk, h, d), k.dtype, q, k, v, do),
+            out_struct((b, lk, h, d), v.dtype, q, k, v, do),
+            out_struct((bh, 1, lk), jnp.float32, q, k, v, do),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),

@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ._vma import psum_grad_like
+from ._vma import out_struct, psum_grad_like
 from .layernorm import layer_norm
 
 
@@ -122,10 +122,10 @@ def _dln_forward(x2, r2, bits2, gamma, beta, keep, eps, block_rows):
         in_specs=[row_spec, row_spec, row_spec, vec_spec, vec_spec],
         out_specs=[row_spec, row_spec, one_spec, one_spec],
         out_shape=[
-            jax.ShapeDtypeStruct((n, d), x2.dtype),
-            jax.ShapeDtypeStruct((n, d), x2.dtype),
-            jax.ShapeDtypeStruct((n, 1), jnp.float32),
-            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+            out_struct((n, d), x2.dtype, x2, r2, bits2),
+            out_struct((n, d), x2.dtype, x2, r2, bits2),
+            out_struct((n, 1), jnp.float32, x2, r2, bits2),
+            out_struct((n, 1), jnp.float32, x2, r2, bits2),
         ],
         interpret=_interpret_mode(),
     )(x2, r2, bits2, gamma.reshape(1, d), beta.reshape(1, d))
@@ -149,10 +149,10 @@ def _dln_backward(dy2, z2, bits2, gamma, mean, inv, keep, block_rows):
                   one_spec],
         out_specs=[row_spec, row_spec, part_spec, part_spec],
         out_shape=[
-            jax.ShapeDtypeStruct((n, d), dy2.dtype),
-            jax.ShapeDtypeStruct((n, d), dy2.dtype),
-            jax.ShapeDtypeStruct((nblk, 1, d), jnp.float32),
-            jax.ShapeDtypeStruct((nblk, 1, d), jnp.float32),
+            out_struct((n, d), dy2.dtype, dy2, z2, bits2),
+            out_struct((n, d), dy2.dtype, dy2, z2, bits2),
+            out_struct((nblk, 1, d), jnp.float32, dy2, z2, bits2),
+            out_struct((nblk, 1, d), jnp.float32, dy2, z2, bits2),
         ],
         interpret=_interpret_mode(),
     )(dy2, z2, bits2, gamma.reshape(1, d), mean, inv)

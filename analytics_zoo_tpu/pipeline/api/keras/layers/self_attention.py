@@ -15,6 +15,7 @@ annotated with logical axes so ``parallel.sharding`` can lay them out over a
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import Optional
 
@@ -37,6 +38,66 @@ def _dropout(x, p, rng, training):
     keep = 1.0 - p
     mask = jax.random.bernoulli(rng, keep, x.shape)
     return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+def _dp_dropout_add_ln(x, resid, gamma, beta, rng, p_drop, training):
+    """dropout_add_layer_norm, entered through a pure-dp shard_map when
+    one is needed for the kernel to engage (see _dp_mesh). The key is
+    folded with the shard index so dropout masks decorrelate across
+    data shards."""
+    dp = _dp_mesh(x.shape[0])
+    if dp is None or not training or rng is None or p_drop <= 0.0:
+        return dropout_add_layer_norm(x, resid, gamma, beta, rng,
+                                      p_drop, training)
+    from jax.sharding import PartitionSpec as P
+    px = P("data", None, None)
+    pv = P(None)
+
+    def body(x_, r_, g_, b_, key_):
+        key_ = jax.random.fold_in(key_, jax.lax.axis_index("data"))
+        return dropout_add_layer_norm(x_, r_, g_, b_, key_, p_drop,
+                                      training)
+
+    # check_vma=False: pallas interpret mode cannot trace under the vma
+    # checker (jax's own error suggests this flag), and the region is a
+    # single elementwise+rowwise op — gradient correctness of the wrap
+    # (incl. the replicated gamma/beta psum on transpose) is pinned by
+    # test_dp_wrap_grad_parity on the 8-device mesh
+    return jax.shard_map(body, mesh=dp, in_specs=(px, px, pv, pv, P()),
+                         out_specs=px, check_vma=False)(
+        x, resid, gamma, beta, rng)
+
+
+def _dp_mesh(batch):
+    """The active mesh when kernels need a shard_map to engage: pure
+    data parallelism (>1 devices, every other axis 1), batch divisible,
+    and not already inside a shard_map. Mosaic custom calls cannot be
+    auto-partitioned (ops/attention.py mosaic_partition_ok), so under a
+    dp>1 mesh the layer enters a fully-manual shard_map at its kernel
+    sites itself — batch-parallel attention and dropout+add+LN are
+    embarrassingly parallel, so the wrap is spec-exact (no resharding)
+    and the XLA fallback inside computes identically when the kernels
+    stay ineligible. Mixed layouts (tp/pp/sp/ep) are handled by their
+    own shard_map paths or the XLA fallback."""
+    from .....common import nncontext as _nn
+    ctx = _nn._global_context
+    if ctx is None:
+        return None
+    sizes = dict(ctx.mesh.shape)
+    dp = int(sizes.get("data", 1))
+    if dp <= 1 or any(int(v) > 1 for k, v in sizes.items()
+                      if k != "data"):
+        return None
+    if batch % dp != 0:
+        return None
+    try:
+        from jax._src import mesh as _jmesh
+        if tuple(getattr(_jmesh.get_abstract_mesh(), "axis_names",
+                         ()) or ()):
+            return None          # already inside a shard_map
+    except Exception:  # noqa: BLE001 - private API moved; don't wrap
+        return None
+    return ctx.mesh
 
 
 class TransformerLayer(KerasLayer):
@@ -266,10 +327,31 @@ class TransformerLayer(KerasLayer):
             # blhd section; falls back to the transposed path when the
             # kernel is ineligible, where XLA folds the transposes into
             # its dots anyway)
-            o = flash_attention_blhd(
-                q.reshape(b, l, nh, d), k.reshape(b, l, nh, d),
-                v.reshape(b, l, nh, d), bias=mask_bias,
-                causal=not self.bidirectional)
+            q4, k4, v4 = (t.reshape(b, l, nh, d) for t in (q, k, v))
+            attn = functools.partial(flash_attention_blhd,
+                                     causal=not self.bidirectional)
+            dp = _dp_mesh(b)
+            if dp is None:
+                o = attn(q4, k4, v4, bias=mask_bias)
+            else:
+                from jax.sharding import PartitionSpec as P
+                p4 = P("data", None, None, None)
+                # check_vma=False: see _dp_dropout_add_ln
+                operands = [q4, k4, v4]
+                in_specs = [p4, p4, p4]
+                if mask_bias is not None:
+                    operands.append(mask_bias)
+                    in_specs.append(
+                        P("data", *([None] * (mask_bias.ndim - 1)))
+                        if mask_bias.shape[0] == b else
+                        P(*([None] * mask_bias.ndim)))
+
+                def body(q_, k_, v_, bias_=None):
+                    return attn(q_, k_, v_, bias=bias_)
+
+                o = jax.shard_map(
+                    body, mesh=dp, in_specs=tuple(in_specs),
+                    out_specs=p4, check_vma=False)(*operands)
         o = o.reshape(b, l, h)
         if rng is not None:
             rng, sub = jax.random.split(rng)
@@ -288,8 +370,8 @@ class TransformerLayer(KerasLayer):
         if rng is not None:
             r1, r2, r3 = jax.random.split(rng, 3)
         a = self._attention(p, x, mask_bias, r1, training)
-        n = dropout_add_layer_norm(a, x, p["ln1_g"], p["ln1_b"], r2,
-                                   self.hidden_p_drop, training)
+        n = _dp_dropout_add_ln(a, x, p["ln1_g"], p["ln1_b"], r2,
+                               self.hidden_p_drop, training)
         if self.moe_experts:
             m = self._moe.call(p["moe"], n, training=training)
         else:
@@ -298,8 +380,8 @@ class TransformerLayer(KerasLayer):
             m = self._gelu(m)
             m = jnp.matmul(m, p["mlp_out_w"].astype(x.dtype)) + \
                 p["mlp_out_b"].astype(x.dtype)
-        return dropout_add_layer_norm(m, n, p["ln2_g"], p["ln2_b"], r3,
-                                      self.hidden_p_drop, training)
+        return _dp_dropout_add_ln(m, n, p["ln2_g"], p["ln2_b"], r3,
+                                  self.hidden_p_drop, training)
 
     def _embed(self, params, inputs, rng, training):
         if self.embedding_layer is not None:

@@ -569,6 +569,77 @@ def test_fused_dropout_ln_fallbacks(monkeypatch):
         np.asarray(layer_norm(dropped + res, g, b, 1e-5)))
 
 
+def test_dp_wrap_grad_parity(monkeypatch):
+    """The layer's pure-dp shard_map wraps (check_vma=False) must be
+    AD-transparent: outputs and every cotangent — including the
+    replicated gamma/beta, whose transpose must psum across shards —
+    equal the unwrapped composition. Runs the CPU fallback inside the
+    wrap (no interpret), so this pins the wrap machinery itself."""
+    from analytics_zoo_tpu.common.nncontext import (ZooConfig, ZooContext,
+                                                    set_nncontext)
+    import analytics_zoo_tpu.pipeline.api.keras.layers.self_attention \
+        as SA
+    from analytics_zoo_tpu.ops.fused_dropout_ln import \
+        dropout_add_layer_norm
+
+    monkeypatch.delenv("ZOO_TPU_PALLAS_INTERPRET", raising=False)
+    monkeypatch.delenv("ZOO_TPU_FORCE_PALLAS", raising=False)
+    rng = np.random.default_rng(11)
+    b, l, dmod = 16, 8, 32
+    x = jnp.asarray(rng.standard_normal((b, l, dmod)), jnp.float32)
+    res = jnp.asarray(rng.standard_normal((b, l, dmod)), jnp.float32)
+    g = jnp.asarray(rng.standard_normal(dmod), jnp.float32)
+    bb = jnp.asarray(rng.standard_normal(dmod), jnp.float32)
+    key = jax.random.key(5)
+
+    set_nncontext(ZooContext(ZooConfig(data_parallel=8)))
+    try:
+        assert SA._dp_mesh(b) is not None
+
+        def loss_wrapped(x, res, g, bb):
+            return (SA._dp_dropout_add_ln(
+                x, res, g, bb, key, 0.25,
+                True).astype(jnp.float32) ** 2).mean()
+
+        # reference: the wrap folds the shard index into the key, so
+        # rebuild the exact per-shard composition without shard_map
+        def loss_ref(x, res, g, bb):
+            shards = []
+            for s in range(8):
+                ks = jax.random.fold_in(key, s)
+                shards.append(dropout_add_layer_norm(
+                    x[s * 2:(s + 1) * 2], res[s * 2:(s + 1) * 2], g, bb,
+                    ks, 0.25, True))
+            return (jnp.concatenate(shards).astype(jnp.float32)
+                    ** 2).mean()
+
+        vw = jax.jit(loss_wrapped)(x, res, g, bb)
+        vr = loss_ref(x, res, g, bb)
+        np.testing.assert_allclose(float(vw), float(vr), rtol=1e-6)
+        gw = jax.jit(jax.grad(loss_wrapped,
+                              argnums=(0, 1, 2, 3)))(x, res, g, bb)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(x, res, g, bb)
+        for a, e in zip(gw, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                       rtol=2e-5, atol=2e-5)
+
+        # attention wrap: deterministic (no dropout) — the wrapped layer
+        # forward must equal the same layer with no mesh context
+        tl = SA.TransformerLayer(vocab=50, hidden_size=32, n_head=2,
+                                 seq_len=l, n_block=1,
+                                 intermediate_size=64)
+        params = tl.build(jax.random.PRNGKey(0), [(None, l), (None, 1, 1, l)])
+        tokens = rng.integers(0, 50, (b, l)).astype(np.int32)
+        mask = np.ones((b, 1, 1, l), np.float32)
+        out_dp = tl.call(params, [tokens, mask], training=False)
+    finally:
+        set_nncontext(None)
+    out_plain = tl.call(params, [tokens, mask], training=False)
+    for a, e in zip(jax.tree.leaves(out_dp), jax.tree.leaves(out_plain)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                   rtol=2e-5, atol=2e-5)
+
+
 def test_mosaic_partition_guard(monkeypatch):
     """Mosaic custom calls raise under a multi-device jit unless ALL
     mesh axes are manual (jax._src.tpu_custom_call) — the probe can't
