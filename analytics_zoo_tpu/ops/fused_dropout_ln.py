@@ -221,6 +221,21 @@ def _kernel_ok(n, d, dtype, keep, block_rows) -> bool:
     return _DLN_OK[key]
 
 
+def dln_kernel_status() -> str:
+    """Probe-cache summary for measurement harnesses: "interpret" /
+    "unprobed" (kernel never eligible this process) / "ok" / "partial" /
+    "failed" — so a bench record can say whether the fused kernel
+    actually ran instead of leaving a silent fallback ambiguous."""
+    if _interpret_mode():
+        return "interpret"
+    if not _DLN_OK:
+        return "unprobed"
+    oks = list(_DLN_OK.values())
+    if all(oks):
+        return "ok"
+    return "partial" if any(oks) else "failed"
+
+
 def dropout_add_layer_norm(x, resid, gamma, beta, rng, p_drop,
                            training=True, eps=1e-5):
     """``layer_norm(dropout(x, p_drop) + resid)`` in one fused pass.

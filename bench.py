@@ -373,6 +373,7 @@ def _bench_bert_mfu_at(peak_flops, bert_batch, seq_len=BERT_SEQ):
     # the record must say so (the probe's fallback is otherwise a log
     # line nobody re-reads)
     from analytics_zoo_tpu.ops.attention import kernel_layouts_ok
+    from analytics_zoo_tpu.ops.fused_dropout_ln import dln_kernel_status
     layouts = kernel_layouts_ok(b=bert_batch, h=BERT_HEADS, lq=seq_len,
                                 lk=seq_len, d=BERT_H // BERT_HEADS)
     return {
@@ -384,6 +385,7 @@ def _bench_bert_mfu_at(peak_flops, bert_batch, seq_len=BERT_SEQ):
         "bert_mfu": (round(achieved / peak_flops, 4)
                      if peak_flops else None),
         "bert_kernel_layouts_ok": layouts,
+        "bert_dln_kernel": dln_kernel_status(),
     }
 
 
