@@ -573,13 +573,27 @@ def leg_bert_routing():
     for arm, extra in (("kernel-blhd", {"ZOO_TPU_KERNEL_MIN_SEQ": "512",
                                         "ZOO_TPU_DISABLE_PALLAS": "0",
                                         "ZOO_TPU_FORCE_PALLAS": "0",
-                                        "ZOO_TPU_ATTN_LAYOUT": "blhd"}),
+                                        "ZOO_TPU_ATTN_LAYOUT": "blhd",
+                                        "ZOO_TPU_DISABLE_FUSED_DLN": "0"}),
                        ("kernel-bhld", {"ZOO_TPU_KERNEL_MIN_SEQ": "512",
                                         "ZOO_TPU_DISABLE_PALLAS": "0",
                                         "ZOO_TPU_FORCE_PALLAS": "0",
-                                        "ZOO_TPU_ATTN_LAYOUT": "bhld"}),
+                                        "ZOO_TPU_ATTN_LAYOUT": "bhld",
+                                        "ZOO_TPU_DISABLE_FUSED_DLN": "0"}),
+                       # attributes the fused dropout+add+LN kernel
+                       # alone: same attention routing as the first arm,
+                       # composed-XLA residual sites — if Mosaic accepts
+                       # the dln kernel but it loses to XLA's fusion,
+                       # this is the arm that says so
+                       ("kernel-blhd-nodln",
+                        {"ZOO_TPU_KERNEL_MIN_SEQ": "512",
+                         "ZOO_TPU_DISABLE_PALLAS": "0",
+                         "ZOO_TPU_FORCE_PALLAS": "0",
+                         "ZOO_TPU_ATTN_LAYOUT": "blhd",
+                         "ZOO_TPU_DISABLE_FUSED_DLN": "1"}),
                        ("xla", {"ZOO_TPU_DISABLE_PALLAS": "1",
-                                "ZOO_TPU_FORCE_PALLAS": "0"})):
+                                "ZOO_TPU_FORCE_PALLAS": "0",
+                                "ZOO_TPU_DISABLE_FUSED_DLN": "0"})):
         env = dict(os.environ, ZOO_BENCH_BUDGET_S="100000", **extra)
         t0 = time.time()
         payload = {"arm": arm}
