@@ -288,6 +288,26 @@ def leg_attn():
             finally:
                 os.environ.pop("ZOO_TPU_FORCE_PALLAS", None)
                 os.environ.pop("ZOO_TPU_DISABLE_PALLAS", None)
+        # blhd arm (r5): same math from the (B, L, H, d) entry — the
+        # delta vs kernel_ms is the standalone cost of the relayout
+        # copies the bhld custom calls force
+        try:
+            os.environ["ZOO_TPU_FORCE_PALLAS"] = "1"
+            q4 = q.transpose(0, 2, 1, 3)
+
+            def step4(q4):
+                def l2(q4):
+                    return (A.flash_attention_blhd(
+                        q4, q4, q4, bias=bias).astype(jnp.float32)
+                        ** 2).mean()
+                return jax.grad(l2)(q4)
+
+            row["blhd_ms"] = round(
+                _time_fn(jax.jit(step4), q4) * 1e3, 2)
+        except Exception as e:  # noqa: BLE001
+            row["blhd_err"] = str(e).splitlines()[0][:200]
+        finally:
+            os.environ.pop("ZOO_TPU_FORCE_PALLAS", None)
         results.append(row)
         emit("attn", row)
     emit("attn_summary", {"rows": results})
