@@ -383,6 +383,8 @@ def _resnet_step_times(data_format, batch=128, with_extras=False):
         times.append(time.perf_counter() - t0)
     step_ms = sorted(times)[len(times) // 2] * 1e3
     emit("resnet_profile", {"fmt": data_format, "what": "train_step_ms",
+                            "note": "single-dispatch wall incl. tunnel"
+                                    " RTT",
                             "ms": round(step_ms, 2),
                             "mfu_197T": round(3 * 2 * 4.09e9 * batch /
                                               (step_ms / 1e3) / 197e12, 3)})
@@ -536,7 +538,10 @@ def leg_bert_profile():
         times.append(time.perf_counter() - t0)
     step_ms = sorted(times)[len(times) // 2] * 1e3
     emit("bert_profile", {"what": "train_step_ms",
-                          "ms": round(step_ms, 2)})
+                          "ms": round(step_ms, 2),
+                          "note": "single-dispatch wall incl. tunnel "
+                                  "RTT; bench legs reflect device "
+                                  "cadence"})
     trace_dir = os.path.join(os.path.dirname(OUT), "bert_trace")
     try:
         with jax.profiler.trace(trace_dir):
