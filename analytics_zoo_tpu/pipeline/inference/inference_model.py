@@ -29,6 +29,7 @@ import os
 import queue
 import threading
 import time
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -37,12 +38,28 @@ import numpy as np
 
 from ...ops import quant
 
+#: default cap on per-signature AOT executables kept per model (LRU);
+#: override per-model via ``cache_cap`` / ``InferenceModel(
+#: max_cached_signatures=...)`` or process-wide via the env var.
+DEFAULT_CACHE_CAP = int(os.environ.get("ZOO_AOT_CACHE_CAP", "64"))
+
 
 class AbstractModel:
     """One loaded backend: ``predict(inputs) -> outputs`` on host numpy."""
 
     def predict(self, inputs):
         raise NotImplementedError
+
+    def predict_async(self, inputs):
+        """Dispatch without forcing a host transfer of the outputs.
+
+        Backends that can dispatch asynchronously (XLA) return device
+        arrays; the caller materializes them later (``np.asarray``),
+        which is the synchronization point.  The default is the
+        synchronous path — foreign runtimes (TF/Torch/ONNX importers)
+        already block inside ``predict``.
+        """
+        return self.predict(inputs)
 
     def release(self):
         pass
@@ -55,9 +72,12 @@ class FloatModel(AbstractModel):
     Parity: ``FloatModel`` (InferenceModelFactory path for BigDL models).
     """
 
-    def __init__(self, model, compute_dtype: Optional[str] = None):
+    def __init__(self, model, compute_dtype: Optional[str] = None,
+                 cache_cap: Optional[int] = None):
         self.model = model
         self.compute_dtype = compute_dtype
+        self.cache_cap = cache_cap if cache_cap is not None \
+            else DEFAULT_CACHE_CAP
         graph = model.graph_function()
         self._graph = graph
         params, state = model._params_tuple() \
@@ -78,28 +98,48 @@ class FloatModel(AbstractModel):
             return out
 
         self._fwd = fwd
-        self._compiled: Dict[Tuple, Any] = {}
+        # per-signature AOT executables, LRU-bounded at ``cache_cap``:
+        # serving traffic with unbounded input shapes must not grow the
+        # executable cache (and its device buffers) without limit
+        self._compiled: "OrderedDict[Tuple, Any]" = OrderedDict()
         self._lock = threading.Lock()
 
     def _signature(self, inputs):
         return tuple((tuple(x.shape), str(x.dtype)) for x in inputs)
 
-    def predict(self, inputs):
-        inputs = [np.asarray(x) for x in (
-            inputs if isinstance(inputs, (list, tuple)) else [inputs])]
+    def _lookup(self, inputs):
+        """Executable for this input signature, compiling on miss; LRU
+        bookkeeping and eviction happen under the compile lock."""
         sig = self._signature(inputs)
-        fn = self._compiled.get(sig)
-        if fn is None:
-            with self._lock:
-                fn = self._compiled.get(sig)
-                if fn is None:
-                    # AOT compile for this signature (XLA serving
-                    # executable; replaces the OpenVINO IR compile step)
-                    fn = jax.jit(self._fwd).lower(
-                        self._params, self._state, *inputs).compile()
-                    self._compiled[sig] = fn
-        out = fn(self._params, self._state, *inputs)
-        return jax.tree.map(np.asarray, out)
+        with self._lock:
+            fn = self._compiled.get(sig)
+            if fn is not None:
+                self._compiled.move_to_end(sig)
+                return fn
+            # AOT compile for this signature (XLA serving executable;
+            # replaces the OpenVINO IR compile step)
+            fn = jax.jit(self._fwd).lower(
+                self._params, self._state, *inputs).compile()
+            self._compiled[sig] = fn
+            while len(self._compiled) > max(self.cache_cap, 1):
+                self._compiled.popitem(last=False)
+            return fn
+
+    @staticmethod
+    def _as_input_list(inputs):
+        return [np.asarray(x) for x in (
+            inputs if isinstance(inputs, (list, tuple)) else [inputs])]
+
+    def predict(self, inputs):
+        return jax.tree.map(np.asarray, self.predict_async(inputs))
+
+    def predict_async(self, inputs):
+        """Dispatch the AOT executable and return device arrays without
+        blocking on the host transfer — the serving pipeline submits
+        batch *k+1* while the writer stage drains batch *k*."""
+        inputs = self._as_input_list(inputs)
+        fn = self._lookup(inputs)
+        return fn(self._params, self._state, *inputs)
 
 
 class QuantizedModel(FloatModel):
@@ -222,10 +262,14 @@ class InferenceModel:
 
     ``supported_concurrent_num``: number of concurrent predicts admitted
     (the reference's model-copy count, InferenceModel.scala:30,67).
+    ``max_cached_signatures``: LRU cap on per-signature AOT executables
+    (None keeps the model default, ``DEFAULT_CACHE_CAP``).
     """
 
-    def __init__(self, supported_concurrent_num: int = 1):
+    def __init__(self, supported_concurrent_num: int = 1,
+                 max_cached_signatures: Optional[int] = None):
         self.supported_concurrent_num = int(supported_concurrent_num)
+        self.max_cached_signatures = max_cached_signatures
         self.model: Optional[AbstractModel] = None
         self._permits: "queue.Queue" = queue.Queue()
         self._autoscale = self.supported_concurrent_num <= 0
@@ -236,6 +280,9 @@ class InferenceModel:
     # loaders (doLoad* parity)
     # ------------------------------------------------------------------
     def _install(self, model: AbstractModel):
+        if self.max_cached_signatures is not None and \
+                hasattr(model, "cache_cap"):
+            model.cache_cap = int(self.max_cached_signatures)
         self.model = model
         self._permits = queue.Queue()
         n = max(self.supported_concurrent_num, 1)
@@ -364,6 +411,19 @@ class InferenceModel:
             self._permits.put(permit)
 
     do_predict = predict
+
+    def predict_async(self, inputs):
+        """Permit-guarded async dispatch: returns device arrays (or the
+        backend's native output for non-XLA backends).  The permit is
+        released at dispatch; the host transfer (``np.asarray``) is the
+        caller's synchronization point."""
+        if self.model is None:
+            raise RuntimeError("no model loaded; call load*() first")
+        permit = self._acquire()
+        try:
+            return self.model.predict_async(inputs)
+        finally:
+            self._permits.put(permit)
 
     def release(self):
         if self.model is not None:

@@ -3,6 +3,13 @@
 Parity: ``zoo/.../pipeline/inference/InferenceSummary.scala:46`` (wired by
 ``ClusterServing.scala:96-97``) — TensorBoard scalars via the event-writer
 in ``utils.tensorboard``.
+
+Pipeline extension: the serving engine is a three-stage pipeline
+(decode -> compute -> write), so the summary now tracks *per-stage*
+latency reservoirs with p50/p95/p99, plus queue depths, in addition to
+the original per-batch Throughput/LatencyMs scalars.  A summary built
+with ``log_dir=None`` keeps the in-memory statistics without writing
+TensorBoard events (the serving bench and smoke entry use this).
 """
 
 from __future__ import annotations
@@ -10,16 +17,76 @@ from __future__ import annotations
 import os
 import threading
 import time
+from collections import deque
+from typing import Dict, Optional, Sequence
 
-from ...utils import tensorboard
+
+class LatencyStats:
+    """Bounded reservoir of recent latencies with percentile queries.
+
+    Keeps the last ``maxlen`` observations (seconds) in a ring buffer so
+    a long-running serving loop reports *recent* tail latency, not the
+    all-time distribution.  Thread-safe: stages record concurrently.
+    """
+
+    def __init__(self, maxlen: int = 4096):
+        self._buf: deque = deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+        self.count = 0          # total observations (not capped)
+        self.total = 0.0        # running sum of all observations
+
+    def record(self, latency_s: float):
+        with self._lock:
+            self._buf.append(float(latency_s))
+            self.count += 1
+            self.total += float(latency_s)
+
+    def percentile(self, pct: float) -> float:
+        """Linear-interpolated percentile (numpy 'linear' method) over
+        the current reservoir, in seconds.  0.0 when empty."""
+        with self._lock:
+            data = sorted(self._buf)
+        if not data:
+            return 0.0
+        if len(data) == 1:
+            return data[0]
+        rank = (pct / 100.0) * (len(data) - 1)
+        lo = int(rank)
+        hi = min(lo + 1, len(data) - 1)
+        frac = rank - lo
+        return data[lo] * (1.0 - frac) + data[hi] * frac
+
+    def percentiles(self, pcts: Sequence[float] = (50, 95, 99)
+                    ) -> Dict[str, float]:
+        """{'p50': ..., 'p95': ..., 'p99': ...} in **milliseconds**."""
+        return {f"p{int(p) if float(p).is_integer() else p}":
+                self.percentile(p) * 1e3 for p in pcts}
+
+    def mean(self) -> float:
+        with self._lock:
+            return self.total / self.count if self.count else 0.0
 
 
 class InferenceSummary:
-    def __init__(self, log_dir: str, app_name: str):
-        self.writer = tensorboard.FileWriter(
-            os.path.join(log_dir, app_name, "inference"))
+    """Scalars + per-stage latency reservoirs.
+
+    ``log_dir=None`` builds a stats-only summary (no event files) — the
+    pipelined serving loop always keeps one so queue overlap is
+    observable even when TensorBoard logging is off.
+    """
+
+    def __init__(self, log_dir: Optional[str] = None,
+                 app_name: str = "serving"):
+        self.writer = None
+        if log_dir is not None:
+            from ...utils import tensorboard
+
+            self.writer = tensorboard.FileWriter(
+                os.path.join(log_dir, app_name, "inference"))
         self._step = 0
         self._lock = threading.Lock()
+        self._stages: Dict[str, LatencyStats] = {}
+        self._queue_depths: Dict[str, int] = {}
 
     def _next_step(self) -> int:
         # serving predicts run concurrently (permits > 1); the step
@@ -37,16 +104,74 @@ class InferenceSummary:
             # steps for one tag (ADVICE r3 #5)
             with self._lock:
                 self._step = max(self._step, step)
-        self.writer.add_scalar(tag, value, step)
+        if self.writer is not None:
+            self.writer.add_scalar(tag, value, step)
 
     def record_batch(self, batch_size: int, latency_s: float):
         step = self._next_step()
-        self.writer.add_scalar("Throughput",
-                               batch_size / max(latency_s, 1e-9), step)
-        self.writer.add_scalar("LatencyMs", latency_s * 1e3, step)
+        if self.writer is not None:
+            self.writer.add_scalar("Throughput",
+                                   batch_size / max(latency_s, 1e-9), step)
+            self.writer.add_scalar("LatencyMs", latency_s * 1e3, step)
+        self._stage("predict").record(latency_s)
+
+    # -- pipeline stages ----------------------------------------------
+    def _stage(self, stage: str) -> LatencyStats:
+        with self._lock:
+            st = self._stages.get(stage)
+            if st is None:
+                st = self._stages[stage] = LatencyStats()
+            return st
+
+    def record_stage(self, stage: str, latency_s: float,
+                     batch_size: Optional[int] = None):
+        """One observation for a pipeline stage ('decode', 'compute',
+        'write', 'e2e', ...); ``batch_size`` also emits a per-stage
+        throughput scalar."""
+        self._stage(stage).record(latency_s)
+        if self.writer is not None:
+            step = self._next_step()
+            self.writer.add_scalar(f"{stage}/LatencyMs", latency_s * 1e3,
+                                   step)
+            if batch_size:
+                self.writer.add_scalar(
+                    f"{stage}/Throughput",
+                    batch_size / max(latency_s, 1e-9), step)
+
+    def record_queue_depth(self, name: str, depth: int):
+        with self._lock:
+            self._queue_depths[name] = int(depth)
+        if self.writer is not None:
+            self.add_scalar(f"Queue/{name}", depth)
+
+    def stage_percentiles(self, stage: str,
+                          pcts: Sequence[float] = (50, 95, 99)
+                          ) -> Dict[str, float]:
+        """Percentiles (ms) for one stage; zeros when unobserved."""
+        return self._stage(stage).percentiles(pcts)
+
+    def stage_count(self, stage: str) -> int:
+        return self._stage(stage).count
+
+    def snapshot(self) -> dict:
+        """Everything at once: per-stage {count, mean_ms, p50/p95/p99}
+        plus the latest queue depths — the observability payload for the
+        bench leg and the smoke entry."""
+        with self._lock:
+            stages = dict(self._stages)
+            depths = dict(self._queue_depths)
+        out = {"queues": depths, "stages": {}}
+        for name, st in stages.items():
+            entry = {"count": st.count,
+                     "mean_ms": round(st.mean() * 1e3, 3)}
+            entry.update({k: round(v, 3)
+                          for k, v in st.percentiles().items()})
+            out["stages"][name] = entry
+        return out
 
     def close(self):
-        self.writer.close()
+        if self.writer is not None:
+            self.writer.close()
 
 
 class Timer:

@@ -5,10 +5,12 @@ Parity: ``zoo/.../serving/ClusterServing.scala`` + client
 """
 
 from .client import API, InputQueue, OutputQueue
-from .cluster_serving import ClusterServing, ClusterServingHelper
+from .cluster_serving import (ClusterServing, ClusterServingHelper,
+                              pick_bucket, power_of_two_buckets)
 from .queue_backend import (FileStreamQueue, InProcessStreamQueue,
                             StreamQueue, get_queue_backend)
 
 __all__ = ["InputQueue", "OutputQueue", "API", "ClusterServing",
            "ClusterServingHelper", "StreamQueue", "InProcessStreamQueue",
-           "FileStreamQueue", "get_queue_backend"]
+           "FileStreamQueue", "get_queue_backend", "pick_bucket",
+           "power_of_two_buckets"]

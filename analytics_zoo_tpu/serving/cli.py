@@ -15,6 +15,7 @@ Usage::
 
     python -m analytics_zoo_tpu.serving.cli init   [--dir DIR]
     python -m analytics_zoo_tpu.serving.cli start  [--dir DIR] [--foreground]
+                                                   [--warmup]
     python -m analytics_zoo_tpu.serving.cli status [--dir DIR]
     python -m analytics_zoo_tpu.serving.cli stop   [--dir DIR]
     python -m analytics_zoo_tpu.serving.cli restart [--dir DIR]
@@ -51,6 +52,12 @@ params:
   batch_size: 32
   top_n: 5
   stream_maxlen: 10000
+  ## pipelined serving engine (docs/serving-pipeline.md):
+  # pipelined: true          # false = single-thread baseline loop
+  # decode_workers: 2        # threads decoding records alongside compute
+  # queue_depth: 64          # bound on each inter-stage queue
+  # bucket_sizes: 1,2,4,8,16,32   # padding buckets (default: powers of 2)
+  # warmup: false            # pre-compile all buckets before serving
 """
 
 
@@ -87,7 +94,7 @@ def cmd_init(workdir: str) -> int:
     return 0
 
 
-def _serve(cfg: str):
+def _serve(cfg: str, warmup: bool = False):
     # honor JAX_PLATFORMS even when a TPU plugin is registered (the env
     # var alone is ignored then; the config update is authoritative)
     plat = os.environ.get("JAX_PLATFORMS")
@@ -100,6 +107,16 @@ def _serve(cfg: str):
     from .cluster_serving import ClusterServing
 
     serving = ClusterServing(config_path=cfg)
+    if warmup or serving.helper.warmup:
+        # pre-compile every padding-bucket signature before the loop
+        # accepts traffic; per-bucket compile time goes to the log
+        t0 = time.time()
+        times = serving.warmup()
+        for bucket in sorted(times):
+            print(f"warmup: bucket {bucket} compiled in "
+                  f"{times[bucket]:.3f}s", flush=True)
+        print(f"warmup: {len(times)}/{len(serving.buckets)} buckets in "
+              f"{time.time() - t0:.3f}s", flush=True)
 
     def _term(_sig, _frm):
         serving._stop.set()
@@ -109,7 +126,8 @@ def _serve(cfg: str):
     serving.serve_forever()
 
 
-def cmd_start(workdir: str, foreground: bool = False) -> int:
+def cmd_start(workdir: str, foreground: bool = False,
+              warmup: bool = False) -> int:
     cfg, pidfile, logfile = _paths(workdir)
     if not os.path.exists(cfg):
         print(f"no {cfg}; run `cluster-serving-init` first",
@@ -119,7 +137,7 @@ def cmd_start(workdir: str, foreground: bool = False) -> int:
         print("Serving is already running!", file=sys.stderr)
         return 1
     if foreground:
-        _serve(cfg)
+        _serve(cfg, warmup=warmup)
         return 0
     # double-fork daemonization, pidfile written by the grandchild
     pid = os.fork()
@@ -143,7 +161,7 @@ def cmd_start(workdir: str, foreground: bool = False) -> int:
     with open(pidfile, "w") as f:
         f.write(str(os.getpid()))
     try:
-        _serve(cfg)
+        _serve(cfg, warmup=warmup)
     finally:
         try:
             os.remove(pidfile)
@@ -218,12 +236,16 @@ def main(argv=None) -> int:
     ap.add_argument("--dir", default=".", help="serving working directory")
     ap.add_argument("--foreground", action="store_true",
                     help="start: run in the foreground (containers)")
+    ap.add_argument("--warmup", action="store_true",
+                    help="start: pre-compile all padding buckets before "
+                         "accepting traffic (logs compile time per bucket)")
     args = ap.parse_args(argv)
     workdir = os.path.abspath(args.dir)
     if args.command == "init":
         return cmd_init(workdir)
     if args.command == "start":
-        return cmd_start(workdir, foreground=args.foreground)
+        return cmd_start(workdir, foreground=args.foreground,
+                         warmup=args.warmup)
     if args.command == "status":
         return cmd_status(workdir)
     if args.command == "stop":

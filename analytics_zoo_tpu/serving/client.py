@@ -10,7 +10,8 @@ from __future__ import annotations
 
 import base64
 import json
-from typing import Optional
+import time
+from typing import Dict, Iterable, Optional
 
 import numpy as np
 
@@ -65,6 +66,22 @@ class OutputQueue(API):
         """Result for one uri or None (client.py:142)."""
         v = self.db.get_result(uri, pop=False)
         return self._decode(v) if v is not None else None
+
+    def wait_all(self, uris: Iterable[str], timeout: float = 30.0,
+                 poll: float = 0.01) -> Dict[str, np.ndarray]:
+        """Poll until every uri has a result (popping as they land) or
+        the deadline passes; returns whatever arrived.  The bench leg,
+        smoke entry, and pipeline tests all need exactly this loop."""
+        want = set(uris)
+        got: Dict[str, np.ndarray] = {}
+        deadline = time.time() + timeout
+        while want and time.time() < deadline:
+            for uri, v in self.db.all_results(pop=True).items():
+                got[uri] = self._decode(v)
+                want.discard(uri)
+            if want:
+                time.sleep(poll)
+        return got
 
     @staticmethod
     def _decode(value: bytes):

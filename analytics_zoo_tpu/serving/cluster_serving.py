@@ -7,11 +7,30 @@ watermark trim (:130-136); config comes from ``config.yaml``
 (``ClusterServingHelper.initArgs``, serving/utils/ClusterServingHelper.scala
 :104) and throughput/latency land in the InferenceSummary (:96-97).
 
-TPU redesign: Spark Structured Streaming becomes a host thread that drains
-the queue into fixed-size batches (padding the tail) so the AOT-compiled
-XLA executable runs at a single batch signature; the BLAS/DNN dual path
-(:158-230) collapses into one batched path because batching is always the
-right call for the MXU.
+TPU redesign: Spark Structured Streaming becomes a host-driven pipeline
+feeding AOT-compiled XLA executables.  The hot path is three overlapped
+stages connected by bounded queues (backpressure propagates to the
+stream read):
+
+1. **decode** — a pool of ``decode_workers`` threads pulls records off
+   the :class:`StreamQueue` and produces ready tensors concurrently with
+   compute (base64/cv2 decode is host work the accelerator should never
+   wait on);
+2. **compute** — a single thread assembles ready tensors into
+   power-of-two **padding buckets** (each bucket is its own AOT
+   signature in :class:`InferenceModel`, pre-compiled by
+   :meth:`ClusterServing.warmup`), so a half-full batch no longer pays
+   full-batch MXU time, and dispatches **asynchronously** — batch *k+1*
+   is submitted before batch *k*'s host transfer completes;
+3. **write** — a thread drains predictions (the ``np.asarray`` host
+   transfer is its synchronization point) and commits results to the
+   queue backend.
+
+The original single-thread loop survives as ``pipelined=False`` (config
+``params.pipelined``) and is the baseline the ``bench.py`` serving leg
+and the slow comparison test measure against.  Per-stage latency
+percentiles, queue depths, and bucket usage are recorded in
+:class:`InferenceSummary` so the overlap is observable.
 """
 
 from __future__ import annotations
@@ -19,9 +38,11 @@ from __future__ import annotations
 import base64
 import json
 import logging
+import queue
 import threading
 import time
-from typing import Optional
+from collections import Counter
+from typing import List, Optional, Sequence
 
 import numpy as np
 
@@ -30,6 +51,38 @@ from ..pipeline.inference.inference_summary import InferenceSummary
 from .queue_backend import StreamQueue, get_queue_backend
 
 logger = logging.getLogger("analytics_zoo_tpu.serving")
+
+#: shutdown marker passed through the stage queues
+_SENTINEL = object()
+
+
+def power_of_two_buckets(batch_size: int) -> List[int]:
+    """Padding buckets 1, 2, 4, ... capped by (and always including)
+    ``batch_size`` — each bucket is one AOT signature."""
+    batch_size = max(int(batch_size), 1)
+    buckets, b = [], 1
+    while b < batch_size:
+        buckets.append(b)
+        b *= 2
+    buckets.append(batch_size)
+    return sorted(set(buckets))
+
+
+def pick_bucket(n: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket >= n (``buckets`` sorted ascending); the largest
+    bucket when n exceeds them all (callers chunk at batch_size)."""
+    for b in buckets:
+        if b >= n:
+            return b
+    return buckets[-1]
+
+
+def _parse_bool(value, default: bool) -> bool:
+    if value is None:
+        return default
+    if isinstance(value, str):
+        return value.strip().lower() in ("1", "true", "yes", "on")
+    return bool(value)
 
 
 class ClusterServingHelper:
@@ -55,6 +108,16 @@ class ClusterServingHelper:
         self.top_n = int(params.get("top_n") or 1)
         # watermark: trim stream when it exceeds maxlen (60%*80% parity)
         self.stream_maxlen = int(params.get("stream_maxlen") or 10000)
+        # -- pipeline knobs (docs/serving-pipeline.md) ------------------
+        self.pipelined = _parse_bool(params.get("pipelined"), True)
+        self.decode_workers = int(params.get("decode_workers") or 2)
+        self.queue_depth = int(params.get("queue_depth") or
+                               max(2 * self.batch_size, 16))
+        raw = params.get("bucket_sizes")
+        if isinstance(raw, str):
+            raw = [int(s) for s in raw.split(",") if s.strip()]
+        self.bucket_sizes = sorted({int(b) for b in raw}) if raw else None
+        self.warmup = _parse_bool(params.get("warmup"), False)
 
     def load_inference_model(self, concurrent_num: int = 1) -> InferenceModel:
         model = InferenceModel(supported_concurrent_num=concurrent_num)
@@ -76,8 +139,26 @@ class ClusterServing:
         self.model = model or self.helper.load_inference_model()
         self.db = backend if backend is not None else \
             get_queue_backend(self.helper.src)
-        self.summary = summary
+        # always keep a summary: log_dir=None is stats-only (percentiles
+        # + queue depths without event files)
+        self.summary = summary if summary is not None else InferenceSummary()
         self.preprocessing = preprocessing
+        h = self.helper
+        self.pipelined = bool(getattr(h, "pipelined", True))
+        self.decode_workers = max(1, int(getattr(h, "decode_workers", 2)))
+        self.queue_depth = max(2, int(getattr(h, "queue_depth", 0) or
+                                      max(2 * h.batch_size, 16)))
+        self.buckets = list(getattr(h, "bucket_sizes", None) or
+                            power_of_two_buckets(h.batch_size))
+        if self.buckets[-1] < h.batch_size:
+            self.buckets.append(int(h.batch_size))
+        # pipeline counters (guarded by _ctr_lock; read via pipeline_stats)
+        self._ctr_lock = threading.Lock()
+        self.records_in = 0
+        self.results_out = 0
+        self.dropped = 0
+        self.batches = 0
+        self.bucket_counts: Counter = Counter()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -99,9 +180,46 @@ class ClusterServing:
         tensors = rec["tensors"]
         arrays = [np.frombuffer(t["data"], np.float32).reshape(t["shape"])
                   for t in tensors.values()]
-        return arrays[0] if len(arrays) == 1 else arrays
+        out = arrays[0] if len(arrays) == 1 else arrays
+        if self.preprocessing is not None and len(arrays) == 1:
+            out = self.preprocessing(out)
+        return out
 
-    def _process_batch(self, items):
+    def _format_result(self, p: np.ndarray) -> dict:
+        if self.helper.top_n and p.ndim == 1 and \
+                p.shape[0] > self.helper.top_n:
+            top = np.argsort(p)[::-1][:self.helper.top_n]
+            return {"value": [[int(i), float(p[i])] for i in top]}
+        return {"value": p.tolist()}
+
+    def _count(self, **deltas):
+        with self._ctr_lock:
+            for name, d in deltas.items():
+                setattr(self, name, getattr(self, name) + d)
+
+    def pipeline_stats(self) -> dict:
+        """Counters + per-stage percentiles + queue depths — the payload
+        the bench leg, smoke entry, and tests assert on."""
+        with self._ctr_lock:
+            out = {"records_in": self.records_in,
+                   "results_out": self.results_out,
+                   "dropped": self.dropped,
+                   "batches": self.batches,
+                   "buckets": dict(self.bucket_counts)}
+        out.update(self.summary.snapshot())
+        return out
+
+    # ------------------------------------------------------------------
+    # synchronous loop (the pre-pipeline baseline, pipelined=False)
+    # ------------------------------------------------------------------
+    def _process_batch(self, items, t_in: Optional[float] = None):
+        # never trust a StreamQueue backend to cap read_batch: chunk
+        # oversized reads instead of compiling a giant signature
+        bs = self.helper.batch_size
+        for i in range(0, len(items), bs):
+            self._process_chunk(items[i:i + bs], t_in)
+
+    def _process_chunk(self, items, t_in: Optional[float] = None):
         uris, arrays = [], []
         for rid, rec in items:
             try:
@@ -109,39 +227,211 @@ class ClusterServing:
                 uris.append(rec.get("uri", rid))
             except Exception as e:  # bad record: report, keep serving
                 logger.warning("skipping record %s: %s", rid, e)
+                self._count(dropped=1)
         if not arrays:
             return
         n = len(arrays)
         batch = np.stack(arrays)
         # pad to the configured batch size: one AOT signature on the MXU
+        # (skipped when the batch is exactly full)
         if n < self.helper.batch_size:
             pad = np.repeat(batch[-1:], self.helper.batch_size - n, axis=0)
             batch = np.concatenate([batch, pad])
         t0 = time.perf_counter()
         preds = np.asarray(self.model.predict(batch))[:n]
         dt = time.perf_counter() - t0
-        if self.summary is not None:
-            self.summary.record_batch(n, dt)
+        self.summary.record_batch(n, dt)
+        self._count(batches=1, records_in=n)
+        self.bucket_counts[batch.shape[0]] += 1
+        results = {}
         for uri, p in zip(uris, preds):
-            if self.helper.top_n and p.ndim == 1 and \
-                    p.shape[0] > self.helper.top_n:
-                top = np.argsort(p)[::-1][:self.helper.top_n]
-                value = {"value": [[int(i), float(p[i])] for i in top]}
-            else:
-                value = {"value": p.tolist()}
-            self.db.put_result(uri, json.dumps(value).encode())
+            results[uri] = json.dumps(self._format_result(p)).encode()
+        self.db.put_results(results)
+        self._count(results_out=n)
+        if t_in is not None:
+            now = time.perf_counter()
+            for _ in range(n):
+                self.summary.record_stage("e2e", now - t_in)
 
-    def serve_forever(self, poll_timeout: float = 0.5):
-        logger.info("cluster serving started (batch=%d)",
-                    self.helper.batch_size)
+    def _serve_sync(self, poll_timeout: float = 0.5):
         while not self._stop.is_set():
             items = self.db.read_batch(self.helper.batch_size,
                                        timeout=poll_timeout)
             if items:
-                self._process_batch(items)
+                self._process_batch(items, t_in=time.perf_counter())
             # watermark trim (ClusterServing.scala:130-136)
             if self.db.stream_len() > self.helper.stream_maxlen:
                 self.db.trim(int(self.helper.stream_maxlen * 0.6 * 0.8))
+
+    # ------------------------------------------------------------------
+    # pipelined loop (decode pool -> bucketed async compute -> writer)
+    # ------------------------------------------------------------------
+    def _decode_worker(self, decode_in: queue.Queue, ready: queue.Queue):
+        while True:
+            item = decode_in.get()
+            if item is _SENTINEL:
+                return
+            t_in, rid, rec = item
+            t0 = time.perf_counter()
+            try:
+                arr = self._decode_record(rec)
+            except Exception as e:  # bad record: report, keep serving
+                logger.warning("skipping record %s: %s", rid, e)
+                self._count(dropped=1)
+                continue
+            self.summary.record_stage("decode", time.perf_counter() - t0)
+            ready.put((t_in, rec.get("uri", rid), arr))
+
+    def _compute_loop(self, ready: queue.Queue, write_q: queue.Queue):
+        bs = self.helper.batch_size
+        while True:
+            item = ready.get()
+            if item is _SENTINEL:
+                return
+            batch_items, saw_sentinel = [item], False
+            # greedy assembly: take whatever is already decoded, up to
+            # batch_size — no artificial linger, buckets absorb the
+            # partial batches
+            while len(batch_items) < bs:
+                try:
+                    nxt = ready.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is _SENTINEL:
+                    saw_sentinel = True
+                    break
+                batch_items.append(nxt)
+            self._dispatch_batch(batch_items, write_q)
+            if saw_sentinel:
+                return
+
+    def _dispatch_batch(self, batch_items, write_q: queue.Queue):
+        t_ins = [it[0] for it in batch_items]
+        uris = [it[1] for it in batch_items]
+        arrays = [it[2] for it in batch_items]
+        n = len(arrays)
+        bucket = pick_bucket(n, self.buckets)
+        try:
+            batch = np.stack(arrays)
+            if n < bucket:
+                pad = np.repeat(batch[-1:], bucket - n, axis=0)
+                batch = np.concatenate([batch, pad])
+            t0 = time.perf_counter()
+            # async dispatch: don't block on the host transfer of batch
+            # k before submitting k+1 — the writer stage synchronizes
+            out = self.model.predict_async(batch)
+        except Exception as e:
+            logger.warning("dropping batch of %d (%s)", n, e)
+            self._count(dropped=n)
+            return
+        self.summary.record_stage("dispatch", time.perf_counter() - t0)
+        self._count(batches=1)
+        with self._ctr_lock:
+            self.bucket_counts[bucket] += 1
+        write_q.put((t_ins, uris, n, t0, out))
+
+    def _writer_loop(self, write_q: queue.Queue):
+        while True:
+            item = write_q.get()
+            if item is _SENTINEL:
+                return
+            t_ins, uris, n, t_disp, out = item
+            try:
+                preds = np.asarray(out)[:n]   # host transfer = sync point
+            except Exception as e:
+                logger.warning("dropping results for %d records (%s)",
+                               n, e)
+                self._count(dropped=n)
+                continue
+            dt = time.perf_counter() - t_disp
+            self.summary.record_batch(n, dt)   # Throughput/LatencyMs parity
+            self.summary.record_stage("compute", dt, batch_size=n)
+            t0 = time.perf_counter()
+            results = {}
+            for uri, p in zip(uris, preds):
+                results[uri] = json.dumps(self._format_result(p)).encode()
+            self.db.put_results(results)
+            now = time.perf_counter()
+            self.summary.record_stage("write", now - t0, batch_size=n)
+            for t_in in t_ins:
+                self.summary.record_stage("e2e", now - t_in)
+            self._count(results_out=n)
+
+    def _serve_pipelined(self, poll_timeout: float = 0.5):
+        decode_in: queue.Queue = queue.Queue(self.queue_depth)
+        ready: queue.Queue = queue.Queue(self.queue_depth)
+        write_q: queue.Queue = queue.Queue(self.queue_depth)
+        decoders = [threading.Thread(target=self._decode_worker,
+                                     args=(decode_in, ready), daemon=True,
+                                     name=f"serving-decode-{i}")
+                    for i in range(self.decode_workers)]
+        compute = threading.Thread(target=self._compute_loop,
+                                   args=(ready, write_q), daemon=True,
+                                   name="serving-compute")
+        writer = threading.Thread(target=self._writer_loop,
+                                  args=(write_q,), daemon=True,
+                                  name="serving-write")
+        for t in decoders + [compute, writer]:
+            t.start()
+        try:
+            while not self._stop.is_set():
+                items = self.db.read_batch(self.helper.batch_size,
+                                           timeout=poll_timeout)
+                if items:
+                    now = time.perf_counter()
+                    for rid, rec in items:
+                        decode_in.put((now, rid, rec))  # backpressure here
+                    self._count(records_in=len(items))
+                    self.summary.record_queue_depth("decode",
+                                                    decode_in.qsize())
+                    self.summary.record_queue_depth("ready", ready.qsize())
+                    self.summary.record_queue_depth("write", write_q.qsize())
+                # watermark trim (ClusterServing.scala:130-136)
+                if self.db.stream_len() > self.helper.stream_maxlen:
+                    self.db.trim(int(self.helper.stream_maxlen * 0.6 * 0.8))
+        finally:
+            # orderly drain: each stage fully flushes before the next
+            # stage sees its sentinel, so no in-flight record is lost
+            for _ in decoders:
+                decode_in.put(_SENTINEL)
+            for t in decoders:
+                t.join()
+            ready.put(_SENTINEL)
+            compute.join()
+            write_q.put(_SENTINEL)
+            writer.join()
+
+    # ------------------------------------------------------------------
+    def warmup(self, shape: Optional[Sequence[int]] = None) -> dict:
+        """Pre-compile every padding bucket's AOT signature before the
+        loop accepts traffic.  ``shape`` is the per-record tensor shape
+        (defaults to the configured ``image_shape``).  Returns
+        {bucket: seconds}; failures are logged and skipped (foreign
+        backends may reject the synthetic input)."""
+        shape = tuple(shape if shape is not None else
+                      self.helper.image_shape)
+        times = {}
+        for b in self.buckets:
+            x = np.zeros((b,) + shape, np.float32)
+            t0 = time.perf_counter()
+            try:
+                self.model.predict(x)
+            except Exception as e:  # noqa: BLE001 - warmup is best-effort
+                logger.warning("warmup: bucket %d failed: %s", b, e)
+                continue
+            times[b] = time.perf_counter() - t0
+            logger.info("warmup: bucket %d compiled in %.3fs", b, times[b])
+        return times
+
+    def serve_forever(self, poll_timeout: float = 0.5):
+        logger.info("cluster serving started (batch=%d, %s, buckets=%s)",
+                    self.helper.batch_size,
+                    "pipelined" if self.pipelined else "synchronous",
+                    self.buckets if self.pipelined else "n/a")
+        if self.pipelined:
+            self._serve_pipelined(poll_timeout)
+        else:
+            self._serve_sync(poll_timeout)
 
     def start(self):
         self._stop.clear()
@@ -153,5 +443,5 @@ class ClusterServing:
     def stop(self):
         self._stop.set()
         if self._thread is not None:
-            self._thread.join(timeout=5)
+            self._thread.join(timeout=10)
             self._thread = None

@@ -43,6 +43,13 @@ class StreamQueue:
     def put_result(self, uri: str, value: bytes):
         raise NotImplementedError
 
+    def put_results(self, results: Dict[str, bytes]):
+        """Commit a batch of results (the serving writer stage drains a
+        whole batch at once); transports may override to amortize their
+        per-result cost."""
+        for uri, value in results.items():
+            self.put_result(uri, value)
+
     def get_result(self, uri: str, pop: bool = True) -> Optional[bytes]:
         raise NotImplementedError
 
@@ -85,6 +92,10 @@ class InProcessStreamQueue(StreamQueue):
     def put_result(self, uri, value):
         with self._cv:
             self._results[uri] = value
+
+    def put_results(self, results):
+        with self._cv:   # one lock acquisition per served batch
+            self._results.update(results)
 
     def get_result(self, uri, pop=True):
         with self._cv:
@@ -230,6 +241,12 @@ class RedisStreamQueue(StreamQueue):  # pragma: no cover - needs a server
 
     def put_result(self, uri, value):
         self.r.hset("result:" + uri, "value", value)
+
+    def put_results(self, results):
+        pipe = self.r.pipeline()
+        for uri, value in results.items():
+            pipe.hset("result:" + uri, "value", value)
+        pipe.execute()
 
     def get_result(self, uri, pop=True):
         v = self.r.hget("result:" + uri, "value")
