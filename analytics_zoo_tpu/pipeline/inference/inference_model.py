@@ -425,6 +425,27 @@ class InferenceModel:
         finally:
             self._permits.put(permit)
 
+    def warm(self, shape, bucket_sizes, dtype=np.float32) -> Dict[int, float]:
+        """AOT-compile the padding-bucket signatures *off* the serve path.
+
+        ``shape`` is the per-record tensor shape; each ``bucket_sizes``
+        entry becomes one ``(bucket,) + shape`` signature compiled via a
+        synthetic predict.  Returns {bucket: seconds}.  Unlike
+        ``ClusterServing.warmup`` this RAISES on the first failure — the
+        model-registry deploy path must not swap traffic onto a version
+        that cannot compile its signatures.
+        """
+        if self.model is None:
+            raise RuntimeError("no model loaded; call load*() first")
+        shape = tuple(int(s) for s in shape)
+        times: Dict[int, float] = {}
+        for b in sorted({int(x) for x in bucket_sizes}):
+            x = np.zeros((b,) + shape, dtype)
+            t0 = time.perf_counter()
+            self.predict(x)
+            times[b] = time.perf_counter() - t0
+        return times
+
     def release(self):
         if self.model is not None:
             self.model.release()

@@ -1,16 +1,25 @@
 """Cluster Serving: always-on streaming inference service.
 
 Parity: ``zoo/.../serving/ClusterServing.scala`` + client
-``pyzoo/zoo/serving/client.py``.
+``pyzoo/zoo/serving/client.py``; the model registry / router layer
+(versioned hot-swap, canary rollout) is TPU-rebuild-native
+(docs/model-registry.md).
 """
 
-from .client import API, InputQueue, OutputQueue
+from .client import API, InputQueue, OutputQueue, ServingError
 from .cluster_serving import (ClusterServing, ClusterServingHelper,
                               pick_bucket, power_of_two_buckets)
 from .queue_backend import (FileStreamQueue, InProcessStreamQueue,
                             StreamQueue, get_queue_backend)
+from .registry import (CanaryState, DeployError, ModelRegistry,
+                       ModelVersion, RegistryControlServer, RegistryError,
+                       UnknownModelError, control_request)
+from .router import RoutedClusterServing
 
-__all__ = ["InputQueue", "OutputQueue", "API", "ClusterServing",
-           "ClusterServingHelper", "StreamQueue", "InProcessStreamQueue",
-           "FileStreamQueue", "get_queue_backend", "pick_bucket",
-           "power_of_two_buckets"]
+__all__ = ["InputQueue", "OutputQueue", "API", "ServingError",
+           "ClusterServing", "ClusterServingHelper", "StreamQueue",
+           "InProcessStreamQueue", "FileStreamQueue", "get_queue_backend",
+           "pick_bucket", "power_of_two_buckets", "ModelRegistry",
+           "ModelVersion", "CanaryState", "RegistryError",
+           "UnknownModelError", "DeployError", "RegistryControlServer",
+           "control_request", "RoutedClusterServing"]

@@ -20,11 +20,22 @@ Usage::
     python -m analytics_zoo_tpu.serving.cli stop   [--dir DIR]
     python -m analytics_zoo_tpu.serving.cli restart [--dir DIR]
     python -m analytics_zoo_tpu.serving.cli shutdown [--dir DIR]
+
+Model-registry verbs (config has a ``registry:`` section —
+docs/model-registry.md).  Against a *running* server they go through the
+file-RPC control plane (load + AOT warmup happen in the server, off the
+serve path); with no server running they edit the persisted manifest
+offline, and the next ``start`` loads the result::
+
+    ... deploy   --path DIR [--model NAME] [--weight W] [--no-activate]
+    ... promote  --model NAME --version N
+    ... undeploy --model NAME [--version N]
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import signal
 import sys
@@ -33,6 +44,7 @@ import time
 PIDFILE = "cluster-serving.pid"
 LOGFILE = "cluster-serving.log"
 CONFIG = "config.yaml"
+STATSFILE = "stats.json"
 
 CONFIG_TEMPLATE = """\
 ## Analytics-Zoo-TPU Cluster Serving configuration
@@ -58,6 +70,15 @@ params:
   # queue_depth: 64          # bound on each inter-stage queue
   # bucket_sizes: 1,2,4,8,16,32   # padding buckets (default: powers of 2)
   # warmup: false            # pre-compile all buckets before serving
+
+## model registry (docs/model-registry.md): uncomment to serve many
+## named, versioned models with hot-swap + canary rollout
+# registry:
+#   root: /tmp/zoo-serving-registry   # manifest + control-plane dir
+#   default_model: default       # model routed when records carry none
+#   canary_error_threshold: 0.5  # canary error rate that triggers rollback
+#   canary_min_requests: 20      # observations before rollback can fire
+#   drain_timeout: 10.0          # seconds to drain a retiring version
 """
 
 
@@ -94,7 +115,37 @@ def cmd_init(workdir: str) -> int:
     return 0
 
 
-def _serve(cfg: str, warmup: bool = False):
+def _build_serving(cfg: str, workdir: str):
+    """ClusterServing for plain configs; RoutedClusterServing (registry
+    mode: ModelRegistry recovered from its manifest, default model
+    deployed from model.path, control server polling) when the config
+    has a ``registry:`` section.  Either way a periodic stats snapshot
+    lands in <workdir>/stats.json for `zoo-serving status`."""
+    from .cluster_serving import ClusterServing, ClusterServingHelper
+
+    helper = ClusterServingHelper(config_path=cfg)
+    if not helper.stats_path:
+        helper.stats_path = os.path.join(workdir, STATSFILE)
+    if not helper.registry_root:
+        return ClusterServing(helper=helper), None
+    from .registry import ModelRegistry, RegistryControlServer
+    from .router import RoutedClusterServing
+
+    registry = ModelRegistry(
+        root=helper.registry_root,
+        default_model=helper.default_model,
+        canary_error_threshold=helper.canary_error_threshold,
+        canary_min_requests=helper.canary_min_requests)
+    serving = RoutedClusterServing(registry, helper=helper)
+    registry.recover(load=True, warmup=serving.registry_warmup())
+    if helper.model_path and not registry.routed_versions():
+        serving.deploy(path=helper.model_path)
+    ctl = RegistryControlServer(registry, helper.registry_root,
+                                serving=serving).start()
+    return serving, ctl
+
+
+def _serve(cfg: str, warmup: bool = False, workdir: str = "."):
     # honor JAX_PLATFORMS even when a TPU plugin is registered (the env
     # var alone is ignored then; the config update is authoritative)
     plat = os.environ.get("JAX_PLATFORMS")
@@ -104,9 +155,7 @@ def _serve(cfg: str, warmup: bool = False):
             jax.config.update("jax_platforms", plat)
         except Exception:  # noqa: BLE001 - serving may not need jax yet
             pass
-    from .cluster_serving import ClusterServing
-
-    serving = ClusterServing(config_path=cfg)
+    serving, _ctl = _build_serving(cfg, workdir)
     if warmup or serving.helper.warmup:
         # pre-compile every padding-bucket signature before the loop
         # accepts traffic; per-bucket compile time goes to the log
@@ -137,7 +186,7 @@ def cmd_start(workdir: str, foreground: bool = False,
         print("Serving is already running!", file=sys.stderr)
         return 1
     if foreground:
-        _serve(cfg, warmup=warmup)
+        _serve(cfg, warmup=warmup, workdir=workdir)
         return 0
     # double-fork daemonization, pidfile written by the grandchild
     pid = os.fork()
@@ -161,13 +210,54 @@ def cmd_start(workdir: str, foreground: bool = False,
     with open(pidfile, "w") as f:
         f.write(str(os.getpid()))
     try:
-        _serve(cfg, warmup=warmup)
+        _serve(cfg, warmup=warmup, workdir=workdir)
     finally:
         try:
             os.remove(pidfile)
         except OSError:
             pass
     os._exit(0)
+
+
+def _load_config(workdir: str) -> dict:
+    cfg, _, _ = _paths(workdir)
+    try:
+        import yaml
+
+        with open(cfg) as f:
+            return yaml.safe_load(f) or {}
+    except OSError:
+        return {}
+
+
+def _registry_root(workdir: str):
+    return (_load_config(workdir).get("registry") or {}).get("root")
+
+
+def _print_stage_percentiles(stats: dict):
+    stages = stats.get("stages") or {}
+    for name in sorted(stages):
+        s = stages[name]
+        print(f"  stage {name:10s} p50={s.get('p50', 0):8.2f}ms "
+              f"p95={s.get('p95', 0):8.2f}ms "
+              f"p99={s.get('p99', 0):8.2f}ms "
+              f"(n={s.get('count', 0)})")
+
+
+def _print_models(models: dict):
+    for name in sorted(models):
+        m = models[name]
+        can = m.get("canary")
+        canary = (f", canary v{can['version']} @ {can['weight']:.2f} "
+                  f"({can['errors']}/{can['requests']} errors)"
+                  if can else "")
+        print(f"  model {name}: active=v{m.get('active')}{canary}")
+        for v, vs in sorted((m.get("versions") or {}).items(),
+                            key=lambda kv: int(kv[0])):
+            print(f"    v{v}: {vs.get('state'):9s} "
+                  f"requests={vs.get('requests', 0)} "
+                  f"errors={vs.get('errors', 0)} "
+                  f"inflight={vs.get('inflight', 0)}")
 
 
 def cmd_status(workdir: str) -> int:
@@ -177,6 +267,80 @@ def cmd_status(workdir: str) -> int:
         print("not running")
         return 3
     print(f"running (pid {pid})")
+    # pipeline stats: the serving process dumps pipeline_stats() to
+    # stats.json every ~2s (atomic rename, safe to read concurrently)
+    stats = None
+    try:
+        with open(os.path.join(workdir, STATSFILE)) as f:
+            stats = json.load(f)
+    except (OSError, ValueError):
+        pass
+    if stats:
+        print(f"  records_in={stats.get('records_in', 0)} "
+              f"results_out={stats.get('results_out', 0)} "
+              f"dropped={stats.get('dropped', 0)} "
+              f"dead_letters={stats.get('dead_letters', 0)} "
+              f"batches={stats.get('batches', 0)}")
+        _print_stage_percentiles(stats)
+        if stats.get("models"):
+            _print_models(stats["models"])
+            return 0
+    # registry mode but no stats dump yet: fall back to the manifest
+    root = _registry_root(workdir)
+    if root:
+        from .registry import ModelRegistry
+
+        reg = ModelRegistry(root=root).recover(load=False)
+        _print_models(reg.stats()["models"])
+    return 0
+
+
+def _registry_op(workdir: str, op: str, **kw) -> int:
+    """deploy/promote/undeploy/canary: through the control plane when
+    the server runs (it loads + warms off the serve path), else offline
+    against the manifest (next start picks it up)."""
+    reg_cfg = _load_config(workdir).get("registry") or {}
+    root = reg_cfg.get("root")
+    if not root:
+        print("config has no `registry:` section; registry verbs need "
+              "one (see docs/model-registry.md)", file=sys.stderr)
+        return 1
+    _, pidfile, _ = _paths(workdir)
+    from .registry import (ModelRegistry, RegistryError, control_request)
+
+    if _read_pid(pidfile) is not None:
+        try:
+            resp = control_request(root, op, **kw)
+        except TimeoutError as e:
+            print(str(e), file=sys.stderr)
+            return 1
+        print(json.dumps(resp))
+        return 0 if resp.get("ok") else 1
+    reg = ModelRegistry(
+        root=root,
+        default_model=reg_cfg.get("default_model") or "default",
+    ).recover(load=False)
+    try:
+        if op == "deploy":
+            mv = reg.deploy(kw.get("model"), path=kw["path"], load=False,
+                            activate=kw.get("activate", True) and
+                            kw.get("canary_weight") is None)
+            if kw.get("canary_weight") is not None:
+                reg.set_canary(mv.name, mv.version,
+                               float(kw["canary_weight"]))
+            print(f"registered {mv.key} (offline; loads on next start)")
+        elif op == "promote":
+            mv = reg.promote(kw["model"], int(kw["version"]), load=False)
+            print(f"promoted {mv.key} (offline; loads on next start)")
+        else:
+            removed = reg.undeploy(
+                kw["model"],
+                int(kw["version"]) if kw.get("version") is not None
+                else None)
+            print(f"undeployed {kw['model']} versions {removed}")
+    except (RegistryError, ValueError) as e:
+        print(str(e), file=sys.stderr)
+        return 1
     return 0
 
 
@@ -220,7 +384,7 @@ def cmd_restart(workdir: str) -> int:
 def cmd_shutdown(workdir: str) -> int:
     rc = cmd_stop(workdir)
     _, _, logfile = _paths(workdir)
-    for path in (logfile,):
+    for path in (logfile, os.path.join(workdir, STATSFILE)):
         try:
             os.remove(path)
         except OSError:
@@ -230,15 +394,30 @@ def cmd_shutdown(workdir: str) -> int:
 
 
 def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(prog="cluster-serving")
+    ap = argparse.ArgumentParser(prog="zoo-serving")
     ap.add_argument("command", choices=["init", "start", "status", "stop",
-                                        "restart", "shutdown"])
+                                        "restart", "shutdown", "deploy",
+                                        "promote", "undeploy"])
     ap.add_argument("--dir", default=".", help="serving working directory")
     ap.add_argument("--foreground", action="store_true",
                     help="start: run in the foreground (containers)")
     ap.add_argument("--warmup", action="store_true",
                     help="start: pre-compile all padding buckets before "
                          "accepting traffic (logs compile time per bucket)")
+    ap.add_argument("--model", default=None,
+                    help="registry verbs: model name (deploy defaults to "
+                         "the registry's default model)")
+    ap.add_argument("--path", default=None,
+                    help="deploy: saved model directory to load")
+    ap.add_argument("--version", default=None, type=int,
+                    help="promote/undeploy: version number")
+    ap.add_argument("--weight", default=None, type=float,
+                    help="deploy: canary weight in [0,1] — deploy as a "
+                         "canary at this traffic fraction instead of "
+                         "activating")
+    ap.add_argument("--no-activate", action="store_true",
+                    help="deploy: register + warm but do not route "
+                         "traffic (promote later)")
     args = ap.parse_args(argv)
     workdir = os.path.abspath(args.dir)
     if args.command == "init":
@@ -252,6 +431,25 @@ def main(argv=None) -> int:
         return cmd_stop(workdir)
     if args.command == "restart":
         return cmd_restart(workdir)
+    if args.command == "deploy":
+        if not args.path:
+            print("deploy needs --path <saved-model-dir>", file=sys.stderr)
+            return 1
+        return _registry_op(workdir, "deploy", model=args.model,
+                            path=args.path, canary_weight=args.weight,
+                            activate=not args.no_activate)
+    if args.command == "promote":
+        if not args.model or args.version is None:
+            print("promote needs --model and --version", file=sys.stderr)
+            return 1
+        return _registry_op(workdir, "promote", model=args.model,
+                            version=args.version)
+    if args.command == "undeploy":
+        if not args.model:
+            print("undeploy needs --model", file=sys.stderr)
+            return 1
+        return _registry_op(workdir, "undeploy", model=args.model,
+                            version=args.version)
     return cmd_shutdown(workdir)
 
 

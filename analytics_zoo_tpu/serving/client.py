@@ -18,6 +18,21 @@ import numpy as np
 from .queue_backend import StreamQueue, get_queue_backend
 
 
+class ServingError(Exception):
+    """A dead-lettered record: the server committed an error payload for
+    this uri instead of a prediction (unknown model, failed decode,
+    failed batch — docs/model-registry.md#dead-letters)."""
+
+    def __init__(self, uri: Optional[str], message: str,
+                 model: Optional[str] = None,
+                 version: Optional[int] = None):
+        super().__init__(f"{uri}: {message}" if uri else message)
+        self.uri = uri
+        self.message = message
+        self.model = model
+        self.version = version
+
+
 class API:
     """Shared client base (client.py:25)."""
 
@@ -28,9 +43,22 @@ class API:
 
 
 class InputQueue(API):
-    def enqueue_image(self, uri: str, img) -> str:
+    @staticmethod
+    def _route_fields(rec: dict, model: Optional[str],
+                      version: Optional[int]) -> dict:
+        # optional on the wire: absent fields route to the server's
+        # default model, so pre-registry clients keep working unchanged
+        if model is not None:
+            rec["model"] = model
+        if version is not None:
+            rec["version"] = int(version)
+        return rec
+
+    def enqueue_image(self, uri: str, img, model: Optional[str] = None,
+                      version: Optional[int] = None) -> str:
         """Put one image on the stream; ``img`` is an ndarray (HWC BGR
-        uint8) or pre-encoded jpg/png bytes (client.py:83-122)."""
+        uint8) or pre-encoded jpg/png bytes (client.py:83-122).
+        ``model``/``version`` target a registry-served model."""
         if isinstance(img, np.ndarray):
             import cv2
 
@@ -40,16 +68,17 @@ class InputQueue(API):
             data = buf.tobytes()
         else:
             data = bytes(img)
-        return self.db.enqueue({"uri": uri,
-                                "image": self.base64_encode_image(data)})
+        rec = {"uri": uri, "image": self.base64_encode_image(data)}
+        return self.db.enqueue(self._route_fields(rec, model, version))
 
-    def enqueue(self, uri: str, **tensors) -> str:
+    def enqueue(self, uri: str, model: Optional[str] = None,
+                version: Optional[int] = None, **tensors) -> str:
         """General tensor input: each kwarg becomes a (shape, data) entry."""
         rec = {"uri": uri, "tensors": {
             k: {"shape": list(np.asarray(v).shape),
                 "data": np.asarray(v, np.float32).tobytes()}
             for k, v in tensors.items()}}
-        return self.db.enqueue(rec)
+        return self.db.enqueue(self._route_fields(rec, model, version))
 
     @staticmethod
     def base64_encode_image(data: bytes) -> str:
@@ -59,31 +88,52 @@ class InputQueue(API):
 class OutputQueue(API):
     def dequeue(self):
         """Fetch-and-clear all results: {uri: ndarray} (client.py:131)."""
-        return {uri: self._decode(v)
+        return {uri: self._decode(v, uri)
                 for uri, v in self.db.all_results(pop=True).items()}
 
     def query(self, uri: str):
         """Result for one uri or None (client.py:142)."""
         v = self.db.get_result(uri, pop=False)
-        return self._decode(v) if v is not None else None
+        return self._decode(v, uri) if v is not None else None
 
     def wait_all(self, uris: Iterable[str], timeout: float = 30.0,
-                 poll: float = 0.01) -> Dict[str, np.ndarray]:
+                 poll: float = 0.01, max_poll: float = 0.5,
+                 raise_on_error: bool = False) -> Dict[str, np.ndarray]:
         """Poll until every uri has a result (popping as they land) or
-        the deadline passes; returns whatever arrived.  The bench leg,
-        smoke entry, and pipeline tests all need exactly this loop."""
+        the deadline passes; returns whatever arrived.  The interval
+        backs off exponentially from ``poll`` to ``max_poll`` while
+        nothing lands and snaps back to ``poll`` on progress, so a hot
+        stream is polled tightly and an idle one cheaply.
+
+        Dead-lettered uris come back as :class:`ServingError` values
+        (structured error instead of a silent timeout); with
+        ``raise_on_error`` the first one raises."""
         want = set(uris)
         got: Dict[str, np.ndarray] = {}
         deadline = time.time() + timeout
+        interval = poll
         while want and time.time() < deadline:
+            progressed = False
             for uri, v in self.db.all_results(pop=True).items():
-                got[uri] = self._decode(v)
+                got[uri] = self._decode(v, uri)
                 want.discard(uri)
+                progressed = True
+            if raise_on_error:
+                for v in got.values():
+                    if isinstance(v, ServingError):
+                        raise v
             if want:
-                time.sleep(poll)
+                if progressed:
+                    interval = poll
+                else:
+                    interval = min(interval * 2, max_poll)
+                time.sleep(interval)
         return got
 
     @staticmethod
-    def _decode(value: bytes):
+    def _decode(value: bytes, uri: Optional[str] = None):
         obj = json.loads(value.decode("utf-8"))
+        if isinstance(obj, dict) and "error" in obj:
+            return ServingError(uri, obj["error"], obj.get("model"),
+                                obj.get("version"))
         return np.asarray(obj["value"], np.float32)

@@ -118,6 +118,17 @@ class ClusterServingHelper:
             raw = [int(s) for s in raw.split(",") if s.strip()]
         self.bucket_sizes = sorted({int(b) for b in raw}) if raw else None
         self.warmup = _parse_bool(params.get("warmup"), False)
+        # periodic pipeline_stats() JSON dump for `zoo-serving status`
+        # (the CLI start path defaults this to <workdir>/stats.json)
+        self.stats_path = params.get("stats_path")
+        # -- model registry (docs/model-registry.md) --------------------
+        reg = config.get("registry") or {}
+        self.registry_root = reg.get("root")
+        self.default_model = reg.get("default_model") or "default"
+        self.canary_error_threshold = float(
+            reg.get("canary_error_threshold") or 0.5)
+        self.canary_min_requests = int(reg.get("canary_min_requests") or 20)
+        self.drain_timeout = float(reg.get("drain_timeout") or 10.0)
 
     def load_inference_model(self, concurrent_num: int = 1) -> InferenceModel:
         model = InferenceModel(supported_concurrent_num=concurrent_num)
@@ -136,7 +147,7 @@ class ClusterServing:
                  summary: Optional[InferenceSummary] = None,
                  preprocessing=None):
         self.helper = helper or ClusterServingHelper(config_path=config_path)
-        self.model = model or self.helper.load_inference_model()
+        self.model = model if model is not None else self._default_model()
         self.db = backend if backend is not None else \
             get_queue_backend(self.helper.src)
         # always keep a summary: log_dir=None is stats-only (percentiles
@@ -157,10 +168,19 @@ class ClusterServing:
         self.records_in = 0
         self.results_out = 0
         self.dropped = 0
+        self.dead_letters = 0
         self.batches = 0
         self.bucket_counts: Counter = Counter()
+        self.stats_path = getattr(h, "stats_path", None)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    def _default_model(self) -> Optional[InferenceModel]:
+        """Model used when none is injected; the registry router
+        overrides this (models come from the ModelRegistry instead)."""
+        if self.helper.model_path:
+            return self.helper.load_inference_model()
+        return None
 
     # -- record decode (the foreachBatch mapPartitions body) -----------
     def _decode_record(self, rec: dict) -> np.ndarray:
@@ -204,6 +224,7 @@ class ClusterServing:
             out = {"records_in": self.records_in,
                    "results_out": self.results_out,
                    "dropped": self.dropped,
+                   "dead_letters": self.dead_letters,
                    "batches": self.batches,
                    "buckets": dict(self.bucket_counts)}
         out.update(self.summary.snapshot())
@@ -266,6 +287,16 @@ class ClusterServing:
     # ------------------------------------------------------------------
     # pipelined loop (decode pool -> bucketed async compute -> writer)
     # ------------------------------------------------------------------
+    def _ready_item(self, t_in: float, rid: str, rec: dict, arr):
+        """Tuple pushed onto the ready queue for one decoded record; the
+        registry router appends the record's routing fields."""
+        return (t_in, rec.get("uri", rid), arr)
+
+    def _on_decode_error(self, rid: str, rec: dict, exc: Exception):
+        """Undecodable record; the router dead-letters instead."""
+        logger.warning("skipping record %s: %s", rid, exc)
+        self._count(dropped=1)
+
     def _decode_worker(self, decode_in: queue.Queue, ready: queue.Queue):
         while True:
             item = decode_in.get()
@@ -276,11 +307,10 @@ class ClusterServing:
             try:
                 arr = self._decode_record(rec)
             except Exception as e:  # bad record: report, keep serving
-                logger.warning("skipping record %s: %s", rid, e)
-                self._count(dropped=1)
+                self._on_decode_error(rid, rec, e)
                 continue
             self.summary.record_stage("decode", time.perf_counter() - t0)
-            ready.put((t_in, rec.get("uri", rid), arr))
+            ready.put(self._ready_item(t_in, rid, rec, arr))
 
     def _compute_loop(self, ready: queue.Queue, write_q: queue.Queue):
         bs = self.helper.batch_size
@@ -412,22 +442,38 @@ class ClusterServing:
                       self.helper.image_shape)
         times = {}
         for b in self.buckets:
-            x = np.zeros((b,) + shape, np.float32)
-            t0 = time.perf_counter()
             try:
-                self.model.predict(x)
+                times.update(self.model.warm(shape, [b]))
             except Exception as e:  # noqa: BLE001 - warmup is best-effort
                 logger.warning("warmup: bucket %d failed: %s", b, e)
                 continue
-            times[b] = time.perf_counter() - t0
             logger.info("warmup: bucket %d compiled in %.3fs", b, times[b])
         return times
+
+    def _stats_dump_loop(self, interval: float = 2.0):
+        """Periodically snapshot pipeline_stats() to ``stats_path`` (atomic
+        rename) so `zoo-serving status` can report live percentiles from
+        outside the process."""
+        from ..utils import file_io
+
+        while True:
+            try:
+                file_io.write_bytes_atomic(
+                    self.stats_path,
+                    json.dumps(self.pipeline_stats()).encode())
+            except Exception as e:  # noqa: BLE001 - observability only
+                logger.debug("stats dump failed: %s", e)
+            if self._stop.wait(interval):
+                return
 
     def serve_forever(self, poll_timeout: float = 0.5):
         logger.info("cluster serving started (batch=%d, %s, buckets=%s)",
                     self.helper.batch_size,
                     "pipelined" if self.pipelined else "synchronous",
                     self.buckets if self.pipelined else "n/a")
+        if self.stats_path:
+            threading.Thread(target=self._stats_dump_loop, daemon=True,
+                             name="serving-stats").start()
         if self.pipelined:
             self._serve_pipelined(poll_timeout)
         else:

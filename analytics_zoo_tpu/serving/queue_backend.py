@@ -19,6 +19,7 @@ results hash (``put_result``/``get_result``), and the memory-watermark trim
 
 from __future__ import annotations
 
+import itertools
 import os
 import tempfile
 import threading
@@ -125,15 +126,22 @@ class FileStreamQueue(StreamQueue):
     Results land in ``<root>/results/<safe-uri>``.  Good enough for
     multi-process single-host serving without Redis."""
 
-    def __init__(self, root: str, name: str = "image_stream"):
+    def __init__(self, root: str, name: str = "image_stream",
+                 orphan_tmp_age: float = 60.0):
         self.root = root
         self.stream_dir = os.path.join(root, name)
         self.results_dir = os.path.join(root, "results")
         os.makedirs(self.stream_dir, exist_ok=True)
         os.makedirs(self.results_dir, exist_ok=True)
+        # per-producer monotonic sequence: timestamp collisions (same
+        # time_ns on fast enqueues, coarse clocks) still sort FIFO
+        self._seq = itertools.count()
+        self.orphan_tmp_age = orphan_tmp_age
+        self._last_gc = 0.0
 
     def enqueue(self, record):
-        rid = f"{time.time_ns():020d}-{uuid.uuid4().hex[:8]}"
+        rid = (f"{time.time_ns():020d}-{next(self._seq):08d}"
+               f"-{uuid.uuid4().hex[:8]}")
         payload = msgpack.packb(record, use_bin_type=True)
         fd, tmp = tempfile.mkstemp(dir=self.stream_dir, suffix=".tmp")
         with os.fdopen(fd, "wb") as f:
@@ -141,7 +149,35 @@ class FileStreamQueue(StreamQueue):
         os.rename(tmp, os.path.join(self.stream_dir, rid + ".msgpack"))
         return rid
 
+    def _gc_orphans(self):
+        """Recover droppings of crashed processes: aged ``.tmp`` files
+        (enqueuer/writer died mid-write, never renamed) are deleted;
+        aged ``.claimed`` files (consumer died between claim and unlink)
+        are renamed back into the stream — re-serving is harmless since
+        the results map is idempotent per uri."""
+        now = time.time()
+        if now - self._last_gc < self.orphan_tmp_age / 2:
+            return
+        self._last_gc = now
+        for d in (self.stream_dir, self.results_dir):
+            for n in os.listdir(d):
+                path = os.path.join(d, n)
+                try:
+                    age = now - os.path.getmtime(path)
+                except OSError:
+                    continue
+                if age < self.orphan_tmp_age:
+                    continue
+                try:
+                    if n.endswith(".tmp"):
+                        os.unlink(path)
+                    elif n.endswith(".msgpack.claimed"):
+                        os.rename(path, path[:-len(".claimed")])
+                except OSError:
+                    pass
+
     def read_batch(self, max_items, timeout=1.0):
+        self._gc_orphans()
         deadline = time.time() + timeout
         while True:
             names = sorted(n for n in os.listdir(self.stream_dir)

@@ -1,0 +1,144 @@
+"""Model-registry smoke: hot-swap a version under live multi-model
+traffic and fail on any lost record.
+
+CI/tooling entry (``scripts/registry-smoke``): two models ("alpha",
+"beta") are deployed into an in-memory :class:`ModelRegistry` behind a
+live :class:`RoutedClusterServing`; a producer alternates records
+between them while the main thread deploys **alpha v2** mid-traffic
+(hot-swap: warm off the serve path, atomic pointer swap, drain v1).
+Every enqueued record must come back with a real prediction — any
+missing uri, dead-lettered record, or dropped count fails the run.
+Constant-kernel models make the serving version observable from the
+output value, so the swap is asserted end-to-end: alpha results must
+show both v1 and v2 markers, and nothing else.
+
+Usage::
+
+    python -m analytics_zoo_tpu.serving.registry_smoke [--seconds 2]
+                                                       [--batch 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="registry-smoke")
+    ap.add_argument("--seconds", type=float, default=2.0,
+                    help="how long to keep producing traffic")
+    ap.add_argument("--batch", type=int, default=8)
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from .client import InputQueue, OutputQueue, ServingError
+    from .cluster_serving import ClusterServingHelper
+    from .queue_backend import InProcessStreamQueue
+    from .registry import ModelRegistry
+    from .router import RoutedClusterServing
+    from .smoke import build_tiny_model
+
+    shape = (3, 8, 8)
+    flat = shape[0] * shape[1] * shape[2]
+    # constant kernels: a record of all-ones yields flat*scale in every
+    # output slot, identifying (model, version) from the value alone
+    scales = {"alpha:v1": 1.0, "alpha:v2": 2.0, "beta:v1": 3.0}
+
+    # top_n larger than the output width -> raw values on the wire
+    # (top-n would replace them with [argmax, value] pairs)
+    helper = ClusterServingHelper(config={
+        "data": {"image_shape": "3, 8, 8"},
+        "params": {"batch_size": args.batch, "top_n": 100}})
+    backend = InProcessStreamQueue()
+    registry = ModelRegistry(default_model="alpha")
+    serving = RoutedClusterServing(registry, helper=helper,
+                                   backend=backend)
+    serving.deploy("alpha", model=build_tiny_model(
+        shape, scale=scales["alpha:v1"]))
+    serving.deploy("beta", model=build_tiny_model(
+        shape, scale=scales["beta:v1"]))
+    serving.warmup()
+    serving.start()
+
+    in_q = InputQueue(backend=backend)
+    out_q = OutputQueue(backend=backend)
+    uris = {"alpha": [], "beta": []}
+    stop = threading.Event()
+
+    def _produce():
+        i = 0
+        x = np.ones(shape, np.float32)
+        while not stop.is_set():
+            model = "alpha" if i % 2 == 0 else "beta"
+            uri = f"smoke-{model}-{i}"
+            in_q.enqueue(uri, model=model, input=x)
+            uris[model].append(uri)
+            i += 1
+            time.sleep(0.002)
+
+    producer = threading.Thread(target=_produce, daemon=True)
+    producer.start()
+    rc = 0
+    try:
+        # let v1 serve some traffic, then hot-swap alpha mid-stream
+        time.sleep(args.seconds / 2)
+        serving.deploy("alpha", model=build_tiny_model(
+            shape, scale=scales["alpha:v2"]))
+        time.sleep(args.seconds / 2)
+        stop.set()
+        producer.join()
+        all_uris = uris["alpha"] + uris["beta"]
+        got = out_q.wait_all(all_uris, timeout=30.0)
+    finally:
+        stop.set()
+        serving.stop()
+
+    stats = serving.pipeline_stats()
+    missing = [u for u in uris["alpha"] + uris["beta"] if u not in got]
+    errors = [u for u, v in got.items() if isinstance(v, ServingError)]
+
+    def marker(v):
+        return round(float(np.asarray(v).ravel()[0]) / flat, 3)
+
+    alpha_markers = {marker(got[u]) for u in uris["alpha"] if u in got
+                     and not isinstance(got[u], ServingError)}
+    beta_markers = {marker(got[u]) for u in uris["beta"] if u in got
+                    and not isinstance(got[u], ServingError)}
+    stats.update(submitted=len(uris["alpha"]) + len(uris["beta"]),
+                 received=len(got), missing=len(missing),
+                 errors=len(errors),
+                 alpha_markers=sorted(alpha_markers),
+                 beta_markers=sorted(beta_markers))
+    print(json.dumps(stats))
+    if missing or errors or stats["dropped"] or stats["dead_letters"]:
+        print(f"REGISTRY SMOKE FAILED: {len(missing)} missing, "
+              f"{len(errors)} errored, {stats['dropped']} dropped, "
+              f"{stats['dead_letters']} dead-lettered", file=sys.stderr)
+        rc = 1
+    elif not alpha_markers <= {1.0, 2.0} or 2.0 not in alpha_markers:
+        print(f"REGISTRY SMOKE FAILED: alpha markers {alpha_markers} "
+              f"(want subset of {{1.0, 2.0}} including post-swap 2.0)",
+              file=sys.stderr)
+        rc = 1
+    elif beta_markers != {3.0}:
+        print(f"REGISTRY SMOKE FAILED: beta markers {beta_markers} "
+              f"(want exactly {{3.0}})", file=sys.stderr)
+        rc = 1
+    else:
+        print(f"REGISTRY SMOKE OK: {stats['submitted']} records across "
+              f"2 models, alpha hot-swapped v1->v2 with 0 lost "
+              f"(markers {sorted(alpha_markers)})", file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,6 +22,32 @@ import sys
 import time
 
 
+def build_tiny_model(shape=(3, 8, 8), units: int = 4, scale=None):
+    """Flatten+Dense InferenceModel for smoke/bench traffic.  With
+    ``scale`` the kernel is a constant, so outputs identify which model
+    (or version) served a record — what the registry smoke asserts on."""
+    import numpy as np
+
+    from ..pipeline.api.keras.layers import Dense, Flatten
+    from ..pipeline.api.keras.models import Sequential
+    from ..pipeline.inference import InferenceModel
+
+    m = Sequential()
+    m.add(Flatten(input_shape=shape))
+    m.add(Dense(units, activation=None if scale is not None
+                else "softmax"))
+    m.compile("sgd", "sparse_categorical_crossentropy")
+    if scale is not None:
+        # constant kernel (the 2-D leaf), zero bias — leaf order comes
+        # from the param tree, so match by shape instead of position
+        m.set_weights([np.full(w.shape, float(scale) if w.ndim == 2
+                               else 0.0, np.float32)
+                       for w in m.get_weights()])
+    inf = InferenceModel(supported_concurrent_num=1)
+    inf.load_keras_net(m)
+    return inf
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="serving-pipeline-smoke")
     ap.add_argument("--seconds", type=float, default=2.0,
@@ -38,17 +64,9 @@ def main(argv=None) -> int:
     from .client import InputQueue, OutputQueue
     from .cluster_serving import ClusterServing, ClusterServingHelper
     from .queue_backend import InProcessStreamQueue
-    from ..pipeline.api.keras.layers import Dense, Flatten
-    from ..pipeline.api.keras.models import Sequential
-    from ..pipeline.inference import InferenceModel
 
     shape = (3, 8, 8)
-    m = Sequential()
-    m.add(Flatten(input_shape=shape))
-    m.add(Dense(4, activation="softmax"))
-    m.compile("sgd", "sparse_categorical_crossentropy")
-    inf = InferenceModel(supported_concurrent_num=1)
-    inf.load_keras_net(m)
+    inf = build_tiny_model(shape)
 
     helper = ClusterServingHelper(config={
         "data": {"image_shape": "3, 8, 8"},

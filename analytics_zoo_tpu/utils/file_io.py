@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import glob as _glob
 import os
+import uuid
 from typing import Callable, Dict, List, Tuple
 
 _SCHEMES: Dict[str, "FileSystem"] = {}
@@ -147,6 +148,17 @@ def read_bytes(uri: str) -> bytes:
 def write_bytes(uri: str, data: bytes):
     with open_file(uri, "wb") as f:
         f.write(data)
+
+
+def write_bytes_atomic(uri: str, data: bytes):
+    """Write to a same-directory temp file, then rename into place —
+    readers never observe a partial file (the serving model-registry
+    manifest and stats snapshots depend on this)."""
+    fs, path = get_filesystem(uri)
+    tmp = f"{path}.tmp-{uuid.uuid4().hex[:8]}"
+    with fs.open(tmp, "wb") as f:
+        f.write(data)
+    fs.rename(tmp, path)
 
 
 register_filesystem("file", LocalFileSystem())

@@ -815,6 +815,111 @@ def bench_serving_pipeline(n_records=240, batch_size=8):
     return out
 
 
+def bench_registry_serving(n_records=240, batch_size=8):
+    """Multi-model registry leg (docs/model-registry.md): the same
+    mixed-arrival workload through (a) a single-model pipelined server
+    (PR-1 baseline) and (b) a RoutedClusterServing with two registered
+    models, records alternating between them.  Reports per-model and
+    aggregate throughput plus the multi/single ratio — the routing +
+    per-version accounting overhead the registry layer adds."""
+    import threading
+
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+    from analytics_zoo_tpu.pipeline.inference.inference_model import \
+        AbstractModel
+    from analytics_zoo_tpu.serving import (ClusterServing,
+                                           ClusterServingHelper,
+                                           InProcessStreamQueue,
+                                           InputQueue, ModelRegistry,
+                                           OutputQueue,
+                                           RoutedClusterServing)
+
+    class _SlowStub(AbstractModel):
+        def predict(self, inputs):
+            x = np.asarray(inputs)
+            time.sleep(0.005 * x.shape[0] / batch_size)  # ~5ms/full batch
+            return x.reshape(x.shape[0], -1).mean(axis=1, keepdims=True)
+
+    def _stub():
+        inf = InferenceModel()
+        inf._install(_SlowStub())
+        return inf
+
+    def _helper():
+        return ClusterServingHelper(config={
+            "data": {"image_shape": "3, 8, 8"},
+            "params": {"batch_size": batch_size, "top_n": 0,
+                       "decode_workers": 4}})
+
+    burst_sizes = [1, 3, batch_size, 5, 2, batch_size, 4, 6]
+
+    def _run(serving, backend, models):
+        """models: [None] for wire-compatible default routing, or the
+        model names records alternate across."""
+        in_q = InputQueue(backend=backend)
+        uris = [f"r-{i}" for i in range(n_records)]
+        per_model = {m: 0 for m in models}
+
+        def produce():
+            i, b = 0, 0
+            x = np.full((3, 8, 8), 7, np.float32)
+            while i < n_records:
+                for _ in range(burst_sizes[b % len(burst_sizes)]):
+                    if i >= n_records:
+                        break
+                    m = models[i % len(models)]
+                    in_q.enqueue(uris[i], model=m, input=x)
+                    per_model[m] += 1
+                    i += 1
+                b += 1
+                time.sleep(0.002)
+
+        serving.start()
+        t0 = time.perf_counter()
+        producer = threading.Thread(target=produce)
+        producer.start()
+        got = OutputQueue(backend=backend).wait_all(uris, timeout=120)
+        wall = time.perf_counter() - t0
+        producer.join()
+        serving.stop()
+        stats = serving.pipeline_stats()
+        return got, wall, stats, per_model
+
+    out = {}
+    # -- single-model pipelined baseline (no registry in the path) -----
+    backend = InProcessStreamQueue()
+    serving = ClusterServing(model=_stub(), helper=_helper(),
+                             backend=backend)
+    got, wall, stats, _ = _run(serving, backend, [None])
+    out["registry_single_rec_per_s"] = round(len(got) / wall, 1)
+    out["registry_single_served"] = len(got)
+    out["registry_single_dropped"] = stats["dropped"]
+
+    # -- two models behind the registry router -------------------------
+    backend = InProcessStreamQueue()
+    registry = ModelRegistry(default_model="alpha")
+    serving = RoutedClusterServing(registry, helper=_helper(),
+                                   backend=backend)
+    serving.deploy("alpha", model=_stub(), warmup=False)
+    serving.deploy("beta", model=_stub(), warmup=False)
+    got, wall, stats, per_model = _run(serving, backend,
+                                       ["alpha", "beta"])
+    out["registry_multi_rec_per_s"] = round(len(got) / wall, 1)
+    out["registry_multi_served"] = len(got)
+    out["registry_multi_dropped"] = stats["dropped"]
+    out["registry_multi_dead_letters"] = stats["dead_letters"]
+    for name in ("alpha", "beta"):
+        v = stats["models"][name]["versions"][1]
+        out[f"registry_multi_{name}_served"] = v["requests"]
+        out[f"registry_multi_{name}_rec_per_s"] = round(
+            v["requests"] / wall, 1)
+    if out["registry_single_rec_per_s"]:
+        out["registry_multi_vs_single"] = round(
+            out["registry_multi_rec_per_s"] /
+            out["registry_single_rec_per_s"], 2)
+    return out
+
+
 def bench_infeed(n_images=480, batch_size=32):
     """Image input-pipeline leg (SURVEY §7 hard-part (c)) — CPU-provable.
 
@@ -1038,6 +1143,19 @@ def main():
             traceback.print_exc()
             RESULT["serving_pipe_error"] = (str(e).splitlines()[0][:500]
                                             if str(e) else repr(e)[:500])
+        emit()
+
+    # Multi-model registry leg: per-model throughput through the routed
+    # server vs the single-model pipelined baseline — the overhead of
+    # route resolution + per-version accounting (docs/model-registry.md).
+    if time.time() - T_START < TOTAL_BUDGET_S * 0.9:
+        try:
+            RESULT.update(bench_registry_serving())
+        except Exception as e:  # noqa: BLE001
+            import traceback
+            traceback.print_exc()
+            RESULT["registry_error"] = (str(e).splitlines()[0][:500]
+                                        if str(e) else repr(e)[:500])
         emit()
 
     # Input-pipeline leg — platform-independent (decode is host-side work

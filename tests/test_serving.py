@@ -208,3 +208,57 @@ def test_cpp_file_client_round_trip(tmp_path):
         np.testing.assert_allclose(pred, want, rtol=1e-4, atol=1e-5)
     finally:
         serving.stop()
+
+
+def test_file_queue_fifo_under_same_timestamp(tmp_path, monkeypatch):
+    """Filenames carry a per-producer monotonic sequence, so read_batch
+    stays FIFO even when time_ns() returns the same value for every
+    enqueue (coarse clocks, fast producers)."""
+    q = FileStreamQueue(str(tmp_path))
+    monkeypatch.setattr(time, "time_ns", lambda: 1_000_000)
+    for i in range(10):
+        q.enqueue({"uri": f"r-{i}"})
+    got = [rec["uri"] for _, rec in q.read_batch(10, timeout=1.0)]
+    assert got == [f"r-{i}" for i in range(10)]
+
+
+def test_file_queue_orphan_cleanup(tmp_path):
+    """Aged .tmp droppings of a crashed enqueuer are deleted; an aged
+    .claimed file (consumer died after claiming) is recovered back into
+    the stream instead of being lost."""
+    q = FileStreamQueue(str(tmp_path), orphan_tmp_age=0.5)
+    import msgpack
+
+    tmp = os.path.join(q.stream_dir, "deadbeef.tmp")
+    with open(tmp, "wb") as f:
+        f.write(b"partial")
+    claimed = os.path.join(q.stream_dir,
+                           "00000000000000000001-00000000-aa.msgpack.claimed")
+    with open(claimed, "wb") as f:
+        f.write(msgpack.packb({"uri": "lost-and-found"}, use_bin_type=True))
+    rtmp = os.path.join(q.results_dir, "cafe.tmp")
+    with open(rtmp, "wb") as f:
+        f.write(b"partial")
+    old = time.time() - 60
+    for p in (tmp, claimed, rtmp):
+        os.utime(p, (old, old))
+    q._last_gc = 0.0
+    items = q.read_batch(10, timeout=1.0)
+    assert not os.path.exists(tmp)
+    assert not os.path.exists(rtmp)
+    assert not os.path.exists(claimed)
+    assert [rec["uri"] for _, rec in items] == ["lost-and-found"]
+
+
+def test_wait_all_exponential_backoff(monkeypatch):
+    """With nothing arriving, the poll interval doubles from ``poll`` up
+    to ``max_poll`` instead of spinning at the initial rate."""
+    backend = InProcessStreamQueue()
+    out_q = OutputQueue(backend=backend)
+    sleeps = []
+    monkeypatch.setattr(time, "sleep", lambda s: sleeps.append(s))
+    out_q.wait_all(["never"], timeout=0.3, poll=0.01, max_poll=0.08)
+    assert sleeps, "expected at least one poll sleep"
+    assert sleeps[0] == pytest.approx(0.02)
+    assert max(sleeps) <= 0.08
+    assert sleeps == sorted(sleeps)  # monotone ramp while idle
