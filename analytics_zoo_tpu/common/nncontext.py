@@ -64,6 +64,13 @@ class ZooConfig:
     log_every_n_steps: int = 50
     # host data pipeline
     prefetch_depth: int = 2
+    # ordered transform-pool threads running the Preprocessing chain for
+    # several batches concurrently (MTSampleToMiniBatch parity). 0 = serial
+    # in the prefetch thread.
+    transform_workers: int = 0
+    # dispatch chunks kept already device_put onto the mesh data sharding
+    # ahead of the compiled step, overlapping H2D with device compute
+    device_ahead: int = 2
     seed: int = 42
     # donate params/opt-state buffers into the train step. Besides halving
     # param memory, donation is ESSENTIAL on tunneled backends: measured on

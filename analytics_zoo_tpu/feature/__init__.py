@@ -5,10 +5,17 @@ from .common import (ArrayToTensor, BigDLAdapter, ChainedPreprocessing,
                      ScalarToTensor, SeqToMultipleTensors, SeqToTensor,
                      TensorToSample, ToTuple)
 from .feature_set import (ArrayFeatureSet, FeatureSet, GeneratorFeatureSet,
-                          MiniBatch, PrefetchIterator, Sample, pad_minibatch)
+                          MiniBatch, PrefetchIterator, Sample,
+                          ShardedFileFeatureSet, TransformStats,
+                          TransformedFeatureSet, pad_minibatch)
+from .host_pipeline import (DeviceStagingIterator, ParallelTransformIterator,
+                            build_host_pipeline)
 
 __all__ = ["ArrayFeatureSet", "FeatureSet", "GeneratorFeatureSet",
            "MiniBatch", "PrefetchIterator", "Sample", "pad_minibatch",
+           "ShardedFileFeatureSet", "TransformedFeatureSet",
+           "TransformStats", "ParallelTransformIterator",
+           "DeviceStagingIterator", "build_host_pipeline",
            "Preprocessing", "ChainedPreprocessing", "LambdaPreprocessing",
            "ScalarToTensor", "SeqToTensor", "SeqToMultipleTensors",
            "ArrayToTensor", "MLlibVectorToTensor",

@@ -11,13 +11,18 @@ the reference's BlockManager fetch phase.
 
 from __future__ import annotations
 
+import logging
 import math
 import queue
 import threading
+import time
+from collections import OrderedDict
 from typing import (Any, Callable, Dict, Iterator, List, Optional, Sequence,
                     Tuple)
 
 import numpy as np
+
+logger = logging.getLogger("analytics_zoo_tpu.feature")
 
 
 class Sample:
@@ -127,6 +132,11 @@ class FeatureSet:
                 return DirectFeatureSet(fs.features, fs.labels, fs.weights)
             except (ImportError, MemoryError):
                 return fs  # native arena unavailable/full: stay in DRAM
+        if mt == "DRAM" and isinstance(fs, TransformedFeatureSet):
+            # DRAM tier = memoize the transformed batches (reference keeps
+            # the post-transform MiniBatches resident; raw tiers already
+            # live in host RAM here, so only transforms benefit)
+            fs.cache(int(kw.get("cache_bytes", DEFAULT_DRAM_CACHE_BYTES)))
         return fs
 
     @staticmethod
@@ -411,20 +421,161 @@ def _stack_batch(buf_x, buf_y, batch_size, pad=False):
     return batch
 
 
+DEFAULT_DRAM_CACHE_BYTES = 2 << 30  # 2 GiB; FeatureSet.rdd cache_bytes kw
+
+
+class TransformStats:
+    """Thread-safe counters for host-side transform cost.
+
+    One instance lives on each TransformedFeatureSet (``stats()``); the
+    staged host pipeline reads the same counters for its telemetry, so
+    "seconds spent transforming" is reported once no matter how many
+    workers ran the Preprocessing chain.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.batches = 0
+        self.seconds = 0.0
+        self.cache_hits = 0
+
+    def record(self, seconds: float, batches: int = 1):
+        with self._lock:
+            self.batches += batches
+            self.seconds += seconds
+
+    def record_hit(self, batches: int = 1):
+        with self._lock:
+            self.cache_hits += batches
+
+    def as_dict(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"batches_transformed": self.batches,
+                    "transform_seconds": round(self.seconds, 6),
+                    "cache_hits": self.cache_hits}
+
+
+def minibatch_nbytes(batch: MiniBatch) -> int:
+    """Host-RAM footprint of a MiniBatch (cache-budget accounting)."""
+
+    def add(x):
+        if x is None:
+            return 0
+        if isinstance(x, (list, tuple)):
+            return sum(add(v) for v in x)
+        return np.asarray(x).nbytes
+
+    return add(tuple(batch))
+
+
 class TransformedFeatureSet(FeatureSet):
     """Applies a Preprocessing chain per batch on the host, off the hot path
-    when wrapped by the prefetcher."""
+    when wrapped by the prefetcher.
 
-    def __init__(self, base: FeatureSet, preprocessing):
+    ``num_workers > 0`` runs the chain for several batches concurrently on
+    an ordered thread pool (MTSampleToMiniBatch parity); ``cache()`` turns
+    on the DRAM tier (``FeatureSet.rdd(..., memory_type="DRAM")`` parity):
+    transformed batches are memoized on the first complete epoch under a
+    byte budget and replayed — batch-granular reshuffle by the epoch seed —
+    on later epochs, with LRU eviction across batch signatures.
+    """
+
+    def __init__(self, base: FeatureSet, preprocessing,
+                 num_workers: int = 0):
         self.base = base
         self.preprocessing = preprocessing
+        self.num_workers = num_workers
+        self._stats = TransformStats()
+        self._cache_budget = 0  # bytes; 0 = DRAM tier off
+        self._cache: "OrderedDict[tuple, Tuple[list, int]]" = OrderedDict()
+        self._cache_used = 0
+        self._cache_disabled: set = set()  # signatures over budget alone
 
     def size(self):
         return self.base.size()
 
-    def batches(self, *args, **kw):
-        for batch in self.base.batches(*args, **kw):
-            yield self.preprocessing(batch)
+    def stats(self) -> TransformStats:
+        return self._stats
+
+    def cache(self, max_bytes: int = DEFAULT_DRAM_CACHE_BYTES
+              ) -> "TransformedFeatureSet":
+        """Enable the DRAM cache tier under ``max_bytes`` of host RAM."""
+        self._cache_budget = int(max_bytes)
+        return self
+
+    def _apply_timed(self, batch: MiniBatch) -> MiniBatch:
+        t0 = time.perf_counter()
+        out = self.preprocessing(batch)
+        self._stats.record(time.perf_counter() - t0)
+        return out
+
+    def _evict_for(self, incoming_bytes: int):
+        while self._cache and \
+                self._cache_used + incoming_bytes > self._cache_budget:
+            sig, (_, nbytes) = self._cache.popitem(last=False)
+            self._cache_used -= nbytes
+            logger.info(
+                "DRAM cache: evicted signature %s (%.1f MiB) to fit "
+                "%.1f MiB", sig, nbytes / 2**20, incoming_bytes / 2**20)
+
+    def batches(self, batch_size, shuffle=False, drop_remainder=True,
+                pad_remainder=False, seed=0, num_workers=None):
+        sig = (batch_size, bool(drop_remainder), bool(pad_remainder))
+        if self._cache_budget and sig in self._cache:
+            cached, _ = self._cache[sig]
+            self._cache.move_to_end(sig)  # LRU touch
+            order = np.arange(len(cached))
+            if shuffle:
+                # sample-level shuffle happened before the transform was
+                # memoized; replay epochs reshuffle at batch granularity
+                # with the fresh epoch seed (documented tradeoff)
+                np.random.default_rng(seed).shuffle(order)
+            for i in order:
+                self._stats.record_hit()
+                yield cached[i]
+            return
+        base_it = self.base.batches(
+            batch_size, shuffle=shuffle, drop_remainder=drop_remainder,
+            pad_remainder=pad_remainder, seed=seed)
+        workers = self.num_workers if num_workers is None else num_workers
+        if workers and workers > 0:
+            from .host_pipeline import ParallelTransformIterator
+            it: Iterator[MiniBatch] = ParallelTransformIterator(
+                base_it, self._apply_timed, num_workers=workers)
+        else:
+            it = (self._apply_timed(b) for b in base_it)
+        if not self._cache_budget or sig in self._cache_disabled:
+            yield from it
+            return
+        acc: Optional[List[MiniBatch]] = []
+        acc_bytes = 0
+        complete = False
+        try:
+            for out in it:
+                if acc is not None:
+                    acc_bytes += minibatch_nbytes(out)
+                    if acc_bytes > self._cache_budget:
+                        logger.info(
+                            "DRAM cache: signature %s exceeds budget "
+                            "(%.1f MiB > %.1f MiB); caching disabled for "
+                            "it", sig, acc_bytes / 2**20,
+                            self._cache_budget / 2**20)
+                        self._cache_disabled.add(sig)
+                        acc = None
+                    else:
+                        acc.append(out)
+                yield out
+            complete = acc is not None
+        finally:
+            close = getattr(it, "close", None)
+            if close is not None:
+                close()
+            if complete:
+                # only full epochs commit: an early break or error must
+                # not memoize a truncated epoch as the whole dataset
+                self._evict_for(acc_bytes)
+                self._cache[sig] = (acc, acc_bytes)
+                self._cache_used += acc_bytes
 
 
 class ShardedFileFeatureSet(DiskFeatureSet):
@@ -528,15 +679,30 @@ class PrefetchIterator:
                 except queue.Full:
                     continue
 
-    def close(self):
+    def close(self, timeout: float = 5.0):
         """Unblock and discard the producer (call when abandoning the
-        iterator mid-stream, e.g. early end-trigger or step failure)."""
+        iterator mid-stream, e.g. early end-trigger or step failure).
+
+        Joins the worker (bounded wait) so a producer blocked in ``put``
+        cannot re-insert items after the drain, then closes the upstream
+        iterator — only once the worker is provably out of it (closing a
+        generator mid-execution from another thread raises ValueError).
+        """
         self._stopped = True
         try:
             while True:
                 self.q.get_nowait()
         except queue.Empty:
             pass
+        self.thread.join(timeout)
+        try:  # drop anything re-inserted between drain and worker exit
+            while True:
+                self.q.get_nowait()
+        except queue.Empty:
+            pass
+        upstream_close = getattr(self.it, "close", None)
+        if upstream_close is not None and not self.thread.is_alive():
+            upstream_close()
 
     def __iter__(self):
         return self
@@ -544,9 +710,17 @@ class PrefetchIterator:
     def __next__(self):
         if self._stopped:
             raise StopIteration
+        if self.error is not None:
+            # surface producer failure immediately rather than after the
+            # already-queued batches and the done sentinel drain out
+            self._stopped = True
+            err, self.error = self.error, None
+            raise err
         item = self.q.get()
         if item is self.done:
+            self._stopped = True
             if self.error is not None:
-                raise self.error
+                err, self.error = self.error, None
+                raise err
             raise StopIteration
         return item

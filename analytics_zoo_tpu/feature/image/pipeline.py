@@ -60,11 +60,20 @@ class PipelineStats:
     def throughput(self) -> float:
         return self.images / self.elapsed_s if self.elapsed_s > 0 else 0.0
 
+    def input_bound_fraction(self) -> float:
+        """Share of the pass's wall time the consumer spent blocked on the
+        pool — same definition as the engine's InputBoundFraction scalar."""
+        if self.elapsed_s <= 0:
+            return 0.0
+        return min(1.0, self.infeed_wait_s / self.elapsed_s)
+
     def as_dict(self) -> dict:
         return {"batches": self.batches, "images": self.images,
                 "infeed_wait_s": round(self.infeed_wait_s, 4),
                 "elapsed_s": round(self.elapsed_s, 4),
-                "throughput_img_s": round(self.throughput(), 1)}
+                "throughput_img_s": round(self.throughput(), 1),
+                "input_bound_fraction": round(self.input_bound_fraction(),
+                                              4)}
 
 
 def _decode_one(path: str, height: int, width: int,
@@ -151,6 +160,12 @@ class ImagePipelineFeatureSet(FeatureSet):
         self.augment = augment
         self.to_chw = data_format in ("th", "NCHW", "nchw")
         self.mean, self.std = mean, std
+        if num_workers is None:
+            # same knob as the engine's transform pool so one env var
+            # sizes the whole host pipeline
+            env = os.environ.get("ZOO_TPU_TRANSFORM_WORKERS")
+            if env:
+                num_workers = int(env)
         self.num_workers = int(num_workers or min(8, os.cpu_count() or 1))
         self.backend = backend
         self.in_flight = int(in_flight or 2 * self.num_workers)

@@ -152,8 +152,23 @@ class TextSet:
         ts.word_index = self.word_index
         return ts
 
-    def transform(self, transformer) -> "TextSet":
-        self.features = [transformer.apply(f) for f in self.features]
+    def transform(self, transformer, num_workers: int = 0) -> "TextSet":
+        """Apply a text transformer to every feature. ``num_workers > 0``
+        runs it on an ordered thread pool (``ZOO_TPU_TRANSFORM_WORKERS``
+        sets the default) — worthwhile for chains that release the GIL or
+        do numpy-heavy shaping on large corpora."""
+        if num_workers == 0:
+            env = os.environ.get("ZOO_TPU_TRANSFORM_WORKERS")
+            if env:
+                num_workers = int(env)
+        if num_workers and num_workers > 0 and len(self.features) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(max_workers=num_workers,
+                                    thread_name_prefix="zoo-text") as pool:
+                self.features = list(pool.map(transformer.apply,
+                                              self.features))
+        else:
+            self.features = [transformer.apply(f) for f in self.features]
         return self
 
     def get_texts(self):

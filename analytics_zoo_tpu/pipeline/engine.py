@@ -31,10 +31,11 @@ from ..common.zoo_trigger import (And, EveryEpoch, MaxEpoch, MaxIteration,
                                   Or, SeveralIteration, TrainRecord,
                                   ZooTrigger)
 from ..feature.feature_set import (ArrayFeatureSet, FeatureSet, MiniBatch,
-                                   minibatch_len, pad_minibatch,
-                                   PrefetchIterator)
+                                   minibatch_len, pad_minibatch)
+from ..feature.host_pipeline import (DeviceStagingIterator,
+                                     build_host_pipeline)
 from ..utils import file_io, serialization, sharded_checkpoint
-from ..utils.profiling import ProfilerHook, peak_flops
+from ..utils.profiling import InfeedMonitor, ProfilerHook, peak_flops
 
 logger = logging.getLogger("analytics_zoo_tpu.engine")
 
@@ -553,15 +554,21 @@ class SPMDTrainer:
                    checkpoint_trigger, validation_set, validation_trigger,
                    end_trigger=None):
         epoch_seed = self.seed + record.epoch
-        it = train_set.batches(batch_size, shuffle=True, drop_remainder=True,
-                               seed=epoch_seed)
-        it = PrefetchIterator(it, depth=self.ctx.config.prefetch_depth)
+        cfg = self.ctx.config
+        it = build_host_pipeline(
+            train_set, batch_size, shuffle=True, drop_remainder=True,
+            seed=epoch_seed, transform_workers=cfg.transform_workers,
+            prefetch_depth=cfg.prefetch_depth)
+        staging = DeviceStagingIterator(
+            it, self._put_batch, self._put_stacked,
+            depth=cfg.device_ahead, monitor=InfeedMonitor())
         try:
-            self._epoch_loop(it, step_fn, record, batch_size, time.time(),
-                             checkpoint_trigger, validation_set,
+            self._epoch_loop(staging, step_fn, record, batch_size,
+                             time.time(), checkpoint_trigger, validation_set,
                              validation_trigger, end_trigger,
-                             self.ctx.config.log_every_n_steps)
+                             cfg.log_every_n_steps)
         finally:
+            staging.close()
             it.close()
 
     # how many steps one fused dispatch covers in auto mode. On accelerator
@@ -607,80 +614,55 @@ class SPMDTrainer:
             logger.debug("flops cost analysis failed", exc_info=True)
             self.flops_per_step = 0.0
 
-    def _epoch_loop(self, it, step_fn, record, batch_size, t0,
+    def _epoch_loop(self, staging, step_fn, record, batch_size, t0,
                     checkpoint_trigger, validation_set, validation_trigger,
                     end_trigger, log_every):
         cfg = self.ctx.config
         n_batches = 0
         last_loss = None
-        infeed_wait = 0.0
+        monitor = staging.monitor or InfeedMonitor()
         window_t0 = time.perf_counter()
         window_steps = 0
         self._last_log_step = min(self._last_log_step, self.step)
-        host_iter = iter(it)
         profiler = ProfilerHook(cfg.profile_dir, cfg.profile_start_step,
                                 cfg.profile_num_steps) \
             if cfg.profile_dir else None
-
-        def fetch():
-            nonlocal infeed_wait
-            tf = time.perf_counter()
-            try:
-                b = next(host_iter)
-            except StopIteration:
-                return None
-            infeed_wait += time.perf_counter() - tf
-            return b
 
         while True:
             k = min(self._steps_per_dispatch_target(),
                     _iteration_granularity_all(
                         record, end_trigger, checkpoint_trigger,
                         validation_trigger))
-            eof = False
-            if k > 1:
-                chunk: List[MiniBatch] = []
-                while len(chunk) < k:
-                    hb = fetch()
-                    if hb is None:
-                        eof = True
-                        break
-                    chunk.append(hb)
-                if not chunk:
-                    break
-                if len(chunk) == k:
-                    stacked = self._put_stacked(chunk)
-                    multi = self.build_multi_step(k)
-                    self._maybe_record_flops(
-                        multi, (self.params, self.opt_state,
-                                self.net_state, stacked, self.step), k)
-                    (self.params, self.opt_state, self.net_state,
-                     logs) = multi(self.params, self.opt_state,
-                                   self.net_state, stacked, self.step)
-                    done = k
-                else:
-                    # epoch tail shorter than k: reuse the single-step
-                    # program rather than compiling a second scan length
-                    done = 0
-                    for hb in chunk:
-                        batch = self._put_batch(hb)
-                        (self.params, self.opt_state, self.net_state,
-                         logs) = step_fn(self.params, self.opt_state,
-                                         self.net_state, batch,
-                                         self.step + done)
-                        done += 1
-            else:
-                hb = fetch()
-                if hb is None:
-                    break
-                batch = self._put_batch(hb)
+            # batches for this dispatch are already device-resident:
+            # the staging iterator ran device_put while the previous
+            # dispatch was computing
+            chunk = staging.next_chunk(k)
+            if chunk is None:
+                break
+            if chunk.stacked is not None:
+                multi = self.build_multi_step(k)
                 self._maybe_record_flops(
-                    step_fn, (self.params, self.opt_state, self.net_state,
-                              batch, self.step), 1)
-                self.params, self.opt_state, self.net_state, logs = step_fn(
-                    self.params, self.opt_state, self.net_state, batch,
-                    self.step)
-                done = 1
+                    multi, (self.params, self.opt_state,
+                            self.net_state, chunk.stacked, self.step), k)
+                (self.params, self.opt_state, self.net_state,
+                 logs) = multi(self.params, self.opt_state,
+                               self.net_state, chunk.stacked, self.step)
+                done = k
+            else:
+                # single-step path: k == 1, or an epoch tail shorter than
+                # k (reuse the single-step program rather than compiling
+                # a second scan length)
+                done = 0
+                for batch in chunk.singles:
+                    if done == 0:
+                        self._maybe_record_flops(
+                            step_fn, (self.params, self.opt_state,
+                                      self.net_state, batch, self.step), 1)
+                    (self.params, self.opt_state, self.net_state,
+                     logs) = step_fn(self.params, self.opt_state,
+                                     self.net_state, batch,
+                                     self.step + done)
+                    done += 1
             self.step += done
             n_batches += done
             window_steps += done
@@ -696,6 +678,7 @@ class SPMDTrainer:
                 lr = float(self.lr_schedule(self.step))
                 now = time.perf_counter()
                 wall = max(now - window_t0, 1e-9)
+                infeed = monitor.window(window_steps, wall)
                 if self.train_summary is not None:
                     self.train_summary.add_scalar("Loss", loss_v, self.step)
                     self.train_summary.add_scalar("LearningRate", lr,
@@ -704,10 +687,13 @@ class SPMDTrainer:
                         "Throughput", window_steps * batch_size / wall,
                         self.step)
                     self.train_summary.add_scalar(
-                        "StepTimeMs", wall / window_steps * 1e3, self.step)
+                        "StepTimeMs", infeed["step_time_ms"], self.step)
                     self.train_summary.add_scalar(
-                        "InfeedWaitMs", infeed_wait / window_steps * 1e3,
+                        "InfeedWaitMs", infeed["input_wait_ms_per_step"],
                         self.step)
+                    self.train_summary.add_scalar(
+                        "InputBoundFraction",
+                        infeed["input_bound_fraction"], self.step)
                     if self.flops_per_step:
                         peak = peak_flops(
                             getattr(self.ctx.devices[0], "device_kind", ""))
@@ -717,7 +703,6 @@ class SPMDTrainer:
                                 / wall / peak, self.step)
                 window_t0 = now
                 window_steps = 0
-                infeed_wait = 0.0
                 logger.info("epoch %d step %d loss %.5f", record.epoch,
                             self.step, loss_v)
             if checkpoint_trigger is not None and checkpoint_trigger(record):
@@ -726,8 +711,6 @@ class SPMDTrainer:
                 self._run_validation(validation_set, batch_size, record)
             if end_trigger is not None and end_trigger(record):
                 break  # per-iteration end check (parity: endWhen)
-            if eof:
-                break
         if profiler is not None:
             profiler.close()
         # epoch end
@@ -758,17 +741,25 @@ class SPMDTrainer:
         self.ensure_initialized()
         eval_fn = self.build_eval_step()
         acc: Dict[str, Any] = {}
-        for host_batch in PrefetchIterator(
-                data.batches(batch_size, shuffle=False, drop_remainder=False,
-                             pad_remainder=True)):
-            batch = self._put_batch(host_batch)
-            stats = eval_fn(self.params, self.net_state, batch)
-            for name, (num, den) in stats.items():
-                if name in acc:
-                    acc[name] = (acc[name][0] + np.asarray(num),
-                                 acc[name][1] + np.asarray(den))
-                else:
-                    acc[name] = (np.asarray(num), np.asarray(den))
+        cfg = self.ctx.config
+        it = build_host_pipeline(
+            data, batch_size, shuffle=False, drop_remainder=False,
+            pad_remainder=True, transform_workers=cfg.transform_workers,
+            prefetch_depth=cfg.prefetch_depth)
+        staging = DeviceStagingIterator(
+            it, self._put_batch, self._put_stacked, depth=cfg.device_ahead)
+        try:
+            for batch, _host in staging:
+                stats = eval_fn(self.params, self.net_state, batch)
+                for name, (num, den) in stats.items():
+                    if name in acc:
+                        acc[name] = (acc[name][0] + np.asarray(num),
+                                     acc[name][1] + np.asarray(den))
+                    else:
+                        acc[name] = (np.asarray(num), np.asarray(den))
+        finally:
+            staging.close()
+            it.close()
         out = {}
         for m in self.metrics:
             num, den = acc[m.name]
@@ -786,14 +777,22 @@ class SPMDTrainer:
             data = ArrayFeatureSet(data)
         outs: List[Any] = []
         counts: List[int] = []
-        for host_batch in data.batches(batch_size, shuffle=False,
-                                       drop_remainder=False,
-                                       pad_remainder=True):
-            n_real = int(np.sum(host_batch.weights > 0))
-            batch = self._put_batch(host_batch)
-            preds = predict_fn(self.params, self.net_state, batch[0])
-            outs.append(preds)
-            counts.append(n_real)
+        cfg = self.ctx.config
+        it = build_host_pipeline(
+            data, batch_size, shuffle=False, drop_remainder=False,
+            pad_remainder=True, transform_workers=cfg.transform_workers,
+            prefetch_depth=cfg.prefetch_depth)
+        staging = DeviceStagingIterator(
+            it, self._put_batch, self._put_stacked, depth=cfg.device_ahead)
+        try:
+            for batch, host_batch in staging:
+                n_real = int(np.sum(host_batch.weights > 0))
+                preds = predict_fn(self.params, self.net_state, batch[0])
+                outs.append(preds)
+                counts.append(n_real)
+        finally:
+            staging.close()
+            it.close()
         if not outs:
             return None
         multi = isinstance(outs[0], (list, tuple))

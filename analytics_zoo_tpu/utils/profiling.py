@@ -13,12 +13,16 @@ threaded into the train loop (``Topology.scala:1184``) and ad-hoc
   used for MFU reporting.
 * :class:`ProfilerHook` — captures a ``jax.profiler`` trace of a step window
   when ``ZooConfig.profile_dir`` is set.
+* :class:`InfeedMonitor` — windowed accounting of how long the consumer
+  thread blocked waiting for host input, and what fraction of wall time
+  that represents (the input-bound fraction surfaced via TrainSummary).
 """
 
 from __future__ import annotations
 
 import logging
 import os
+import threading
 
 import numpy as np
 
@@ -62,6 +66,42 @@ def device_sync(tree):
     leaf = leaves[0]
     idx = (0,) * getattr(leaf, "ndim", 0)
     _ = np.asarray(leaf[idx] if idx else leaf)
+
+
+class InfeedMonitor:
+    """Accumulates host-input wait time and reduces it per logging window.
+
+    The staging iterator calls :meth:`input_wait` around every blocking
+    fetch from the host pipeline; the train loop calls :meth:`window` once
+    per logging window to obtain averaged scalars and reset the
+    accumulator. ``input_bound_fraction`` is the share of wall time the
+    step loop spent waiting on input — near 0 means compute-bound, near 1
+    means the accelerator is starved and more transform workers / a cache
+    tier / a wider prefetch would pay off.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._wait = 0.0
+        self.total_wait = 0.0
+
+    def input_wait(self, seconds: float):
+        with self._lock:
+            self._wait += seconds
+            self.total_wait += seconds
+
+    def window(self, steps: int, wall_s: float):
+        """Scalars for a window of ``steps`` steps over ``wall_s`` seconds;
+        resets the window accumulator."""
+        with self._lock:
+            wait, self._wait = self._wait, 0.0
+        steps = max(int(steps), 1)
+        wall_s = max(wall_s, 1e-9)
+        return {
+            "input_wait_ms_per_step": wait / steps * 1e3,
+            "step_time_ms": wall_s / steps * 1e3,
+            "input_bound_fraction": min(1.0, wait / wall_s),
+        }
 
 
 class ProfilerHook:

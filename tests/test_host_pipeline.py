@@ -1,0 +1,398 @@
+"""Staged host input pipeline (PR 3): parallel transform pool, device-ahead
+staging, DRAM cache tier, PrefetchIterator fixes, input-bound telemetry."""
+
+import logging
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.feature.common import LambdaPreprocessing
+from analytics_zoo_tpu.feature.feature_set import (FeatureSet, MiniBatch,
+                                                   PrefetchIterator,
+                                                   TransformedFeatureSet)
+from analytics_zoo_tpu.feature.host_pipeline import (DeviceStagingIterator,
+                                                     ParallelTransformIterator,
+                                                     build_host_pipeline)
+
+
+def _array_fs(n=64, dim=4):
+    x = np.arange(n * dim, dtype=np.float32).reshape(n, dim)
+    y = np.arange(n, dtype=np.float32)
+    return FeatureSet.array(x, y)
+
+
+def _double(batch):
+    return MiniBatch(tuple(x * 2.0 for x in batch.inputs),
+                     batch.targets, batch.weights)
+
+
+# ---------------------------------------------------------------------------
+# ParallelTransformIterator
+# ---------------------------------------------------------------------------
+class TestParallelTransformIterator:
+    def test_preserves_order_and_values(self):
+        items = list(range(20))
+
+        def slow_square(i):
+            time.sleep(0.001 * (20 - i) / 20)  # later items finish sooner
+            return i * i
+
+        out = list(ParallelTransformIterator(iter(items), slow_square,
+                                             num_workers=4))
+        assert out == [i * i for i in items]
+
+    def test_bounded_in_flight(self):
+        """No more than workers+2 source items may be consumed ahead of
+        the consumer."""
+        pulled = []
+
+        def source():
+            for i in range(100):
+                pulled.append(i)
+                yield i
+
+        it = ParallelTransformIterator(source(), lambda x: x, num_workers=2)
+        time.sleep(0.05)  # let the pool run: nothing should over-pull
+        assert len(pulled) <= 2 + 2 + 1
+        assert next(it) == 0
+        it.close()
+
+    def test_worker_error_reraised_in_order(self):
+        def fn(i):
+            if i == 3:
+                raise ValueError("boom at 3")
+            return i
+
+        it = ParallelTransformIterator(iter(range(10)), fn, num_workers=4)
+        assert [next(it) for _ in range(3)] == [0, 1, 2]
+        with pytest.raises(ValueError, match="boom at 3"):
+            next(it)
+        # iterator is closed after the error
+        with pytest.raises(StopIteration):
+            next(it)
+
+    def test_close_closes_base_generator(self):
+        closed = []
+
+        def source():
+            try:
+                for i in range(100):
+                    yield i
+            finally:
+                closed.append(True)
+
+        it = ParallelTransformIterator(source(), lambda x: x, num_workers=2)
+        next(it)
+        it.close()
+        assert closed == [True]
+
+
+# ---------------------------------------------------------------------------
+# PrefetchIterator satellite fixes
+# ---------------------------------------------------------------------------
+class TestPrefetchIterator:
+    def test_error_surfaces_before_queue_drains(self):
+        """A producer exception must be raised on the next __next__, not
+        after the queued-up batches and done sentinel drain out."""
+        started = threading.Event()
+
+        def source():
+            yield 1
+            yield 2
+            started.set()
+            raise RuntimeError("producer died")
+
+        it = PrefetchIterator(source(), depth=4)
+        assert started.wait(timeout=5.0)
+        it.thread.join(timeout=5.0)  # error is recorded before exit
+        with pytest.raises(RuntimeError, match="producer died"):
+            next(it)  # items 1 and 2 are still queued — skip them
+
+    def test_error_without_queued_items(self):
+        def source():
+            raise KeyError("immediate")
+            yield  # pragma: no cover
+
+        it = PrefetchIterator(source(), depth=2)
+        with pytest.raises(KeyError):
+            next(it)
+
+    def test_close_joins_worker_and_closes_upstream(self):
+        closed = []
+
+        def source():
+            try:
+                for i in range(10_000):
+                    yield i
+            finally:
+                closed.append(True)
+
+        it = PrefetchIterator(source(), depth=1)
+        next(it)
+        it.close()
+        assert not it.thread.is_alive()
+        assert closed == [True]
+        assert it.q.qsize() == 0  # a blocked producer didn't re-insert
+        with pytest.raises(StopIteration):
+            next(it)
+
+    def test_normal_exhaustion_still_works(self):
+        it = PrefetchIterator(iter(range(5)), depth=2)
+        assert list(it) == [0, 1, 2, 3, 4]
+
+
+# ---------------------------------------------------------------------------
+# TransformedFeatureSet: stats, parallel workers, DRAM cache tier
+# ---------------------------------------------------------------------------
+class TestTransformedFeatureSet:
+    def test_stats_counts_batches_and_seconds(self):
+        fs = _array_fs().transform(LambdaPreprocessing(_double))
+        assert fs.stats().as_dict()["batches_transformed"] == 0
+        list(fs.batches(8))
+        s = fs.stats().as_dict()
+        assert s["batches_transformed"] == 8
+        assert s["transform_seconds"] >= 0.0
+        assert s["cache_hits"] == 0
+
+    def test_parallel_matches_serial(self):
+        base = _array_fs()
+        serial = base.transform(LambdaPreprocessing(_double))
+        par = base.transform(LambdaPreprocessing(_double))
+        a = list(serial.batches(8, shuffle=True, seed=3))
+        b = list(par.batches(8, shuffle=True, seed=3, num_workers=3))
+        assert len(a) == len(b)
+        for ba, bb in zip(a, b):
+            np.testing.assert_array_equal(ba.inputs[0], bb.inputs[0])
+            np.testing.assert_array_equal(ba.targets, bb.targets)
+
+    def test_rdd_dram_enables_cache_and_replays(self):
+        fs = FeatureSet.rdd(
+            _array_fs().transform(LambdaPreprocessing(_double)),
+            memory_type="DRAM")
+        assert isinstance(fs, TransformedFeatureSet)
+        e1 = list(fs.batches(8, shuffle=True, seed=1))
+        assert fs.stats().as_dict()["cache_hits"] == 0
+        e2 = list(fs.batches(8, shuffle=True, seed=2))
+        s = fs.stats().as_dict()
+        assert s["cache_hits"] == 8
+        assert s["batches_transformed"] == 8  # epoch 2 transformed nothing
+        # replay reshuffles at batch granularity: same multiset of batches
+        key = lambda b: b.inputs[0].tobytes()  # noqa: E731
+        assert sorted(key(b) for b in e1) == sorted(key(b) for b in e2)
+        assert [key(b) for b in e1] != [key(b) for b in e2]
+
+    def test_partial_epoch_does_not_commit(self):
+        fs = _array_fs().transform(LambdaPreprocessing(_double)).cache()
+        it = fs.batches(8)
+        next(it)
+        it.close()  # abandon mid-epoch
+        list(fs.batches(8))
+        assert fs.stats().as_dict()["cache_hits"] == 0  # nothing memoized
+
+    def test_over_budget_signature_disables_caching(self, caplog):
+        fs = _array_fs().transform(LambdaPreprocessing(_double)).cache(
+            max_bytes=100)  # one batch is already bigger
+        with caplog.at_level(logging.INFO, "analytics_zoo_tpu.feature"):
+            list(fs.batches(8))
+            list(fs.batches(8))
+        assert fs.stats().as_dict()["cache_hits"] == 0
+        assert any("caching disabled" in r.message for r in caplog.records)
+
+    def test_lru_eviction_across_signatures(self, caplog):
+        one_epoch = 64 * 4 * 4 + 64 * 4 + 64 * 4  # x + y + w bytes
+        fs = _array_fs().transform(LambdaPreprocessing(_double)).cache(
+            max_bytes=int(one_epoch * 1.5))  # fits one signature, not two
+        with caplog.at_level(logging.INFO, "analytics_zoo_tpu.feature"):
+            list(fs.batches(8))
+            list(fs.batches(16))  # second signature evicts the first
+        assert any("evicted signature" in r.message
+                   for r in caplog.records)
+        list(fs.batches(16))
+        assert fs.stats().as_dict()["cache_hits"] == 4  # 16-batch replay
+
+
+# ---------------------------------------------------------------------------
+# DeviceStagingIterator
+# ---------------------------------------------------------------------------
+def _staging(fs, batch=8, depth=2, monitor=None, **kw):
+    it = build_host_pipeline(fs, batch, **kw)
+    return it, DeviceStagingIterator(
+        it, lambda b: ("put", b), lambda bs: ("stacked", list(bs)),
+        depth=depth, monitor=monitor)
+
+
+class TestDeviceStagingIterator:
+    def test_full_chunks_and_tail(self):
+        it, stg = _staging(_array_fs(n=40), batch=8,
+                           drop_remainder=False)  # 5 batches
+        chunks = []
+        while True:
+            c = stg.next_chunk(2)
+            if c is None:
+                break
+            chunks.append(c)
+        stg.close()
+        it.close()
+        # 2 full stacked chunks + 1 single-step tail
+        assert [len(c.hosts) for c in chunks] == [2, 2, 1]
+        assert chunks[0].stacked is not None and chunks[0].singles is None
+        assert chunks[2].stacked is None and len(chunks[2].singles) == 1
+
+    def test_k_change_restages_without_losing_batches(self):
+        it, stg = _staging(_array_fs(n=64), batch=8, depth=3)  # 8 batches
+        seen = []
+        c = stg.next_chunk(3)          # stages ahead at k=3
+        seen.extend(h.inputs[0][0, 0] for h in c.hosts)
+        c = stg.next_chunk(1)          # trigger boundary: shrink to 1
+        seen.extend(h.inputs[0][0, 0] for h in c.hosts)
+        while True:
+            c = stg.next_chunk(2)
+            if c is None:
+                break
+            seen.extend(h.inputs[0][0, 0] for h in c.hosts)
+        stg.close()
+        it.close()
+        ref = [b.inputs[0][0, 0] for b in _array_fs(n=64).batches(8)]
+        assert seen == ref  # every batch exactly once, in order
+
+    def test_monitor_accounts_input_wait(self):
+        from analytics_zoo_tpu.utils.profiling import InfeedMonitor
+
+        monitor = InfeedMonitor()
+        fs = _array_fs().transform(LambdaPreprocessing(
+            lambda b: (time.sleep(0.002), _double(b))[1]))
+        it, stg = _staging(fs, batch=8, monitor=monitor)
+        while stg.next_chunk(1) is not None:
+            pass
+        stg.close()
+        it.close()
+        assert monitor.total_wait > 0.0
+        w = monitor.window(8, 0.1)
+        assert 0.0 <= w["input_bound_fraction"] <= 1.0
+        assert w["input_wait_ms_per_step"] > 0.0
+        # window() resets the accumulator
+        assert monitor.window(8, 0.1)["input_wait_ms_per_step"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# ShardedFileFeatureSet parquet ingestion + striping (satellite coverage)
+# ---------------------------------------------------------------------------
+def test_sharded_file_feature_set_parquet_and_striping(tmp_path):
+    pd = pytest.importorskip("pandas")
+    pytest.importorskip("pyarrow")
+    from analytics_zoo_tpu.feature.feature_set import ShardedFileFeatureSet
+
+    rng = np.random.default_rng(0)
+    paths = []
+    for i in range(4):
+        df = pd.DataFrame({"a": rng.standard_normal(10),
+                           "b": rng.standard_normal(10),
+                           "label": rng.integers(0, 2, 10)})
+        p = str(tmp_path / f"shard{i}.parquet")
+        df.to_parquet(p, index=False)
+        paths.append(p)
+
+    fs = FeatureSet.files(paths, label_col="label")
+    assert fs.size() == 40
+    batches = list(fs.batches(8, drop_remainder=True))
+    assert len(batches) == 5
+    assert batches[0].inputs[0].shape == (8, 2)
+    assert batches[0].inputs[0].dtype == np.float32
+    assert batches[0].targets is not None
+
+    # striping: each of 2 processes sees disjoint halves covering all shards
+    fs0 = ShardedFileFeatureSet(paths, label_col="label",
+                                process_index=0, num_processes=2)
+    fs1 = ShardedFileFeatureSet(paths, label_col="label",
+                                process_index=1, num_processes=2)
+    assert fs0.paths == [paths[0], paths[2]]
+    assert fs1.paths == [paths[1], paths[3]]
+    assert fs0.size() == fs1.size() == 20
+    with pytest.raises(ValueError, match="no shards"):
+        ShardedFileFeatureSet(paths[:1], process_index=1, num_processes=2)
+
+
+def test_sharded_file_feature_set_column_selection(tmp_path):
+    pd = pytest.importorskip("pandas")
+    from analytics_zoo_tpu.feature.feature_set import ShardedFileFeatureSet
+
+    df = pd.DataFrame({"a": [1.0, 2.0], "b": [3.0, 4.0],
+                       "c": [5.0, 6.0], "label": [0, 1]})
+    p = str(tmp_path / "s.csv")
+    df.to_csv(p, index=False)
+    fs = ShardedFileFeatureSet([p], columns=["b"], label_col="label",
+                               shard_per_host=False)
+    (b,) = list(fs.batches(2, drop_remainder=False))
+    np.testing.assert_array_equal(b.inputs[0], [[3.0], [4.0]])
+    np.testing.assert_array_equal(b.targets, [0, 1])
+
+
+# ---------------------------------------------------------------------------
+# engine integration: telemetry scalars + parallel-pipeline determinism
+# ---------------------------------------------------------------------------
+class TestEngineIntegration:
+    def _fit(self, tmp_path, cfg_kw, tb_name):
+        from analytics_zoo_tpu.common.nncontext import (ZooConfig,
+                                                        ZooContext,
+                                                        set_nncontext)
+        from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+        from analytics_zoo_tpu.pipeline.api.keras.models import Sequential
+
+        set_nncontext(None)
+        set_nncontext(ZooContext(ZooConfig(log_every_n_steps=2, **cfg_kw)))
+        try:
+            m = Sequential()
+            m.add(Dense(8, activation="relu", input_shape=(4,)))
+            m.add(Dense(1))
+            m.compile(optimizer="sgd", loss="mse")
+            m.set_tensorboard(str(tmp_path), tb_name)
+            rng = np.random.default_rng(0)
+            x = rng.standard_normal((64, 4)).astype(np.float32)
+            y = rng.standard_normal((64, 1)).astype(np.float32)
+            m.fit(x, y, batch_size=16, nb_epoch=2)
+            scalars = {tag: m.get_train_summary(tag)
+                       for tag in ("InfeedWaitMs", "InputBoundFraction",
+                                   "StepTimeMs", "Throughput")}
+            return [np.asarray(w) for w in m.get_weights()], scalars
+        finally:
+            set_nncontext(None)
+
+    def test_input_telemetry_scalars_emitted(self, tmp_path):
+        _, scalars = self._fit(tmp_path, dict(transform_workers=2), "app")
+        for tag, vals in scalars.items():
+            assert vals, f"no {tag} scalar in the train event file"
+        for _step, _wall, _tag, v in scalars["InputBoundFraction"]:
+            assert 0.0 <= v <= 1.0
+
+    def test_parallel_pipeline_training_is_deterministic(self, tmp_path):
+        w_serial, _ = self._fit(tmp_path / "a", dict(transform_workers=0),
+                                "serial")
+        w_par, _ = self._fit(tmp_path / "b", dict(transform_workers=3,
+                                                  device_ahead=3), "par")
+        for a, b in zip(w_serial, w_par):
+            np.testing.assert_array_equal(a, b)
+
+    def test_fit_on_dram_cached_transform_set(self, tmp_path):
+        from analytics_zoo_tpu.common.nncontext import (ZooConfig,
+                                                        ZooContext,
+                                                        set_nncontext)
+        from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+        from analytics_zoo_tpu.pipeline.api.keras.models import Sequential
+
+        set_nncontext(None)
+        set_nncontext(ZooContext(ZooConfig(transform_workers=2)))
+        try:
+            fs = FeatureSet.rdd(
+                _array_fs().transform(LambdaPreprocessing(
+                    lambda b: MiniBatch(b.inputs,
+                                        b.targets.reshape(-1, 1), b.weights))),
+                memory_type="DRAM")
+            m = Sequential()
+            m.add(Dense(1, input_shape=(4,)))
+            m.compile(optimizer="sgd", loss="mse")
+            m.fit(fs, batch_size=8, nb_epoch=3)
+            assert fs.stats().as_dict()["cache_hits"] > 0
+        finally:
+            set_nncontext(None)
