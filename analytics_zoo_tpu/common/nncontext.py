@@ -83,9 +83,24 @@ class ZooConfig:
     # BENCH_NOTES.md), stay per-step on CPU where dispatch is cheap and
     # the scan's extra compile time dominates. Set 1 to force per-step.
     steps_per_dispatch: int = 0
+    # fused-dispatch size for evaluate()/predict(): k batches per scanned
+    # XLA program with on-device metric accumulation (one host fetch per
+    # chunk instead of per batch). 0 = follow steps_per_dispatch (auto:
+    # fuse on accelerator backends, per-batch on CPU).
+    eval_steps_per_dispatch: int = 0
+    # gradient accumulation: split each logical batch into this many
+    # microbatches inside the compiled step (inner lax.scan, grads
+    # combined weighted by microbatch sample-weight mass before the ONE
+    # optimizer update) — grows effective batch size beyond what fits in
+    # HBM at once. Must divide batch_size. 1 = off.
+    grad_accum_steps: int = 1
     # GPipe microbatches per step when pipeline_parallel > 1 (0 = one per
     # pipe stage)
     pipeline_microbatches: int = 0
+    # JAX persistent compilation cache directory: compiled train/eval scan
+    # programs and serving AOT warmups survive process restarts (restart
+    # pays a cache load, not a recompile). None = off.
+    compile_cache_dir: Optional[str] = None
     # §5.1 profiling: when set, capture a jax.profiler trace of
     # ``profile_num_steps`` steps starting at ``profile_start_step``
     profile_dir: Optional[str] = None
@@ -143,6 +158,7 @@ class ZooContext:
         import jax
 
         self.config = config or ZooConfig.from_env()
+        _maybe_enable_compile_cache(self.config)
         self.devices = list(devices) if devices is not None else jax.devices()
         self.process_index = jax.process_index()
         self.num_processes = jax.process_count()
@@ -201,6 +217,36 @@ class ZooContext:
     @property
     def num_devices(self):
         return len(self.devices)
+
+
+def _maybe_enable_compile_cache(cfg: ZooConfig):
+    """Point JAX's persistent compilation cache at
+    ``ZooConfig.compile_cache_dir`` (env: ``ZOO_TPU_COMPILE_CACHE_DIR``).
+
+    The fused train/eval/predict scan programs and serving AOT warmups are
+    exactly the expensive-to-compile, stable-shape programs the cache is
+    for: a process restart then pays a cache load instead of a recompile.
+    The min-compile-time floor drops to 0 so the small per-batch eval
+    programs cache too."""
+    directory = cfg.compile_cache_dir
+    if not directory:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", str(directory))
+    except Exception as e:  # noqa: BLE001 - cache is an optimization only
+        logger.warning("persistent compilation cache unavailable: %s", e)
+        return
+    try:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception:  # noqa: BLE001 - knob name varies across jax versions
+        pass
+    try:
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:  # noqa: BLE001
+        pass
+    logger.info("persistent compilation cache -> %s", directory)
 
 
 def _can_use_mesh_utils(shape, n):

@@ -28,8 +28,10 @@ from typing import Any, Callable, Iterator, List, Optional, Tuple
 
 import time
 
+import numpy as np
+
 from .feature_set import (FeatureSet, MiniBatch, PrefetchIterator,
-                          TransformedFeatureSet)
+                          TransformedFeatureSet, minibatch_len)
 
 logger = logging.getLogger("analytics_zoo_tpu.feature")
 
@@ -119,6 +121,19 @@ class StagedChunk:
         self.singles = singles
         self.hosts = hosts
 
+    @property
+    def real_counts(self) -> List[int]:
+        """Per-batch count of real (non-padding) samples: zero-weight rows
+        are the pad_remainder filler; weight-less batches are all real.
+        Lets evaluate()/predict() unpad fused outputs without touching the
+        device copies."""
+        counts = []
+        for h in self.hosts:
+            w = h.weights
+            counts.append(minibatch_len(h) if w is None else
+                          int(np.sum(np.asarray(w) > 0)))
+        return counts
+
 
 class DeviceStagingIterator:
     """Keeps up to ``depth`` dispatch chunks already on the device mesh.
@@ -171,7 +186,23 @@ class DeviceStagingIterator:
             hosts.append(hb)
         if not hosts:
             return False
-        if k > 1 and len(hosts) == k:
+        # a full chunk stacks into the (k, batch, ...) super-batch only
+        # when every batch has the same length: a non-dropped, non-padded
+        # remainder (drop_remainder=False, pad_remainder=False) lands mid-
+        # chunk with a shorter batch axis and must take the singles path
+        # rather than np.stack raising
+        uniform = len({minibatch_len(h) for h in hosts}) == 1
+        if k > 1 and len(hosts) == k and uniform:
+            # stacking needs one tree structure across the chunk: a padded
+            # remainder carries a weights array while full batches carry
+            # None — materialize ones (the semantic equivalent of None)
+            # so the stacked super-batch has a single treedef
+            if any(h.weights is not None for h in hosts) and \
+                    not all(h.weights is not None for h in hosts):
+                hosts = [h if h.weights is not None else
+                         MiniBatch(h.inputs, h.targets,
+                                   np.ones(minibatch_len(h), np.float32))
+                         for h in hosts]
             chunk = StagedChunk(k, self._put_stacked(hosts), None, hosts)
         else:
             chunk = StagedChunk(

@@ -17,11 +17,21 @@ class Metric:
     name = "metric"
 
     def batch_stats(self, y_pred, y_true, sample_weight=None):
-        """Return (numerator, denominator) partial sums for one batch."""
+        """Return (numerator, denominator) partial sums for one batch.
+
+        Contract: the returned arrays must be SHAPE-STABLE across batches
+        of the same batch size — the fused eval path carries the
+        accumulator through a ``lax.scan`` over stacked batches, so a
+        metric whose partial-sum shape depended on batch content would
+        fail to trace."""
         raise NotImplementedError
 
     def finalize(self, num, den):
-        return num / max(den, 1e-12)
+        """Reduce accumulated partials to the final value; ``num``/``den``
+        arrive as host arrays summed over every batch (np.maximum keeps
+        this array-safe for vector-valued partials)."""
+        import numpy as np
+        return float(np.asarray(num / np.maximum(den, 1e-12)))
 
     def __repr__(self):
         return self.name

@@ -35,7 +35,8 @@ from ..feature.feature_set import (ArrayFeatureSet, FeatureSet, MiniBatch,
 from ..feature.host_pipeline import (DeviceStagingIterator,
                                      build_host_pipeline)
 from ..utils import file_io, serialization, sharded_checkpoint
-from ..utils.profiling import InfeedMonitor, ProfilerHook, peak_flops
+from ..utils.profiling import (InfeedMonitor, ProfilerHook, inference_window,
+                               peak_flops)
 
 logger = logging.getLogger("analytics_zoo_tpu.engine")
 
@@ -163,6 +164,12 @@ class SPMDTrainer:
         self._auto_k = None      # measured steps-per-dispatch decision
         self._eval_step = None
         self._predict_step = None
+        self._multi_evals: Dict[int, Callable] = {}      # scan length -> fn
+        self._multi_predicts: Dict[int, Callable] = {}   # scan length -> fn
+        # telemetry from the last evaluate()/predict() run (throughput +
+        # infeed scalars; also mirrored into val_summary when attached)
+        self.last_eval_stats: Optional[Dict[str, float]] = None
+        self.last_predict_stats: Optional[Dict[str, float]] = None
         # optional: matmul FLOPs of one train step; enables the MFU scalar
         # in TrainSummary (§5.1)
         self.flops_per_step: Optional[float] = None
@@ -344,12 +351,77 @@ class SPMDTrainer:
                 else "threefry2x32"
         return jax.random.key(self.seed, impl=impl)
 
+    def _grad_accum_steps(self) -> int:
+        return max(1, int(getattr(self.ctx.config, "grad_accum_steps", 1)
+                          or 1))
+
+    @staticmethod
+    def _split_microbatches(batch, accum: int):
+        """Reshape every leaf of a (xs, y, w) batch from ``(n, ...)`` to
+        ``(accum, n // accum, ...)`` for the inner microbatch scan. The
+        batch axis stays data-sharded; the microbatch axis is scanned
+        (device-local reshape when ``n // accum`` still divides dp)."""
+        def split(x):
+            if x is None:
+                return None
+            n = x.shape[0]
+            return x.reshape((accum, n // accum) + x.shape[1:])
+
+        return jax.tree.map(split, tuple(batch),
+                            is_leaf=lambda x: x is None)
+
+    def _accumulated_grads(self, params, net_state, batch, rng, accum):
+        """Gradient accumulation (traced): an inner ``lax.scan`` over
+        ``accum`` microbatches computes per-microbatch grads and combines
+        them weighted by each microbatch's sample-weight mass, so the
+        result equals the full-batch weighted-mean gradient up to
+        reduction order — while peak activation memory is that of ONE
+        microbatch. Runs inside the jitted step (and inside the k-step
+        dispatch scan): no host sync per microbatch.
+
+        Caveat (documented in docs/training.md): non-trainable state
+        (BatchNorm running stats) updates sequentially per microbatch,
+        and the dropout stream folds in the microbatch index — both
+        differ from the equivalent full batch.
+        """
+        micro = self._split_microbatches(batch, accum)
+        mb_len = micro[0][0].shape[1]
+
+        def body(carry, idx_and_mb):
+            g_acc, loss_acc, w_acc, state = carry
+            idx, mbatch = idx_and_mb
+            mrng = jax.random.fold_in(rng, idx)
+            (loss, (_, state)), grads = jax.value_and_grad(
+                lambda p: self._loss_and_preds(p, state, mbatch, mrng,
+                                               True), has_aux=True)(params)
+            w = mbatch[2]
+            sw = jnp.sum(w.astype(jnp.float32)) if w is not None \
+                else jnp.asarray(float(mb_len))
+            g_acc = jax.tree.map(lambda a, g: a + g * sw, g_acc, grads)
+            return (g_acc, loss_acc + loss * sw, w_acc + sw, state), None
+
+        init = (jax.tree.map(jnp.zeros_like, params), jnp.zeros(()),
+                jnp.zeros(()), net_state)
+        (g_acc, loss_acc, w_acc, new_state), _ = jax.lax.scan(
+            body, init, (jnp.arange(accum), micro))
+        denom = jnp.maximum(w_acc, 1e-12)
+        return (loss_acc / denom,
+                jax.tree.map(lambda g: g / denom, g_acc), new_state)
+
     def _step_body(self, params, opt_state, net_state, batch, step):
-        """One optimization step (traced): fwd, bwd, clip, update."""
+        """One optimization step (traced): fwd, bwd, clip, update. With
+        ``grad_accum_steps > 1`` the fwd/bwd runs as an inner microbatch
+        scan (see :meth:`_accumulated_grads`); clip + update still happen
+        exactly once on the combined gradient."""
         rng = jax.random.fold_in(self._train_root_key(), step)
-        (loss, (_, new_state)), grads = jax.value_and_grad(
-            lambda p: self._loss_and_preds(p, net_state, batch, rng,
-                                           True), has_aux=True)(params)
+        accum = self._grad_accum_steps()
+        if accum > 1:
+            loss, grads, new_state = self._accumulated_grads(
+                params, net_state, batch, rng, accum)
+        else:
+            (loss, (_, new_state)), grads = jax.value_and_grad(
+                lambda p: self._loss_and_preds(p, net_state, batch, rng,
+                                               True), has_aux=True)(params)
         if self.frozen_names:
             grads = {k: (jax.tree.map(jnp.zeros_like, g)
                          if k in self.frozen_names else g)
@@ -423,38 +495,99 @@ class SPMDTrainer:
             self._multi_steps[k] = jax.jit(multi_fn)
         return self._multi_steps[k]
 
+    def _eval_stats(self, params, net_state, batch):
+        """Per-batch metric partial sums (traced). Every metric emits a
+        shape-stable ``(num, den)`` pair so the fused eval scan can carry
+        the accumulator on device across batches."""
+        xs, y, w = batch
+        rng = jax.random.PRNGKey(0)
+        loss, (preds, _) = self._loss_and_preds(
+            params, net_state, batch, rng, False) if y is not None else \
+            (jnp.zeros(()), (None, None))
+        stats = {}
+        for m in self.metrics:
+            stats[m.name] = m.batch_stats(preds, y, w)
+        wsum = jnp.sum(w) if w is not None else \
+            jnp.asarray(float(xs[0].shape[0]))
+        stats["loss"] = (loss * wsum, wsum)
+        return stats
+
     def build_eval_step(self):
         if self._eval_step is not None:
             return self._eval_step
 
         def eval_fn(params, net_state, batch):
-            xs, y, w = batch
-            rng = jax.random.PRNGKey(0)
-            loss, (preds, _) = self._loss_and_preds(
-                params, net_state, batch, rng, False) if y is not None else \
-                (jnp.zeros(()), (None, None))
-            stats = {}
-            for m in self.metrics:
-                stats[m.name] = m.batch_stats(preds, y, w)
-            stats["loss"] = (loss * jnp.sum(w), jnp.sum(w))
-            return stats
+            return self._eval_stats(params, net_state, batch)
 
         self._eval_step = jax.jit(eval_fn)
         return self._eval_step
+
+    def build_multi_eval(self, k: int):
+        """k eval batches fused into ONE dispatched program: ``lax.scan``
+        over a stacked ``(k, batch, ...)`` super-batch carrying the metric
+        ``(num, den)`` accumulator ON DEVICE across the scan. evaluate()
+        then pays one host fetch per chunk (the tiny accumulated stats)
+        instead of one blocking fetch per batch — the same dispatch-latency
+        amortization ``build_multi_step`` gives training."""
+        if k in self._multi_evals:
+            return self._multi_evals[k]
+
+        def multi_fn(params, net_state, batches):
+            def one(batch):
+                return self._eval_stats(params, net_state, batch)
+
+            first = jax.tree.map(lambda x: x[0], batches)
+            init = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                                jax.eval_shape(one, first))
+
+            def body(acc, batch):
+                return jax.tree.map(jnp.add, acc, one(batch)), None
+
+            acc, _ = jax.lax.scan(body, init, batches)
+            return acc
+
+        self._multi_evals[k] = jax.jit(multi_fn)
+        return self._multi_evals[k]
+
+    def _predict_out(self, params, net_state, xs):
+        if self.compute_dtype is not None:
+            params = _cast_tree(params, self.compute_dtype)
+            xs = _cast_tree(xs, self.compute_dtype)
+        preds, _ = self.apply_fn(params, list(xs), net_state, False, None)
+        return jax.tree.map(lambda p: p.astype(jnp.float32), preds)
 
     def build_predict_step(self):
         if self._predict_step is not None:
             return self._predict_step
 
         def predict_fn(params, net_state, xs):
-            if self.compute_dtype is not None:
-                params = _cast_tree(params, self.compute_dtype)
-                xs = _cast_tree(xs, self.compute_dtype)
-            preds, _ = self.apply_fn(params, list(xs), net_state, False, None)
-            return jax.tree.map(lambda p: p.astype(jnp.float32), preds)
+            return self._predict_out(params, net_state, xs)
 
         self._predict_step = jax.jit(predict_fn)
         return self._predict_step
+
+    def build_multi_predict(self, k: int):
+        """k inference batches in ONE dispatch: scan over stacked inputs,
+        outputs stay stacked ``(k, batch, ...)`` and device-resident —
+        predict() unpads and concatenates once at the end instead of
+        round-tripping every batch through ``np.asarray``."""
+        if k in self._multi_predicts:
+            return self._multi_predicts[k]
+
+        def multi_fn(params, net_state, xs_stacked):
+            def body(_, xs):
+                return None, self._predict_out(params, net_state, xs)
+
+            _, preds = jax.lax.scan(body, None, xs_stacked)
+            return preds
+
+        self._multi_predicts[k] = jax.jit(multi_fn)
+        return self._multi_predicts[k]
+
+    def invalidate_eval(self):
+        """Drop compiled eval programs (metric set changed)."""
+        self._eval_step = None
+        self._multi_evals = {}
 
     # ------------------------------------------------------------------
     # data placement
@@ -510,6 +643,12 @@ class SPMDTrainer:
               validation_trigger: Optional[ZooTrigger] = None,
               max_epoch: Optional[int] = None):
         self.ensure_initialized()
+        accum = self._grad_accum_steps()
+        if batch_size % accum != 0:
+            raise ValueError(
+                f"grad_accum_steps={accum} must divide batch_size="
+                f"{batch_size}: each logical batch is split into equal "
+                f"microbatches inside the compiled step")
         end_trigger = end_trigger or MaxEpoch(max_epoch or 1)
         checkpoint_trigger = checkpoint_trigger or self.checkpoint_trigger
         if checkpoint_trigger is not None and self.checkpoint_dir is None:
@@ -737,29 +876,88 @@ class SPMDTrainer:
         logger.info("validation @%d: %s", self.step, results)
         return results
 
-    def evaluate(self, data: FeatureSet, batch_size: int) -> Dict[str, float]:
-        self.ensure_initialized()
-        eval_fn = self.build_eval_step()
-        acc: Dict[str, Any] = {}
+    def _eval_dispatch_target(self) -> int:
+        """Fused-dispatch size for evaluate()/predict():
+        ``ZooConfig.eval_steps_per_dispatch`` when set, otherwise the
+        train-side steps_per_dispatch decision (auto: fuse on accelerator
+        backends, per-batch on CPU)."""
+        cfg_k = int(getattr(self.ctx.config, "eval_steps_per_dispatch", 0)
+                    or 0)
+        if cfg_k > 0:
+            return cfg_k
+        return self._steps_per_dispatch_target()
+
+    def _inference_pipeline(self, data, batch_size, monitor):
         cfg = self.ctx.config
         it = build_host_pipeline(
             data, batch_size, shuffle=False, drop_remainder=False,
             pad_remainder=True, transform_workers=cfg.transform_workers,
             prefetch_depth=cfg.prefetch_depth)
         staging = DeviceStagingIterator(
-            it, self._put_batch, self._put_stacked, depth=cfg.device_ahead)
+            it, self._put_batch, self._put_stacked, depth=cfg.device_ahead,
+            monitor=monitor)
+        return it, staging
+
+    def _emit_inference_stats(self, kind, monitor, n_batches, n_samples,
+                              wall_s, fused_dispatches):
+        stats = inference_window(monitor, n_batches, n_samples, wall_s,
+                                 fused_dispatches, kind)
+        if kind == "Eval" and self.val_summary is not None:
+            for name, value in stats.items():
+                self.val_summary.add_scalar(name, value, self.step)
+        logger.info("%s: %.1f samples/s (%d batches, %d fused dispatches, "
+                    "input-bound %.3f)", kind.lower(), stats[
+                        f"{kind}Throughput"], n_batches, fused_dispatches,
+                    stats[f"{kind}InputBoundFraction"])
+        return stats
+
+    def evaluate(self, data: FeatureSet, batch_size: int) -> Dict[str, float]:
+        """Metric means over ``data``. Dispatch-fused: ``k`` batches run as
+        ONE ``lax.scan`` program that accumulates every metric's
+        ``(num, den)`` on device, so the host fetches one tiny stats tree
+        per chunk instead of blocking on every batch."""
+        self.ensure_initialized()
+        k = self._eval_dispatch_target()
+        eval_fn = self.build_eval_step()
+        acc: Dict[str, Any] = {}
+        monitor = InfeedMonitor()
+        it, staging = self._inference_pipeline(data, batch_size, monitor)
+        n_batches = n_samples = fused = 0
+        t0 = time.perf_counter()
         try:
-            for batch, _host in staging:
-                stats = eval_fn(self.params, self.net_state, batch)
-                for name, (num, den) in stats.items():
+            while True:
+                chunk = staging.next_chunk(k)
+                if chunk is None:
+                    break
+                if chunk.stacked is not None:
+                    stats = self.build_multi_eval(chunk.k)(
+                        self.params, self.net_state, chunk.stacked)
+                    fused += 1
+                else:
+                    stats = None
+                    for batch in chunk.singles:
+                        s = eval_fn(self.params, self.net_state, batch)
+                        stats = s if stats is None else jax.tree.map(
+                            jnp.add, stats, s)
+                # ONE host fetch per chunk: the accumulated scalar stats
+                host = jax.device_get(stats)
+                for name, (num, den) in host.items():
                     if name in acc:
-                        acc[name] = (acc[name][0] + np.asarray(num),
-                                     acc[name][1] + np.asarray(den))
+                        acc[name] = (acc[name][0] + num, acc[name][1] + den)
                     else:
                         acc[name] = (np.asarray(num), np.asarray(den))
+                n_batches += len(chunk.hosts)
+                n_samples += sum(chunk.real_counts)
         finally:
             staging.close()
             it.close()
+        if not acc:
+            raise ValueError(
+                "evaluate() got an empty dataset: the FeatureSet produced "
+                "no batches (size 0?)")
+        self.last_eval_stats = self._emit_inference_stats(
+            "Eval", monitor, n_batches, n_samples,
+            time.perf_counter() - t0, fused)
         out = {}
         for m in self.metrics:
             num, den = acc[m.name]
@@ -770,38 +968,65 @@ class SPMDTrainer:
         return out
 
     def predict(self, data, batch_size: int = 128):
-        """Returns stacked predictions as numpy (host)."""
+        """Returns stacked predictions as numpy (host). Dispatch-fused like
+        :meth:`evaluate`: ``k`` batches run as one scanned program whose
+        stacked outputs stay device-resident; the host materializes and
+        unpads everything ONCE at the end instead of syncing per batch."""
         self.ensure_initialized()
+        k = self._eval_dispatch_target()
         predict_fn = self.build_predict_step()
         if isinstance(data, (np.ndarray, list, tuple)):
             data = ArrayFeatureSet(data)
-        outs: List[Any] = []
-        counts: List[int] = []
-        cfg = self.ctx.config
-        it = build_host_pipeline(
-            data, batch_size, shuffle=False, drop_remainder=False,
-            pad_remainder=True, transform_workers=cfg.transform_workers,
-            prefetch_depth=cfg.prefetch_depth)
-        staging = DeviceStagingIterator(
-            it, self._put_batch, self._put_stacked, depth=cfg.device_ahead)
+        # (stacked?, device preds, per-batch real counts) per dispatch;
+        # device arrays accumulate un-fetched until final assembly
+        results: List[Any] = []
+        monitor = InfeedMonitor()
+        it, staging = self._inference_pipeline(data, batch_size, monitor)
+        n_batches = n_samples = fused = 0
+        t0 = time.perf_counter()
         try:
-            for batch, host_batch in staging:
-                n_real = int(np.sum(host_batch.weights > 0))
-                preds = predict_fn(self.params, self.net_state, batch[0])
-                outs.append(preds)
-                counts.append(n_real)
+            while True:
+                chunk = staging.next_chunk(k)
+                if chunk is None:
+                    break
+                counts = chunk.real_counts
+                if chunk.stacked is not None:
+                    preds = self.build_multi_predict(chunk.k)(
+                        self.params, self.net_state, chunk.stacked[0])
+                    results.append((True, preds, counts))
+                    fused += 1
+                else:
+                    for batch, c in zip(chunk.singles, counts):
+                        preds = predict_fn(self.params, self.net_state,
+                                           batch[0])
+                        results.append((False, preds, [c]))
+                n_batches += len(chunk.hosts)
+                n_samples += sum(counts)
         finally:
             staging.close()
             it.close()
-        if not outs:
+        if not results:
             return None
-        multi = isinstance(outs[0], (list, tuple))
+        self.last_predict_stats = self._emit_inference_stats(
+            "Predict", monitor, n_batches, n_samples,
+            time.perf_counter() - t0, fused)
+
+        def segments(out, stacked, counts):
+            a = np.asarray(out)     # single host transfer per dispatch
+            if stacked:
+                return [a[i, :c] for i, c in enumerate(counts)]
+            return [a[:counts[0]]]
+
+        multi = isinstance(results[0][1], (list, tuple))
         if multi:
-            return [np.concatenate([np.asarray(o[i])[:c]
-                                    for o, c in zip(outs, counts)])
-                    for i in range(len(outs[0]))]
-        return np.concatenate([np.asarray(o)[:c]
-                               for o, c in zip(outs, counts)])
+            n_out = len(results[0][1])
+            return [np.concatenate(
+                [seg for stacked, out, counts in results
+                 for seg in segments(out[i], stacked, counts)])
+                for i in range(n_out)]
+        return np.concatenate(
+            [seg for stacked, out, counts in results
+             for seg in segments(out, stacked, counts)])
 
     # ------------------------------------------------------------------
     # checkpointing (§5.4 parity: model + optim state, resumable)

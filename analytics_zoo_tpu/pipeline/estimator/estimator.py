@@ -121,7 +121,9 @@ class Estimator(AbstractEstimator):
                    (validation_method or [])]
         if self.trainer is not None:
             self.trainer.metrics = metrics or self.trainer.metrics
-            self.trainer._eval_step = None
+            # drop ALL compiled eval programs (per-batch and the fused
+            # scan variants) so the new metric set is traced in
+            self.trainer.invalidate_eval()
             return self.trainer
 
         graph = self.model.graph_function()

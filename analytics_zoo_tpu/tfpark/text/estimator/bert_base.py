@@ -135,7 +135,7 @@ class BERTBaseEstimator:
 
             trainer.metrics = [get_metric(m, trainer.loss_fn)
                                for m in metrics]
-            trainer._eval_step = None  # rebuild with the new metric set
+            trainer.invalidate_eval()  # rebuild with the new metric set
         return trainer.evaluate(fs, batch_size=batch_size)
 
     def predict(self, input_fn):

@@ -104,6 +104,24 @@ class InfeedMonitor:
         }
 
 
+def inference_window(monitor: "InfeedMonitor", n_batches: int,
+                     n_samples: int, wall_s: float,
+                     fused_dispatches: int, prefix: str):
+    """Throughput + infeed scalars for one evaluate()/predict() run
+    (``prefix`` = "Eval" | "Predict"); the eval-side telemetry mirror of
+    the train loop's per-window scalars. Consumes (and resets) the
+    monitor's current window."""
+    scalars = monitor.window(n_batches, wall_s)
+    wall_s = max(wall_s, 1e-9)
+    return {
+        f"{prefix}Throughput": n_samples / wall_s,
+        f"{prefix}BatchesPerSec": n_batches / wall_s,
+        f"{prefix}InfeedWaitMs": scalars["input_wait_ms_per_step"],
+        f"{prefix}InputBoundFraction": scalars["input_bound_fraction"],
+        f"{prefix}FusedDispatches": float(fused_dispatches),
+    }
+
+
 class ProfilerHook:
     """Start/stop a jax.profiler trace over a configured step window."""
 

@@ -269,6 +269,15 @@ def test_zooconfig_env_overrides(monkeypatch):
     cfg = ZooConfig.from_env()
     assert cfg.async_checkpoint is True
     assert cfg.nnframes_spill_bytes == 12345
+    # fused-eval / grad-accum / compile-cache fields (Optional[str] passes
+    # through as a plain string)
+    monkeypatch.setenv("ZOO_TPU_GRAD_ACCUM_STEPS", "4")
+    monkeypatch.setenv("ZOO_TPU_EVAL_STEPS_PER_DISPATCH", "8")
+    monkeypatch.setenv("ZOO_TPU_COMPILE_CACHE_DIR", "/tmp/zoo-xla-cache")
+    cfg = ZooConfig.from_env()
+    assert cfg.grad_accum_steps == 4
+    assert cfg.eval_steps_per_dispatch == 8
+    assert cfg.compile_cache_dir == "/tmp/zoo-xla-cache"
 
 
 def test_auto_steps_per_dispatch_stays_per_step_on_cpu():
