@@ -94,6 +94,12 @@ class ZooConfig:
     # optimizer update) — grows effective batch size beyond what fits in
     # HBM at once. Must divide batch_size. 1 = off.
     grad_accum_steps: int = 1
+    # opt-in grad_norm in fit/step logs (removed unconditionally in r4:
+    # every single-step dispatch materialized an unconsumed full-gradient
+    # read + serializing global reduce as a jit output). When True the
+    # norm is logged ONLY when L2-norm clipping already computes it —
+    # never as an extra reduce — and the fused k-step path still DCEs it.
+    log_grad_norm: bool = False
     # GPipe microbatches per step when pipeline_parallel > 1 (0 = one per
     # pipe stage)
     pipeline_microbatches: int = 0

@@ -632,7 +632,22 @@ def _flash_fwd_rule_blhd(q, k, v, kbias, causal, sm_scale,
 
 
 def _flash_bwd_rule_blhd(causal, sm_scale, block_q, block_k, res, do):
+    """Backward via the blhd Pallas kernels. ``ZOO_TPU_FLASH_BWD=xla``
+    recomputes through the reference math instead (materializes O(L^2)
+    probs) — the same escape hatch as the bhld rule; before this it
+    silently no-opped on the default layout."""
     q, k, v, kbias, o, lse = res
+    if os.environ.get("ZOO_TPU_FLASH_BWD", "kernel") == "xla":
+        def ref(q, k, v, kb):
+            # (B, L, H, d) -> the reference's (B, H, L, d); the vjp
+            # transposes the cotangents back for free
+            out = attention_reference(
+                q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3), bias=kb[:, None, None, :],
+                causal=causal, sm_scale=sm_scale)
+            return out.transpose(0, 2, 1, 3)
+
+        return jax.vjp(ref, q, k, v, kbias)[1](do)
     return _flash_backward_blhd(q, k, v, kbias, o, lse, do, causal,
                                 sm_scale, block_q, block_k)
 

@@ -1,5 +1,5 @@
-"""Int8 quantization ops: weight-only PTQ and activation-calibrated
-int8 compute.
+"""Int8 quantization ops: weight-only PTQ, activation-calibrated int8
+compute, and fused requantization chains.
 
 Replaces the compute half of the reference's OpenVINO int8 pipeline
 (``OpenVinoInferenceSupportive.scala:151-343`` ``calibrateTensorflowModel``
@@ -12,18 +12,25 @@ TPU-first design:
 - weights: int8 per-output-channel symmetric (max-abs / 127), stored as
   int8 in HBM — the bandwidth win exists even in weight-only mode.
 - activations: per-tensor symmetric scale learned from a calibration
-  set (max-abs recorded during an eager replay). With both scales the
-  matmul runs ``int8 x int8 -> int32`` via ``lax.dot_general(...,
-  preferred_element_type=int32)``, which XLA:TPU lowers onto the MXU at
-  double the bf16 rate — that is the latency win OpenVINO int8 had and
-  weight-only PTQ gives up (VERDICT r4 missing #3).
-- only matmul-consumed 2D kernels get the int8-compute path; conv /
-  embedding kernels stay weight-only (dequantize-into-consumer), which
-  XLA fuses.
+  set (max-abs recorded during an eager replay). With both scales a
+  matmul/conv runs ``int8 x int8 -> int32`` via
+  ``preferred_element_type=int32``, which XLA:TPU lowers onto the MXU at
+  double the bf16 rate.
+- **requantization chains** (the r5 fix for the measured int8
+  regression): when the chain planner sets ``requant`` on a kernel, the
+  layer's whole epilogue runs in the integer domain — bias is folded
+  into the int32 accumulator (``round(bias / (act_scale * w_scale))``),
+  relu commutes with the positive scale so it applies on int32, and a
+  single per-channel multiply ``requant = act_scale * w_scale /
+  next_act_scale`` rescales int32 straight to the NEXT layer's int8
+  input. Consecutive quantized Dense/Conv layers therefore exchange
+  int8 activations with no f32 dequantize/re-quantize round trip in
+  between — exactly one activation ``div`` (the chain entry) appears in
+  the lowered program, everything else is multiply-only.
 
-The consumer-side dispatch lives in ``matmul``: layers that may receive
-a :class:`QuantTensor` kernel (Dense-family) call ``quant.matmul(x, w)``
-instead of ``jnp.matmul`` — a float kernel passes straight through.
+Layers route their bias + activation INTO ``matmul`` / ``conv2d`` so
+the op owns the epilogue; a float kernel passes straight through with
+identical semantics to the unquantized layer.
 """
 
 from __future__ import annotations
@@ -37,34 +44,57 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["QuantTensor", "quantize_weight", "matmul", "conv2d",
-           "calibrating", "calibration_scales"]
+           "calibrating", "calibration_scales", "out_key",
+           "chain_requant"]
 
 
 @jax.tree_util.register_pytree_node_class
 class QuantTensor:
-    """int8 weights + f32 per-out-channel scale (+ optional activation
-    scale). ``name`` is the flattened param path — the calibration key."""
+    """int8 weights + f32 per-out-channel scale, plus the calibration /
+    chain metadata. ``name`` is the flattened param path — the
+    calibration key.
 
-    def __init__(self, q, scale, act_scale=None, name: str = ""):
+    - ``act_scale``: per-tensor scale of the layer's f32 INPUT (set
+      after calibration; enables int8 x int8 -> int32 compute).
+    - ``out_scale``: per-tensor scale of the layer's f32 OUTPUT
+      (post bias + activation), recorded so the chain planner can
+      validate and plan requantization at load time.
+    - ``requant``: per-out-channel int32 -> int8 requantize multiplier
+      ``act_scale * w_scale / next_layer_act_scale``, precomputed
+      concretely by the chain planner. When set, the op emits int8.
+    - ``qbias``: the layer bias pre-quantized into the int32
+      accumulator domain (``round(bias / (act_scale * w_scale))``),
+      precomputed so the compiled program carries no bias division.
+    """
+
+    def __init__(self, q, scale, act_scale=None, name: str = "",
+                 out_scale=None, requant=None, qbias=None):
         self.q = q
         self.scale = scale
         self.act_scale = act_scale
         self.name = name
+        self.out_scale = out_scale
+        self.requant = requant
+        self.qbias = qbias
 
     # -- pytree --------------------------------------------------------
     def tree_flatten(self):
-        if self.act_scale is None:
-            return (self.q, self.scale), ("noact", self.name)
-        return (self.q, self.scale, self.act_scale), ("act", self.name)
+        children = [self.q, self.scale]
+        mask = []
+        for v in (self.act_scale, self.out_scale, self.requant,
+                  self.qbias):
+            mask.append(v is not None)
+            if v is not None:
+                children.append(v)
+        return tuple(children), (tuple(mask), self.name)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        kind, name = aux
-        if kind == "noact":
-            q, scale = children
-            return cls(q, scale, None, name)
-        q, scale, act = children
-        return cls(q, scale, act, name)
+        mask, name = aux
+        it = iter(children[2:])
+        opt = [next(it) if m else None for m in mask]
+        return cls(children[0], children[1], opt[0], name, opt[1],
+                   opt[2], opt[3])
 
     # -- surface -------------------------------------------------------
     @property
@@ -79,8 +109,25 @@ class QuantTensor:
         return jnp.asarray(self.q, jnp.float32) * self.scale
 
     def with_act_scale(self, act_scale) -> "QuantTensor":
-        return QuantTensor(self.q, self.scale,
-                           jnp.float32(act_scale), self.name)
+        return QuantTensor(self.q, self.scale, jnp.float32(act_scale),
+                           self.name, self.out_scale, self.requant,
+                           self.qbias)
+
+    def with_out_scale(self, out_scale) -> "QuantTensor":
+        return QuantTensor(self.q, self.scale, self.act_scale, self.name,
+                           jnp.float32(out_scale), self.requant,
+                           self.qbias)
+
+    def with_requant(self, requant) -> "QuantTensor":
+        requant = None if requant is None else \
+            jnp.asarray(requant, jnp.float32)
+        return QuantTensor(self.q, self.scale, self.act_scale, self.name,
+                           self.out_scale, requant, self.qbias)
+
+    def with_qbias(self, qbias) -> "QuantTensor":
+        qbias = None if qbias is None else jnp.asarray(qbias, jnp.int32)
+        return QuantTensor(self.q, self.scale, self.act_scale, self.name,
+                           self.out_scale, self.requant, qbias)
 
 
 def quantize_weight(w, name: str = "") -> QuantTensor:
@@ -91,6 +138,21 @@ def quantize_weight(w, name: str = "") -> QuantTensor:
     scale = np.maximum(scale, 1e-12).astype(np.float32)
     q = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
     return QuantTensor(q, scale, None, name)
+
+
+def chain_requant(act_scale, w_scale, next_act_scale) -> np.ndarray:
+    """Concrete per-out-channel int32 -> int8 requantize multiplier.
+
+    Computed at chain-plan time (all scales are concrete floats then) so
+    the compiled program contains no division on the requantize path —
+    the boundary is a single multiply + round + clamp."""
+    return (float(act_scale) * np.asarray(w_scale, np.float64).reshape(-1)
+            / float(next_act_scale)).astype(np.float32)
+
+
+def out_key(name: str) -> str:
+    """Recorder key for a kernel's calibrated OUTPUT range."""
+    return name + "::out"
 
 
 # -- calibration recorder ----------------------------------------------
@@ -106,7 +168,8 @@ _recorder = _Recorder()
 
 class calibrating:
     """Context manager: record max-abs of every activation that feeds a
-    QuantTensor matmul (the model must run EAGERLY inside)."""
+    QuantTensor matmul/conv — and of every such layer's OUTPUT — during
+    an EAGER replay of the model."""
 
     def __enter__(self):
         _recorder.active = True
@@ -123,68 +186,140 @@ def calibration_scales(ranges: dict) -> dict:
     return {k: max(v, 1e-12) / 127.0 for k, v in ranges.items()}
 
 
-# -- the op ------------------------------------------------------------
+# -- the ops -----------------------------------------------------------
 
 def _record_range(x, name):
-    """Eager calibration replay: fold this activation's max-abs into the
-    recorder entry for the kernel named ``name``."""
+    """Eager calibration replay: fold this tensor's max-abs into the
+    recorder entry for ``name``."""
     seen = float(np.max(np.abs(np.asarray(x)))) if x.size else 0.0
     prev = _recorder.ranges.get(name, 0.0)
     _recorder.ranges[name] = max(prev, seen)
 
 
 def _quantize_act(x, act_scale):
-    """Symmetric per-tensor int8 quantization with the calibrated scale."""
+    """Symmetric per-tensor int8 quantization with the calibrated scale.
+    This is the ONLY activation division on a requantization chain — it
+    runs once at chain entry; int8 inputs pass straight through."""
     return jnp.clip(jnp.round(x / act_scale), -127, 127).astype(jnp.int8)
 
 
-def matmul(x, w):
-    """``x @ w`` where ``w`` may be float, weight-only QuantTensor, or a
-    calibrated QuantTensor (true int8 compute)."""
+def _f32_epilogue(y, bias, activation):
+    """The unquantized layer epilogue, verbatim."""
+    if bias is not None:
+        y = y + bias.astype(y.dtype)
+    if activation is not None:
+        y = activation(y)
+    return y
+
+
+def _is_relu(activation) -> bool:
+    return getattr(activation, "name", None) == "relu"
+
+
+def _chainable_act(activation) -> bool:
+    """Activations the integer epilogue can absorb: none, or relu
+    (max(x, 0) commutes with the positive requantize scale)."""
+    return activation is None or _is_relu(activation)
+
+
+def _fold_bias_i32(acc, w, bias, combined, shape=None):
+    """Fold the bias into the int32 accumulator domain:
+    ``round(bias / (act_scale * w_scale[c]))`` per output channel —
+    taken from the precomputed ``w.qbias`` when the planner set it
+    (no division in the compiled program), else derived inline."""
+    qb = w.qbias
+    if qb is None:
+        if bias is None:
+            return acc
+        qb = jnp.round(bias / combined).astype(jnp.int32)
+    if shape is not None:
+        qb = qb.reshape(shape)
+    return acc + qb
+
+
+def _requantize(acc, requant, activation, shape=None):
+    """int32 accumulator -> next layer's int8 input: optional relu in
+    the integer domain, then one per-channel multiply + round + clamp."""
+    if _is_relu(activation):
+        acc = jnp.maximum(acc, 0)
+    m = requant.reshape(shape) if shape is not None else requant
+    return jnp.clip(jnp.round(acc.astype(jnp.float32) * m),
+                    -127, 127).astype(jnp.int8)
+
+
+def matmul(x, w, bias=None, activation=None):
+    """``activation(x @ w + bias)`` where ``w`` may be float, a
+    weight-only QuantTensor, or a calibrated QuantTensor (true int8
+    compute, optionally emitting int8 for the next chained layer)."""
     if not isinstance(w, QuantTensor):
-        return jnp.matmul(x, w)
+        return _f32_epilogue(jnp.matmul(x, w), bias, activation)
     if _recorder.active:
         _record_range(x, w.name)
-        return jnp.matmul(x, w.dequantize())
+        y = _f32_epilogue(jnp.matmul(x, w.dequantize()), bias, activation)
+        _record_range(y, out_key(w.name))
+        return y
     if w.act_scale is None or w.q.ndim != 2:
         # weight-only: upcast fuses into the consumer
-        return jnp.matmul(x, w.dequantize())
-    # calibrated int8 path: quantize the activation with the static
-    # calibration scale, accumulate in int32 on the MXU, rescale once.
-    xq = _quantize_act(x, w.act_scale)
+        return _f32_epilogue(jnp.matmul(x, w.dequantize()), bias,
+                             activation)
+    # calibrated int8 path: int8 inputs arrive pre-quantized from the
+    # upstream chain link; f32 inputs quantize once at chain entry.
+    xq = x if x.dtype == jnp.int8 else _quantize_act(x, w.act_scale)
     acc = jax.lax.dot_general(
-        xq, w.q, (((x.ndim - 1,), (0,)), ((), ())),
+        xq, w.q, (((xq.ndim - 1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32)
-    out_scale = w.act_scale * w.scale.reshape(-1)  # (out,)
-    return acc.astype(jnp.float32) * out_scale
+    combined = w.act_scale * w.scale.reshape(-1)  # (out,)
+    acc = _fold_bias_i32(acc, w, bias, combined)
+    if w.requant is not None and _chainable_act(activation):
+        return _requantize(acc, w.requant, activation)
+    y = acc.astype(jnp.float32) * combined
+    return y if activation is None else activation(y)
 
 
 def conv2d(x, w, window_strides, padding, rhs_dilation,
-           dimension_numbers):
-    """``lax.conv_general_dilated`` where ``w`` may be float, weight-only
-    QuantTensor, or calibrated QuantTensor (int8 conv, int32 accumulate —
-    convs ride the MXU exactly like matmuls, and int8 doubles the v5e
-    rate). Kernel layout must be HWIO (out channels last, matching
-    Convolution2D.build) so the per-out-channel scale broadcasts on the
-    output feature dim."""
+           dimension_numbers, bias=None, activation=None):
+    """``activation(conv(x, w) + bias)`` via ``lax.conv_general_dilated``
+    where ``w`` may be float, weight-only QuantTensor, or calibrated
+    QuantTensor (int8 conv, int32 accumulate — convs ride the MXU
+    exactly like matmuls, and int8 doubles the v5e rate). Kernel layout
+    must be HWIO (out channels last, matching Convolution2D.build) so
+    the per-out-channel scale broadcasts on the output feature dim.
+    ``bias`` is the raw (out,) vector; the op reshapes it onto the
+    output feature axis."""
     conv = functools.partial(
         jax.lax.conv_general_dilated, window_strides=window_strides,
         padding=padding, rhs_dilation=rhs_dilation,
         dimension_numbers=dimension_numbers)
+
+    def bshape(ndim, n):
+        shape = [1] * ndim
+        shape[_out_feature_axis(dimension_numbers)] = n
+        return tuple(shape)
+
+    def f32_path(kernel, xin):
+        y = conv(xin, kernel.astype(xin.dtype))
+        b = None if bias is None else bias.reshape(
+            bshape(y.ndim, bias.shape[0]))
+        return _f32_epilogue(y, b, activation)
+
     if not isinstance(w, QuantTensor):
-        return conv(x, w.astype(x.dtype))
+        return f32_path(w, x)
     if _recorder.active:
         _record_range(x, w.name)
-        return conv(x, w.dequantize().astype(x.dtype))
+        y = f32_path(w.dequantize(), x)
+        _record_range(y, out_key(w.name))
+        return y
     if w.act_scale is None or w.q.ndim != 4:
-        return conv(x, w.dequantize().astype(x.dtype))
-    xq = _quantize_act(x, w.act_scale)
+        return f32_path(w.dequantize(), x)
+    xq = x if x.dtype == jnp.int8 else _quantize_act(x, w.act_scale)
     acc = conv(xq, w.q, preferred_element_type=jnp.int32)
-    out_scale = (w.act_scale * w.scale.reshape(-1)).astype(jnp.float32)
-    c_axis = _out_feature_axis(dimension_numbers)
-    shape = [1] * acc.ndim
-    shape[c_axis] = out_scale.shape[0]
-    return acc.astype(jnp.float32) * out_scale.reshape(shape)
+    combined = (w.act_scale * w.scale.reshape(-1)).astype(jnp.float32)
+    cshape = bshape(acc.ndim, combined.shape[0])
+    acc = _fold_bias_i32(acc, w, bias, combined, shape=cshape)
+    if w.requant is not None and _chainable_act(activation):
+        return _requantize(acc, w.requant, activation, shape=cshape)
+    y = acc.astype(jnp.float32) * combined.reshape(cshape)
+    return y if activation is None else activation(y)
 
 
 def _out_feature_axis(dimension_numbers) -> int:

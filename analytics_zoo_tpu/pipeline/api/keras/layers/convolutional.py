@@ -71,17 +71,16 @@ class Convolution2D(KerasLayer):
 
     def call(self, params, x, training=False, **kw):
         pad = "SAME" if self.border_mode == "same" else "VALID"
-        # quant.conv2d passes float kernels straight through; int8
-        # serving kernels (QuantTensor) take the calibrated-compute path
+        # quant.conv2d owns the whole epilogue: float kernels reproduce
+        # conv + bias + activation verbatim; calibrated int8 kernels
+        # fold bias into the int32 accumulator and may emit int8 for
+        # the next requantization-chain link
         from .....ops import quant
-        y = quant.conv2d(x, params["kernel"], self.subsample, pad,
-                         rhs_dilation=self.dilation,
-                         dimension_numbers=self._dn())
-        if self.bias:
-            b = params["bias"].astype(y.dtype)
-            y = y + (b[None, :, None, None] if self.dim_ordering == "th"
-                     else b)
-        return self.activation(y) if self.activation else y
+        return quant.conv2d(x, params["kernel"], self.subsample, pad,
+                            rhs_dilation=self.dilation,
+                            dimension_numbers=self._dn(),
+                            bias=params["bias"] if self.bias else None,
+                            activation=self.activation)
 
     def compute_output_shape(self, s):
         kh, kw = self.kernel_size

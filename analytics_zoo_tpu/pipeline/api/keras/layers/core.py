@@ -51,15 +51,14 @@ class Dense(KerasLayer):
         return params
 
     def call(self, params, x, training=False, **kw):
-        # quant.matmul passes float kernels straight to jnp.matmul; int8
-        # serving kernels (QuantTensor) take the calibrated-compute path
+        # quant.matmul owns the whole epilogue: float kernels reproduce
+        # matmul + bias + activation verbatim; calibrated int8 kernels
+        # fold bias into the int32 accumulator and may emit int8 for
+        # the next requantization-chain link
         from .....ops import quant
-        y = quant.matmul(x, params["kernel"])
-        if self.bias:
-            y = y + params["bias"]
-        if self.activation is not None:
-            y = self.activation(y)
-        return y
+        return quant.matmul(x, params["kernel"],
+                            bias=params["bias"] if self.bias else None,
+                            activation=self.activation)
 
     def compute_output_shape(self, input_shape):
         return tuple(input_shape[:-1]) + (self.output_dim,)
@@ -662,12 +661,9 @@ class SparseDense(KerasLayer):
         else:
             x = jax.lax.stop_gradient(x)
         from .....ops import quant
-        y = quant.matmul(x, params["kernel"])
-        if self.bias:
-            y = y + params["bias"]
-        if self.activation is not None:
-            y = self.activation(y)
-        return y
+        return quant.matmul(x, params["kernel"],
+                            bias=params["bias"] if self.bias else None,
+                            activation=self.activation)
 
     def compute_output_shape(self, input_shape):
         return tuple(input_shape[:-1]) + (self.output_dim,)

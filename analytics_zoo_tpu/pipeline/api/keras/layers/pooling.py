@@ -19,7 +19,10 @@ def _pool2d(x, window, strides, padding, mode, dim_ordering):
         dims = (1,) + window + (1,)
         strd = (1,) + strides + (1,)
     if mode == "max":
-        init = -jnp.inf
+        # int8 activations flow through max-pool on a requantization
+        # chain: the identity for integer max is iinfo.min, not -inf
+        init = x.dtype.type(jnp.iinfo(x.dtype).min) if jnp.issubdtype(
+            x.dtype, jnp.integer) else -jnp.inf
         out = jax.lax.reduce_window(x, init, jax.lax.max, dims, strd, padding)
         return out
     out = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strd, padding)

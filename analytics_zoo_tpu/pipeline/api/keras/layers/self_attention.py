@@ -95,9 +95,23 @@ def _dp_mesh(batch):
         if tuple(getattr(_jmesh.get_abstract_mesh(), "axis_names",
                          ()) or ()):
             return None          # already inside a shard_map
-    except Exception:  # noqa: BLE001 - private API moved; don't wrap
+    except Exception as e:  # noqa: BLE001 - private API moved; don't wrap
+        global _MESH_PROBE_WARNED
+        if not _MESH_PROBE_WARNED:
+            _MESH_PROBE_WARNED = True
+            import logging
+            logging.getLogger(
+                "analytics_zoo_tpu.pipeline.api.keras").warning(
+                "jax._src.mesh probe failed (%s): cannot detect an "
+                "enclosing shard_map after this jax upgrade, so the "
+                "pure-dp kernel wrap stays DISABLED (XLA fallback, "
+                "correct but slower). Update _dp_mesh for the new jax "
+                "private-API layout.", e)
         return None
     return ctx.mesh
+
+
+_MESH_PROBE_WARNED = False
 
 
 class TransformerLayer(KerasLayer):

@@ -100,6 +100,13 @@ class GradientClipping:
         self.l2_norm = l2_norm
 
     def apply(self, grads):
+        return self.apply_with_norm(grads)[0]
+
+    def apply_with_norm(self, grads):
+        """Clip and also return the pre-clip global norm when L2-norm
+        clipping computes one anyway (else None — callers must not pay
+        an extra full-gradient reduce just to log it)."""
+        gnorm = None
         if self.l2_norm is not None:
             gnorm = optax.global_norm(grads)
             scale = jnp.minimum(1.0, self.l2_norm / (gnorm + 1e-12))
@@ -108,7 +115,7 @@ class GradientClipping:
             lo = -np.inf if self.min_value is None else self.min_value
             hi = np.inf if self.max_value is None else self.max_value
             grads = jax.tree.map(lambda g: jnp.clip(g, lo, hi), grads)
-        return grads
+        return grads, gnorm
 
 
 class SPMDTrainer:
@@ -426,7 +433,7 @@ class SPMDTrainer:
             grads = {k: (jax.tree.map(jnp.zeros_like, g)
                          if k in self.frozen_names else g)
                      for k, g in grads.items()}
-        grads = self.clipping.apply(grads)
+        grads, gnorm = self.clipping.apply_with_norm(grads)
         updates, opt_state = self.tx.update(grads, opt_state, params)
         if self.frozen_names:
             # zeroed grads are not enough: stateful transforms (Adam
@@ -441,9 +448,14 @@ class SPMDTrainer:
         # along "for free": in the fused k-step path XLA dead-code
         # eliminated it, but every SINGLE-step dispatch materialized an
         # unconsumed full-gradient read + serializing global reduce as a
-        # jit output. Norm logging belongs to the clipping path, which
-        # already computes it.
+        # jit output (removed r4). With ``log_grad_norm`` the norm rides
+        # along again, but only when L2-norm clipping already computed
+        # it — never as an extra reduce — and the k-step scan body still
+        # drops (DCEs) it.
         logs = {"loss": loss}
+        if gnorm is not None and \
+                bool(getattr(self.ctx.config, "log_grad_norm", False)):
+            logs["grad_norm"] = gnorm
         return params, opt_state, new_state, logs
 
     def build_train_step(self):
@@ -822,6 +834,10 @@ class SPMDTrainer:
                     self.train_summary.add_scalar("Loss", loss_v, self.step)
                     self.train_summary.add_scalar("LearningRate", lr,
                                                   self.step)
+                    if "grad_norm" in logs:   # opt-in; single-step path
+                        self.train_summary.add_scalar(
+                            "GradNorm", float(np.asarray(logs["grad_norm"])),
+                            self.step)
                     self.train_summary.add_scalar(
                         "Throughput", window_steps * batch_size / wall,
                         self.step)

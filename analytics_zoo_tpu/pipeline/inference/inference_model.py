@@ -25,6 +25,7 @@ TPU redesign:
 
 from __future__ import annotations
 
+import json
 import os
 import queue
 import threading
@@ -143,7 +144,7 @@ class FloatModel(AbstractModel):
 
 
 class QuantizedModel(FloatModel):
-    """int8 PTQ, two tiers (replacing OpenVINO int8,
+    """int8 PTQ, three tiers (replacing OpenVINO int8,
     ``OpenVinoInferenceSupportive.scala:151-343``):
 
     - **weight-only** (construction): matmul-bearing kernels stored int8
@@ -151,16 +152,25 @@ class QuantizedModel(FloatModel):
       smaller weights, the HBM-bandwidth win, no calibration data.
     - **activation-calibrated** (``calibrate(samples)``): the reference's
       ``calibrateTensorflowModel`` equivalent — an eager replay over a
-      calibration set records per-kernel activation ranges, after which
-      Dense-family matmuls run true ``int8 x int8 -> int32`` on the MXU
-      (2x the bf16 rate on v5e). Only 2D ``kernel`` leaves take the
-      compute path; conv/embedding/attention kernels stay weight-only.
+      calibration set records per-kernel input AND output activation
+      ranges, after which Dense/Conv matmuls run true
+      ``int8 x int8 -> int32`` on the MXU (2x the bf16 rate on v5e).
+    - **requantization chains** (planned automatically after
+      calibration, or at load time from exported scales): consecutive
+      quantized layers — possibly separated by int8-transparent layers
+      (Flatten/Reshape/Permute/Dropout/relu/MaxPooling2D) — exchange
+      int8 activations directly: bias folds into the int32 accumulator,
+      relu runs in the integer domain, and one per-channel multiply
+      requantizes straight to the next layer's int8 input. This removes
+      the per-layer ``f32 rescale -> quantize`` round trip that made the
+      r5 int8 path a regression.
     """
 
     #: param leaf names treated as quantizable 2D+ kernels
     KERNEL_KEYS = ("kernel", "w", "qkv_w", "proj_w", "embedding")
 
-    def __init__(self, model, compute_dtype=None, calibration=None):
+    def __init__(self, model, compute_dtype=None, calibration=None,
+                 scales=None):
         super().__init__(model, compute_dtype)
         self._params = self._quantize_tree(self._params)
         # int8-COMPUTE eligibility is decided by the CONSUMER, not the
@@ -170,6 +180,11 @@ class QuantizedModel(FloatModel):
         # upfront or its raw jnp.matmul crashes on the wrapper type.
         self._int8_paths = self._compute_eligible_paths()
         self.calibrated = False
+        #: (producer_layer_name, consumer_layer_name) requant chains
+        self.chains: List[Tuple[str, str]] = []
+        self._scales: Dict[str, float] = {}
+        if scales is not None:
+            self.load_calibration(scales)
         if calibration is not None:
             self.calibrate(calibration)
 
@@ -203,9 +218,10 @@ class QuantizedModel(FloatModel):
         return jax.tree_util.tree_map_with_path(qleaf, params)
 
     def calibrate(self, samples):
-        """Record activation ranges over ``samples`` (a list of
-        input-lists, or a single batched array) and switch 2D Dense
-        kernels to calibrated int8 compute."""
+        """Record input AND output activation ranges over ``samples`` (a
+        list of input-lists, or a single batched array), switch eligible
+        kernels to calibrated int8 compute, and plan requantization
+        chains."""
         if isinstance(samples, np.ndarray):
             samples = [samples]
         with quant.calibrating() as ranges:
@@ -214,14 +230,36 @@ class QuantizedModel(FloatModel):
                           (s if isinstance(s, (list, tuple)) else [s])]
                 # eager (unjitted) replay so quant.matmul sees values
                 self._fwd(self._params, self._state, *inputs)
-        scales = quant.calibration_scales(ranges)
+        self._apply_scales(quant.calibration_scales(ranges))
+        return self
 
+    def load_calibration(self, scales: Dict[str, float]):
+        """Apply previously exported calibration scales (the output of
+        :meth:`export_calibration`) — the load-time half of the
+        calibration round trip: chains are planned from the stored
+        scales with no replay."""
+        self._apply_scales({str(k): float(v) for k, v in scales.items()})
+        return self
+
+    def export_calibration(self) -> Dict[str, float]:
+        """Kernel-name-keyed activation scales (inputs under the kernel
+        path, outputs under ``<path>::out``), JSON-serializable."""
+        return dict(self._scales)
+
+    def _apply_scales(self, scales: Dict[str, float]):
         def apply_scale(leaf):
             if isinstance(leaf, quant.QuantTensor) and \
                     leaf.name in scales and \
                     leaf.name in self._int8_paths and \
                     leaf.q.ndim in (2, 4):
-                return leaf.with_act_scale(scales[leaf.name])
+                # drop any stale chain plan / folded bias —
+                # _plan_chains and _fold_biases rebuild them from the
+                # fresh scales
+                leaf = leaf.with_requant(None).with_qbias(None)
+                leaf = leaf.with_act_scale(scales[leaf.name])
+                out = scales.get(quant.out_key(leaf.name))
+                if out is not None:
+                    leaf = leaf.with_out_scale(out)
             return leaf
 
         # under the compile lock: a concurrent predict must not lower
@@ -231,9 +269,120 @@ class QuantizedModel(FloatModel):
             self._params = jax.tree.map(
                 apply_scale, self._params,
                 is_leaf=lambda l: isinstance(l, quant.QuantTensor))
+            self._scales = dict(scales)
+            self._fold_biases()
+            self._plan_chains()
             self._compiled.clear()
             self.calibrated = True
-        return self
+
+    def _fold_biases(self):
+        """Pre-quantize every calibrated layer's bias into the int32
+        accumulator domain (``round(bias / (act_scale * w_scale))``) so
+        the compiled program adds a constant int32 vector instead of
+        dividing at run time."""
+        for p in self._params.values():
+            if not isinstance(p, dict):
+                continue
+            qt = p.get("kernel")
+            b = p.get("bias")
+            if b is None or not isinstance(qt, quant.QuantTensor) or \
+                    qt.act_scale is None or \
+                    qt.name not in self._int8_paths or \
+                    qt.q.ndim not in (2, 4):
+                continue
+            combined = float(qt.act_scale) * \
+                np.asarray(qt.scale, np.float64).reshape(-1)
+            qb = np.clip(np.round(np.asarray(b, np.float64) / combined),
+                         -(2 ** 31) + 1, 2 ** 31 - 1)
+            p["kernel"] = qt.with_qbias(qb)
+
+    # -- requantization-chain planner ----------------------------------
+    def _node_kernel(self, node):
+        """The node's calibrated int8-compute QuantTensor kernel, or
+        None when the node is not on the int8 path."""
+        p = self._params.get(node.layer.name)
+        if not isinstance(p, dict):
+            return None
+        qt = p.get("kernel")
+        if isinstance(qt, quant.QuantTensor) and qt.act_scale is not None \
+                and qt.name in self._int8_paths and qt.q.ndim in (2, 4):
+            return qt
+        return None
+
+    @staticmethod
+    def _int8_transparent(layer) -> bool:
+        """Layers an int8 activation can flow through unchanged in value
+        semantics: pure reshapes/transposes, inference-mode dropout,
+        relu (commutes with the positive scale), and max-pooling
+        (selects, never mixes). Exact types only — AveragePooling2D
+        subclasses MaxPooling2D but averages, which would need integer
+        rounding treatment."""
+        from ..api.keras.layers import (Activation, Dropout, Flatten,
+                                        MaxPooling2D, Permute, Reshape)
+        if type(layer) in (Flatten, Reshape, Permute, Dropout,
+                           MaxPooling2D):
+            return True
+        if type(layer) is Activation:
+            return getattr(layer.fn, "name", None) == "relu"
+        return False
+
+    def _plan_chains(self):
+        """Walk the graph: for every calibrated quantized layer whose
+        single consumer (across int8-transparent layers) is another
+        calibrated quantized layer, precompute the int32 -> int8
+        requantize multiplier ``act_scale * w_scale /
+        consumer_act_scale`` and store it on the producer kernel — the
+        compiled program then passes int8 between the two with no f32
+        dequantize in between."""
+        graph = self._graph
+        consumers: Dict[int, list] = {}
+        for node in graph.nodes:
+            for v in node.inputs:
+                if v.node is not None:
+                    consumers.setdefault(v.node.id, []).append(node)
+        output_ids = {v.node.id for v in graph.outputs
+                      if v.node is not None}
+        # a layer used by >1 node shares ONE kernel; a per-consumer
+        # requant multiplier cannot live on it
+        counts: Dict[int, int] = {}
+        for n in graph.nodes:
+            counts[id(n.layer)] = counts.get(id(n.layer), 0) + 1
+        shared = {lid for lid, c in counts.items() if c > 1}
+
+        def chain_target(node):
+            cur = node
+            while True:
+                if cur.id in output_ids:
+                    return None  # model outputs must stay f32
+                cons = consumers.get(cur.id, [])
+                if len(cons) != 1 or len(cons[0].inputs) != 1:
+                    return None  # fan-out / merges stay f32
+                nxt = cons[0]
+                if self._node_kernel(nxt) is not None:
+                    return None if id(nxt.layer) in shared else nxt
+                if not self._int8_transparent(nxt.layer):
+                    return None
+                cur = nxt
+
+        self.chains = []
+        for node in graph.nodes:
+            qt = self._node_kernel(node)
+            if qt is None or qt.requant is not None or \
+                    id(node.layer) in shared:
+                continue
+            act = getattr(node.layer, "activation", None)
+            if not quant._chainable_act(act):
+                continue
+            target = chain_target(node)
+            if target is None:
+                continue
+            tgt = self._node_kernel(target)
+            requant = quant.chain_requant(
+                qt.act_scale, qt.scale, tgt.act_scale)
+            self._params[node.layer.name]["kernel"] = \
+                qt.with_requant(requant)
+            self.chains.append((node.layer.name, target.layer.name))
+        return self.chains
 
 
 # Back-compat alias: r3/r4 weight-only leaves are now ops.quant.QuantTensor
@@ -313,13 +462,16 @@ class InferenceModel:
     do_load = load
 
     def load_keras_net(self, net, quantize: bool = False,
-                       calibration=None):
+                       calibration=None, scales=None):
         """Load an in-memory KerasNet/ZooModel. ``calibration``: optional
-        sample inputs enabling int8 *compute* (implies quantize)."""
+        sample inputs enabling int8 *compute* (implies quantize);
+        ``scales``: previously exported calibration scales (dict),
+        planning requantization chains without a replay."""
         if hasattr(net, "model") and not hasattr(net, "graph_function"):
             net = net.model
-        if quantize or calibration is not None:
-            self._install(QuantizedModel(net, calibration=calibration))
+        if quantize or calibration is not None or scales is not None:
+            self._install(QuantizedModel(net, calibration=calibration,
+                                         scales=scales))
         else:
             self._install(FloatModel(net))
         return self
@@ -377,16 +529,58 @@ class InferenceModel:
         self._install(QuantizedModel(net) if quantize else FloatModel(net))
         return self
 
-    def load_quantized(self, model_path: str):
-        """int8 weight-only PTQ of a native model directory — the XLA
-        stand-in for doLoadOpenVINO int8 IRs."""
+    #: file name probed for exported calibration scales inside a model
+    #: directory (written by :meth:`save_calibration`)
+    CALIBRATION_FILE = "calibration.json"
+
+    def load_quantized(self, model_path: str,
+                       calibration_path: Optional[str] = None):
+        """int8 PTQ of a native model directory — the XLA stand-in for
+        doLoadOpenVINO int8 IRs.  ``calibration_path`` (or a
+        ``calibration.json`` saved next to the model) supplies exported
+        activation scales, so the requantization chains are planned at
+        load time with no calibration replay."""
         from ..api.keras.models import KerasNet
 
-        self._install(QuantizedModel(
-            KerasNet.load_model(self._resolve_model_dir(model_path))))
+        model_dir = self._resolve_model_dir(model_path)
+        if calibration_path is None:
+            default = os.path.join(model_dir, self.CALIBRATION_FILE)
+            if os.path.exists(default):
+                calibration_path = default
+        scales = None
+        if calibration_path is not None:
+            with open(calibration_path) as f:
+                scales = json.load(f)
+        self._install(QuantizedModel(KerasNet.load_model(model_dir),
+                                     scales=scales))
         return self
 
     do_load_openvino = load_quantized
+
+    def save_calibration(self, path: str):
+        """Persist the loaded quantized model's calibration scales
+        (JSON) — the save half of the calibration round trip; point
+        ``load_quantized(calibration_path=...)`` back at it (or drop it
+        in the model directory as ``calibration.json``)."""
+        if not isinstance(self.model, QuantizedModel) or \
+                not self.model.calibrated:
+            raise RuntimeError("save_calibration() needs a calibrated "
+                               "quantized model")
+        with open(path, "w") as f:
+            json.dump(self.model.export_calibration(), f, indent=2)
+        return self
+
+    def load_calibration(self, scales):
+        """Apply exported calibration scales (a dict or a JSON path) to
+        the loaded quantized model."""
+        if not isinstance(self.model, QuantizedModel):
+            raise RuntimeError("load_calibration() needs a quantized "
+                               "model (load with quantize=True)")
+        if isinstance(scales, str):
+            with open(scales) as f:
+                scales = json.load(f)
+        self.model.load_calibration(scales)
+        return self
 
     # ------------------------------------------------------------------
     # predict (doPredict :622-656 + retrieveModel :710)

@@ -324,11 +324,14 @@ def _registry_op(workdir: str, op: str, **kw) -> int:
         if op == "deploy":
             mv = reg.deploy(kw.get("model"), path=kw["path"], load=False,
                             activate=kw.get("activate", True) and
-                            kw.get("canary_weight") is None)
+                            kw.get("canary_weight") is None,
+                            quantize=bool(kw.get("quantize", False)),
+                            calibration=kw.get("calibration"))
             if kw.get("canary_weight") is not None:
                 reg.set_canary(mv.name, mv.version,
                                float(kw["canary_weight"]))
-            print(f"registered {mv.key} (offline; loads on next start)")
+            print(f"registered {mv.key} [{mv.dtype}] (offline; loads on "
+                  f"next start)")
         elif op == "promote":
             mv = reg.promote(kw["model"], int(kw["version"]), load=False)
             print(f"promoted {mv.key} (offline; loads on next start)")
@@ -418,6 +421,15 @@ def main(argv=None) -> int:
     ap.add_argument("--no-activate", action="store_true",
                     help="deploy: register + warm but do not route "
                          "traffic (promote later)")
+    ap.add_argument("--quantize", action="store_true",
+                    help="deploy: load the version as int8 (fused "
+                         "requantization chains when calibration scales "
+                         "are available) — typically combined with "
+                         "--weight for a side-by-side int8 canary")
+    ap.add_argument("--calibration", default=None,
+                    help="deploy --quantize: exported calibration-scales "
+                         "JSON (defaults to calibration.json inside the "
+                         "model directory when present)")
     args = ap.parse_args(argv)
     workdir = os.path.abspath(args.dir)
     if args.command == "init":
@@ -437,7 +449,9 @@ def main(argv=None) -> int:
             return 1
         return _registry_op(workdir, "deploy", model=args.model,
                             path=args.path, canary_weight=args.weight,
-                            activate=not args.no_activate)
+                            activate=not args.no_activate,
+                            quantize=args.quantize,
+                            calibration=args.calibration)
     if args.command == "promote":
         if not args.model or args.version is None:
             print("promote needs --model and --version", file=sys.stderr)

@@ -68,6 +68,14 @@ class DeployError(RegistryError):
     moved — the previous version keeps serving."""
 
 
+def _is_int8(model: Optional[InferenceModel]) -> bool:
+    """Whether a pre-loaded InferenceModel carries an int8 backend."""
+    from ..pipeline.inference.inference_model import QuantizedModel
+
+    return model is not None and \
+        isinstance(getattr(model, "model", None), QuantizedModel)
+
+
 class ModelVersion:
     """One immutable numbered version of a named model.
 
@@ -79,11 +87,19 @@ class ModelVersion:
 
     def __init__(self, name: str, version: int,
                  model: Optional[InferenceModel] = None,
-                 path: Optional[str] = None):
+                 path: Optional[str] = None, dtype: str = "f32",
+                 calibration: Optional[str] = None):
         self.name = name
         self.version = int(version)
         self.model = model
         self.path = path
+        #: compute dtype of this version ("f32" | "int8") — part of the
+        #: dispatch key so an int8 canary never shares a batch with its
+        #: f32 baseline
+        self.dtype = dtype or "f32"
+        #: exported calibration-scales path for int8 versions (enables
+        #: requantization-chain planning at (re)load time)
+        self.calibration = calibration
         #: registered -> warming -> ready -> retired | failed | cold
         self.state = "registered"
         self.created = time.time()
@@ -127,6 +143,7 @@ class ModelVersion:
     def stats(self) -> dict:
         return {"state": self.state,
                 "path": self.path,
+                "dtype": self.dtype,
                 "created": self.created,
                 "requests": self.requests,
                 "errors": self.errors,
@@ -206,7 +223,8 @@ class ModelRegistry:
                path: Optional[str] = None,
                warmup: Optional[Callable[[InferenceModel], object]] = None,
                activate: bool = True, load: bool = True,
-               drain_timeout: float = 10.0) -> ModelVersion:
+               drain_timeout: float = 10.0, quantize: bool = False,
+               calibration: Optional[str] = None) -> ModelVersion:
         """Register the next version of ``name`` and (optionally) swap
         traffic onto it.
 
@@ -218,27 +236,37 @@ class ModelRegistry:
         leaves routing untouched.  ``load=False`` records the version in
         the manifest without loading (offline deploy; the next
         :meth:`recover` loads it).
+
+        ``quantize`` deploys the version as int8: loaded through
+        :meth:`InferenceModel.load_quantized` with ``calibration``
+        (exported scales JSON; defaults to a ``calibration.json`` inside
+        the model directory) so requantization chains are planned at
+        load time. The version carries ``dtype="int8"`` — its own
+        dispatch keys, AOT warmup, and compile-cache entries — so an
+        int8 build can canary side-by-side against its f32 baseline.
         """
         name = name or self.default_model
         if model is None and path is None:
             raise ValueError("deploy needs a loaded model or a path")
+        dtype = "int8" if quantize or _is_int8(model) else "f32"
         with self._lock:
             versions = self._models.setdefault(name, {})
             version = max(versions, default=0) + 1
-            mv = ModelVersion(name, version, model=model, path=path)
+            mv = ModelVersion(name, version, model=model, path=path,
+                              dtype=dtype, calibration=calibration)
             versions[version] = mv
         if not load:
             if activate:
                 with self._lock:
                     self._active[name] = version
-            self._event(f"registered {mv.key} (path={path}; loads on "
-                        f"next start)")
+            self._event(f"registered {mv.key} [{mv.dtype}] (path={path}; "
+                        f"loads on next start)")
             self._save()
             return mv
         phase = "load"
         try:
             if mv.model is None:
-                mv.model = self._loader(mv.path)
+                mv.model = self._load_version(mv)
             mv.state = "warming"
             phase = "warmup"
             if warmup is not None:
@@ -260,6 +288,15 @@ class ModelRegistry:
             self._save()
         return mv
 
+    def _load_version(self, mv: ModelVersion) -> InferenceModel:
+        """Load a version with its recorded dtype: int8 versions go
+        through the quantized loader (+ calibration scales when
+        exported), f32 through the configured loader."""
+        if mv.dtype == "int8":
+            return InferenceModel().load_quantized(
+                mv.path, calibration_path=mv.calibration)
+        return self._loader(mv.path)
+
     def _ensure_loaded(self, mv: ModelVersion,
                        warmup: Optional[Callable] = None):
         if mv.model is not None:
@@ -269,7 +306,7 @@ class ModelRegistry:
                 f"{mv.key} has no loaded model and no path to load from")
         mv.state = "warming"
         try:
-            mv.model = self._loader(mv.path)
+            mv.model = self._load_version(mv)
             if warmup is not None:
                 warmup(mv.model)
         except Exception as e:
@@ -500,7 +537,9 @@ class ModelRegistry:
                     "canary": can.stats() if can is not None else None,
                     "versions": [
                         {"version": mv.version, "path": mv.path,
-                         "state": mv.state, "created": mv.created}
+                         "state": mv.state, "created": mv.created,
+                         "dtype": mv.dtype,
+                         "calibration": mv.calibration}
                         for mv in sorted(versions.values(),
                                          key=lambda m: m.version)]}
         file_io.write_bytes_atomic(
@@ -525,7 +564,9 @@ class ModelRegistry:
                 versions = self._models.setdefault(name, {})
                 for vd in m.get("versions", []):
                     v = int(vd["version"])
-                    mv = ModelVersion(name, v, path=vd.get("path"))
+                    mv = ModelVersion(name, v, path=vd.get("path"),
+                                      dtype=vd.get("dtype", "f32"),
+                                      calibration=vd.get("calibration"))
                     mv.created = vd.get("created", mv.created)
                     mv.state = "cold"
                     versions[v] = mv
@@ -709,12 +750,15 @@ class RegistryControlServer:
                 mv = self.registry.deploy(
                     req.get("model"), path=req["path"],
                     warmup=self._warmup_fn(),
-                    activate=activate and weight is None)
+                    activate=activate and weight is None,
+                    quantize=bool(req.get("quantize", False)),
+                    calibration=req.get("calibration"))
                 if weight is not None:
                     self.registry.set_canary(mv.name, mv.version,
                                              float(weight))
                 return {"ok": True, "model": mv.name,
-                        "version": mv.version, "state": mv.state}
+                        "version": mv.version, "state": mv.state,
+                        "dtype": mv.dtype}
             if op == "promote":
                 mv = self.registry.promote(
                     req["model"], int(req["version"]),

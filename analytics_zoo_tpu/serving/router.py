@@ -92,7 +92,10 @@ class RoutedClusterServing(ClusterServing):
             except Exception as e:  # unknown model/version -> dead-letter
                 dead.append((uri, str(e) or repr(e), model, version))
                 continue
-            groups.setdefault((mv.name, mv.version),
+            # (model, version, dtype) + the bucket picked per group is
+            # the full dispatch key: an int8 canary version never shares
+            # a batch (or a compile-cache entry) with its f32 baseline
+            groups.setdefault((mv.name, mv.version, mv.dtype),
                               (mv, []))[1].append((t_in, uri, arr))
         if dead:
             self._dead_letter(dead)
@@ -122,7 +125,7 @@ class RoutedClusterServing(ClusterServing):
         self.summary.record_stage("dispatch", time.perf_counter() - t0)
         self._count(batches=1)
         with self._ctr_lock:
-            self.bucket_counts[f"{mv.key}:{bucket}"] += 1
+            self.bucket_counts[f"{mv.key}:{bucket}:{mv.dtype}"] += 1
         write_q.put((t_ins, uris, n, t0, out, mv))
 
     # -- write stage: per-version accounting + refcount release --------
@@ -184,14 +187,20 @@ class RoutedClusterServing(ClusterServing):
 
     def deploy(self, name: Optional[str] = None, model=None,
                path: Optional[str] = None, activate: bool = True,
-               canary_weight: Optional[float] = None, warmup: bool = True):
+               canary_weight: Optional[float] = None, warmup: bool = True,
+               quantize: bool = False,
+               calibration: Optional[str] = None):
         """Deploy into this server's registry with its bucket warmup;
-        ``canary_weight`` deploys as a canary instead of activating."""
+        ``canary_weight`` deploys as a canary instead of activating;
+        ``quantize`` deploys an int8 version (with optional exported
+        ``calibration`` scales) for side-by-side comparison against the
+        f32 baseline."""
         mv = self.registry.deploy(
             name, model=model, path=path,
             warmup=self.registry_warmup() if warmup else None,
             activate=activate and canary_weight is None,
-            drain_timeout=self.helper.drain_timeout)
+            drain_timeout=self.helper.drain_timeout,
+            quantize=quantize, calibration=calibration)
         if canary_weight is not None:
             self.registry.set_canary(mv.name, mv.version,
                                      float(canary_weight))
@@ -211,7 +220,7 @@ class RoutedClusterServing(ClusterServing):
                     logger.warning("warmup: %s bucket %d failed: %s",
                                    mv.key, b, e)
                     continue
-                times[f"{mv.key}:{b}"] = t[b]
+                times[f"{mv.key}:{b}:{mv.dtype}"] = t[b]
         return times
 
     def pipeline_stats(self) -> dict:

@@ -314,6 +314,30 @@ def test_flash_bwd_kernel_matches_xla_escape_hatch(monkeypatch):
                                    rtol=2e-3, atol=2e-3)
 
 
+def test_flash_bwd_blhd_escape_hatch(monkeypatch):
+    """ZOO_TPU_FLASH_BWD=xla must take effect on the default blhd layout
+    too (it used to silently no-op there) and agree with the blhd kernel
+    backward, including the bias cotangent path through the layout
+    transposes."""
+    from analytics_zoo_tpu.ops.attention import flash_attention_blhd
+
+    monkeypatch.setenv("ZOO_TPU_PALLAS_INTERPRET", "1")
+    monkeypatch.setenv("ZOO_TPU_FORCE_PALLAS", "1")
+    q, k, v = _qkv(b=1, h=2, l=128, d=64, seed=9)
+    q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))   # -> blhd
+    bias = jnp.zeros((1, 1, 1, 128)).at[:, :, :, 100:].set(-10000.0)
+
+    def loss(q, k, v):
+        return (flash_attention_blhd(q, k, v, bias=bias) ** 2).mean()
+
+    g_kernel = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    monkeypatch.setenv("ZOO_TPU_FLASH_BWD", "xla")
+    g_xla = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_kernel, g_xla):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-3)
+
+
 def test_per_shape_probe_silent_fallback(monkeypatch):
     """A shape whose kernel compile fails must silently route to the XLA
     reference path (per-shape probe, r4); ZOO_TPU_FORCE_PALLAS=1 must skip

@@ -1,0 +1,236 @@
+"""Int8 requantization-chain tests (the int8-v2 acceptance gates).
+
+- the chain planner links consecutive quantized Dense/Conv kernels
+  through int8-transparent glue (MaxPooling2D/Flatten/Dropout), and the
+  compiled program exchanges int8 activations with NO per-layer f32
+  dequant: exactly ONE division (the entry quantize) survives in a
+  fully chained program (bias is pre-folded into the int32 accumulator,
+  requantize multiplies by a precomputed scale);
+- fan-out stops a chain (the producer must emit f32 for its consumers);
+- the calibration round trip (export -> JSON -> ``load_quantized``)
+  plans identical chains and reproduces predictions bit-exactly;
+- serving deploys an int8 version side-by-side with its f32 baseline
+  under distinct ``(model, version, dtype)`` dispatch keys, persisted
+  through manifest recovery.
+"""
+
+import json
+
+import jax
+import numpy as np
+
+from analytics_zoo_tpu.ops import quant
+from analytics_zoo_tpu.pipeline.api.keras.layers import (Convolution2D,
+                                                         Dense, Dropout,
+                                                         Flatten, Input,
+                                                         MaxPooling2D, merge)
+from analytics_zoo_tpu.pipeline.api.keras.models import Model, Sequential
+from analytics_zoo_tpu.pipeline.inference import InferenceModel
+
+
+def _chained_cnn():
+    m = Sequential()
+    m.add(Convolution2D(8, 3, 3, activation="relu", border_mode="same",
+                        input_shape=(3, 16, 16), name="c1"))
+    m.add(MaxPooling2D(pool_size=(2, 2), name="mp"))
+    m.add(Convolution2D(8, 3, 3, activation="relu", name="c2"))
+    m.add(Flatten(name="fl"))
+    m.add(Dropout(0.2, name="dr"))
+    m.add(Dense(16, activation="relu", name="d1"))
+    m.add(Dense(4, activation="relu", name="out"))
+    m.compile(optimizer="sgd", loss="mse")
+    return m
+
+
+def _calibrated(m, shape, n=3, seed=0):
+    rng = np.random.default_rng(seed)
+    calib = [rng.standard_normal((4,) + shape).astype(np.float32)
+             for _ in range(n)]
+    return InferenceModel().load_keras_net(m, calibration=calib)
+
+
+def test_cnn_chain_plan_and_int8_exchange():
+    """Chains thread conv->pool->conv->flatten->dropout->dense->dense;
+    the jaxpr carries one int32-accumulating op per kernel, one int8
+    requantize per chain edge plus the entry quantize, and exactly one
+    division — any extra div is a per-layer f32 dequant leaking back."""
+    m = _chained_cnn()
+    inf = _calibrated(m, (3, 16, 16))
+    qm = inf.model
+    assert qm.chains == [("c1", "c2"), ("c2", "d1"), ("d1", "out")]
+
+    x = np.random.default_rng(1).standard_normal(
+        (2, 3, 16, 16)).astype(np.float32)
+    text = str(jax.make_jaxpr(qm._fwd)(qm._params, qm._state, x))
+    assert text.count("preferred_element_type=int32") == 4, text[:2000]
+    n_i8 = text.count("convert_element_type[new_dtype=int8")
+    assert n_i8 == len(qm.chains) + 1, text[:2000]   # edges + entry
+    assert text.count(" div ") == 1, text[:2000]
+
+    # sanity parity on untrained random weights (quant noise compounds
+    # through a 5-kernel chain on gaussian activations; the strict
+    # <0.1% gate runs on a trained model in the accuracy test below)
+    ref = np.asarray(InferenceModel().load_keras_net(m).predict(x))
+    got = np.asarray(inf.predict(x))
+    denom = float(np.mean(np.abs(ref))) or 1.0
+    assert float(np.mean(np.abs(got - ref))) / denom < 0.5
+
+
+def test_fanout_stops_chain():
+    """A producer whose output feeds two consumers must NOT requantize:
+    each consumer calibrated its own input range and the merge needs
+    f32 — the planner only chains single-consumer edges."""
+    inp = Input(shape=(8,))
+    h = Dense(16, activation="relu", name="fan_d1")(inp)
+    a = Dense(8, activation="relu", name="fan_a")(h)
+    b = Dense(8, activation="relu", name="fan_b")(h)
+    out = Dense(2, name="fan_out")(merge([a, b], mode="concat"))
+    m = Model(inp, out)
+    m.compile(optimizer="sgd", loss="mse")
+
+    inf = _calibrated(m, (8,))
+    qm = inf.model
+    starts = {src for src, _ in qm.chains}
+    assert "fan_d1" not in starts            # fan-out: two consumers
+    assert "fan_a" not in starts             # consumer is multi-input
+    assert "fan_b" not in starts
+    k = qm._params["fan_d1"]["kernel"]
+    assert isinstance(k, quant.QuantTensor) and k.requant is None
+
+
+def test_chain_parity_accuracy_gate():
+    """<0.1% absolute accuracy drop vs f32 on a classifier the chains
+    fully cover (the reference's OpenVINO-int8 acceptance bar)."""
+    rng = np.random.default_rng(7)
+    centers = rng.standard_normal((3, 8)) * 3.0
+    ytr = rng.integers(0, 3, 600).astype(np.int32)
+    xtr = (centers[ytr] + rng.standard_normal((600, 8))).astype(np.float32)
+    yte = rng.integers(0, 3, 300).astype(np.int32)
+    xte = (centers[yte] + rng.standard_normal((300, 8))).astype(np.float32)
+
+    m = Sequential()
+    m.add(Dense(32, activation="relu", input_shape=(8,), name="g1"))
+    m.add(Dense(32, activation="relu", name="g2"))
+    m.add(Dense(3, activation="softmax", name="gout"))
+    m.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    m.fit(xtr, ytr, batch_size=64, nb_epoch=12)
+    f32_acc = np.mean(np.argmax(m.predict(xte, batch_size=300), 1) == yte)
+    assert f32_acc > 0.9, f"golden model underfit: {f32_acc}"
+
+    inf = InferenceModel()
+    inf.load_keras_net(m, calibration=[xtr[i:i + 64]
+                                       for i in range(0, 256, 64)])
+    assert inf.model.chains == [("g1", "g2"), ("g2", "gout")]
+    int8_acc = np.mean(np.argmax(np.asarray(inf.predict(xte)), 1) == yte)
+    assert f32_acc - int8_acc <= 0.001, (f32_acc, int8_acc)
+
+
+def test_calibration_round_trip_file(tmp_path):
+    """calibrate -> save_calibration -> load_quantized(model dir with
+    calibration.json) must re-plan the SAME chains with no replay and
+    reproduce predictions bit-exactly."""
+    m = _chained_cnn()
+    inf = _calibrated(m, (3, 16, 16))
+    x = np.random.default_rng(2).standard_normal(
+        (4, 3, 16, 16)).astype(np.float32)
+    want = np.asarray(inf.predict(x))
+    chains = list(inf.model.chains)
+
+    model_dir = tmp_path / "saved"
+    m.save_model(str(model_dir))
+    inf.save_calibration(str(model_dir / InferenceModel.CALIBRATION_FILE))
+
+    inf2 = InferenceModel().load_quantized(str(model_dir))   # auto-detect
+    assert inf2.model.calibrated
+    assert inf2.model.chains == chains
+    np.testing.assert_array_equal(np.asarray(inf2.predict(x)), want)
+
+    # explicit calibration_path (file saved elsewhere) works the same
+    side = tmp_path / "scales.json"
+    side.write_text(json.dumps(inf.model.export_calibration()))
+    inf3 = InferenceModel().load_quantized(str(model_dir),
+                                           calibration_path=str(side))
+    np.testing.assert_array_equal(np.asarray(inf3.predict(x)), want)
+
+
+def _tiny_image_model():
+    m = Sequential()
+    m.add(Flatten(input_shape=(3, 8, 8)))
+    m.add(Dense(5, activation="softmax", name="head"))
+    m.compile("sgd", "sparse_categorical_crossentropy")
+    return m
+
+
+def test_serving_int8_version_routing(tmp_path):
+    """An int8 deploy rides its own (model, version, dtype) dispatch
+    key next to the f32 baseline, and the dtype + calibration survive
+    manifest recovery."""
+    from analytics_zoo_tpu.serving import (ClusterServingHelper,
+                                           InProcessStreamQueue,
+                                           InputQueue, ModelRegistry,
+                                           OutputQueue,
+                                           RoutedClusterServing)
+    from analytics_zoo_tpu.pipeline.inference.inference_model import \
+        QuantizedModel
+
+    m = _tiny_image_model()
+    model_dir = tmp_path / "m"
+    m.save_model(str(model_dir))
+    rng = np.random.default_rng(3)
+    calib = [rng.standard_normal((4, 3, 8, 8)).astype(np.float32)
+             for _ in range(3)]
+    inf = InferenceModel().load_keras_net(m, calibration=calib)
+    inf.save_calibration(str(model_dir / InferenceModel.CALIBRATION_FILE))
+
+    root = str(tmp_path / "reg")
+    registry = ModelRegistry(root=root)
+    backend = InProcessStreamQueue()
+    helper = ClusterServingHelper(config={
+        "data": {"image_shape": "3, 8, 8"},
+        "params": {"batch_size": 4, "top_n": 0}})
+    serving = RoutedClusterServing(registry, helper=helper,
+                                   backend=backend)
+    mv1 = serving.deploy("m", path=str(model_dir))
+    mv2 = serving.deploy("m", path=str(model_dir), quantize=True)
+    assert (mv1.dtype, mv2.dtype) == ("f32", "int8")
+    assert isinstance(mv2.model.model, QuantizedModel)
+    assert mv2.model.model.calibrated        # calibration.json picked up
+
+    serving.start()
+    x = rng.standard_normal((3, 8, 8)).astype(np.float32)
+    in_q, out_q = InputQueue(backend=backend), OutputQueue(backend=backend)
+    uris = []
+    try:
+        for i in range(6):       # explicit pins: both dtypes get traffic
+            for v in (1, 2):
+                uri = f"q-{v}-{i}"
+                uris.append(uri)
+                in_q.enqueue(uri, model="m", version=str(v), input=x)
+        got = out_q.wait_all(uris, timeout=30.0)
+    finally:
+        serving.stop()
+    assert len(got) == len(uris)
+    keys = list(serving.bucket_counts)
+    assert any(k.startswith("m:v1:") and k.endswith(":f32") for k in keys)
+    assert any(k.startswith("m:v2:") and k.endswith(":int8") for k in keys)
+
+    # restart: dtype comes back from the manifest and the int8 version
+    # reloads through load_quantized
+    reg2 = ModelRegistry(root=root).recover(load=True)
+    r1, r2 = reg2._models["m"][1], reg2._models["m"][2]
+    assert (r1.dtype, r2.dtype) == ("f32", "int8")
+    assert isinstance(r2.model.model, QuantizedModel)
+    out = np.asarray(r2.model.predict(np.zeros((2, 3, 8, 8), np.float32)))
+    assert out.shape[0] == 2
+
+
+def test_registry_stats_report_dtype():
+    from analytics_zoo_tpu.serving import ModelRegistry
+
+    m = _tiny_image_model()
+    reg = ModelRegistry()
+    inf = InferenceModel().load_keras_net(m, quantize=True)
+    mv = reg.deploy("q", model=inf)
+    assert mv.dtype == "int8"                # inferred from the model
+    stats = reg.stats()["models"]["q"]["versions"][1]
+    assert stats["dtype"] == "int8"

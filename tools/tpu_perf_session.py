@@ -685,13 +685,33 @@ def leg_baseline_rows():
     try:
         set_nncontext(ZooContext(ZooConfig()))
         from analytics_zoo_tpu.models.recommendation import WideAndDeep
+        # importlib from explicit file paths — a bare ``import common``
+        # via sys.path injection is collision-prone (any installed or
+        # sibling ``common`` module wins silently). The example imports
+        # ``common`` itself, so register OUR load under that name for
+        # the duration, restoring whatever was there.
+        import importlib.util
+
+        def _load_from(path, name):
+            spec = importlib.util.spec_from_file_location(name, path)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            return mod
+
         ex_dir = os.path.join(os.path.dirname(OUT), "examples")
-        sys.path.insert(0, ex_dir)
+        _ex_common = _load_from(os.path.join(ex_dir, "common.py"),
+                                "zoo_example_common")
+        prev_common = sys.modules.get("common")
+        sys.modules["common"] = _ex_common
         try:
-            import common as _ex_common
-            import recommendation_wide_and_deep as _wd_ex
+            _wd_ex = _load_from(
+                os.path.join(ex_dir, "recommendation_wide_and_deep.py"),
+                "zoo_example_recommendation_wide_and_deep")
         finally:
-            sys.path.remove(ex_dir)
+            if prev_common is None:
+                sys.modules.pop("common", None)
+            else:
+                sys.modules["common"] = prev_common
         n, batch = (512, 64) if smoke else (16384, 1024)
         rows = _ex_common.census_like(n, seed=0)
         inputs = _wd_ex.featurize(rows)
