@@ -294,17 +294,71 @@ def set_nncontext(ctx: Optional[ZooContext]):
     _global_context = ctx
 
 
+_distributed_joined = False
+
+
 def _maybe_init_distributed():
-    """Join the multi-host JAX runtime when launched on a TPU pod slice.
+    """Join the multi-host JAX runtime when launched under ``zoo-launch``
+    (or any launcher that sets the ``ZOO_TPU_*`` topology contract).
 
     Replaces the reference's Spark-driver/executor bootstrap: coordination
     rides the JAX coordination service over DCN, data-plane collectives ride
-    ICI.
+    ICI.  A **partial** contract is a config error, not a single-process
+    run — silently defaulting the rank to 0 made every mis-launched worker
+    fight over the coordinator as process 0 (the old env dance's worst
+    failure mode), so incomplete/inconsistent env raises instead.
     """
+    global _distributed_joined
+
+    coord = os.environ.get("ZOO_TPU_COORDINATOR")
+    nproc_env = os.environ.get("ZOO_TPU_NUM_PROCESSES")
+    pid_env = os.environ.get("ZOO_TPU_PROCESS_ID")
+    if not coord:
+        if nproc_env is not None or pid_env is not None:
+            raise RuntimeError(
+                "partial distributed env: ZOO_TPU_NUM_PROCESSES/"
+                "ZOO_TPU_PROCESS_ID are set but ZOO_TPU_COORDINATOR is "
+                "not. Set all three (host:port, world size, rank) or "
+                "none — `zoo-launch --hosts N train.py` does this for "
+                "you.")
+        return
+    missing = [name for name, val in
+               (("ZOO_TPU_NUM_PROCESSES", nproc_env),
+                ("ZOO_TPU_PROCESS_ID", pid_env)) if val is None]
+    if missing:
+        raise RuntimeError(
+            f"partial distributed env: ZOO_TPU_COORDINATOR={coord!r} but "
+            f"{' and '.join(missing)} missing. Set all three or none — "
+            f"`zoo-launch --hosts N train.py` does this for you.")
+    try:
+        num_processes = int(nproc_env)
+        process_id = int(pid_env)
+    except ValueError as e:
+        raise RuntimeError(
+            f"bad distributed env: ZOO_TPU_NUM_PROCESSES={nproc_env!r} / "
+            f"ZOO_TPU_PROCESS_ID={pid_env!r} must be integers") from e
+    if num_processes < 1 or not 0 <= process_id < num_processes:
+        raise RuntimeError(
+            f"inconsistent distributed env: ZOO_TPU_PROCESS_ID="
+            f"{process_id} must be in [0, ZOO_TPU_NUM_PROCESSES="
+            f"{num_processes})")
+    if _distributed_joined:
+        return  # jax.distributed.initialize is once-per-process
     import jax
 
-    if os.environ.get("ZOO_TPU_COORDINATOR"):
-        jax.distributed.initialize(
-            coordinator_address=os.environ["ZOO_TPU_COORDINATOR"],
-            num_processes=int(os.environ.get("ZOO_TPU_NUM_PROCESSES", "1")),
-            process_id=int(os.environ.get("ZOO_TPU_PROCESS_ID", "0")))
+    try:
+        # CPU multi-process collectives need the gloo transport (the
+        # default XLA CPU client refuses cross-process programs with
+        # "Multiprocess computations aren't implemented"); harmless on
+        # TPU where collectives ride ICI. Must land before backend init.
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # noqa: BLE001 - knob name varies across jax versions
+        pass
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _distributed_joined = True
+    logger.info(
+        "joined distributed topology: process %d/%d via coordinator %s "
+        "(%d local / %d global devices)", process_id, num_processes,
+        coord, jax.local_device_count(), jax.device_count())

@@ -16,6 +16,7 @@ import math
 import queue
 import threading
 import time
+import weakref
 from collections import OrderedDict
 from typing import (Any, Callable, Dict, Iterator, List, Optional, Sequence,
                     Tuple)
@@ -142,6 +143,21 @@ class FeatureSet:
     @staticmethod
     def disk(paths: Sequence[str], num_slice: int = 1) -> "DiskFeatureSet":
         return DiskFeatureSet(list(paths), num_slice=num_slice)
+
+    @staticmethod
+    def from_dataset(uri: str, columns: Optional[Sequence[str]] = None,
+                     label_col: Optional[str] = None, num_slice: int = 1,
+                     process_index: Optional[int] = None,
+                     num_processes: Optional[int] = None
+                     ) -> "FeatureSet":
+        """Distributed ingestion over a partitioned dataset directory
+        (parquet/arrow/npz/csv shards; ``file``/``hdfs``/``gs``/``s3``
+        URIs): each host streams a disjoint, deterministic, size-balanced
+        shard subset (see :mod:`feature.dataset`)."""
+        from .dataset import ShardedDatasetFeatureSet
+        return ShardedDatasetFeatureSet(
+            uri, columns=columns, label_col=label_col, num_slice=num_slice,
+            process_index=process_index, num_processes=num_processes)
 
     @staticmethod
     def files(paths: Sequence[str], num_slice: int = 1,
@@ -644,6 +660,38 @@ class ShardedFileFeatureSet(DiskFeatureSet):
         return out
 
 
+# Live pipeline-stage registry: every closeable infeed stage
+# (PrefetchIterator, ParallelTransformIterator, DeviceStagingIterator)
+# registers itself so launcher-driven shutdown (zoo-launch SIGTERM ->
+# launcher.worker handler) can close them all — a killed worker must not
+# hang in concurrent.futures' atexit join on still-busy transform-pool
+# threads. WeakSet: normal close()/GC drops entries automatically.
+_LIVE_PIPELINES: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def register_pipeline(obj) -> None:
+    """Track a closeable pipeline stage for process-wide teardown."""
+    _LIVE_PIPELINES.add(obj)
+
+
+def shutdown_all_pipelines() -> int:
+    """Close every live pipeline stage; returns how many were closed.
+
+    Idempotent and safe mid-stream: each stage's ``close()`` already
+    handles being called while a producer is running.
+    """
+    closed = 0
+    for obj in list(_LIVE_PIPELINES):
+        try:
+            obj.close()
+            closed += 1
+        except Exception:  # noqa: BLE001 - teardown must not raise
+            logger.warning("pipeline close failed during shutdown",
+                           exc_info=True)
+        _LIVE_PIPELINES.discard(obj)
+    return closed
+
+
 class PrefetchIterator:
     """Background-thread prefetch of host minibatches (double buffering the
     host side; ``jax.device_put`` overlap covers the device side). Replaces
@@ -655,6 +703,7 @@ class PrefetchIterator:
         self.done = object()
         self.error = None
         self._stopped = False
+        register_pipeline(self)
         self.thread = threading.Thread(target=self._worker, daemon=True)
         self.thread.start()
 

@@ -31,7 +31,8 @@ import time
 import numpy as np
 
 from .feature_set import (FeatureSet, MiniBatch, PrefetchIterator,
-                          TransformedFeatureSet, minibatch_len)
+                          TransformedFeatureSet, minibatch_len,
+                          register_pipeline)
 
 logger = logging.getLogger("analytics_zoo_tpu.feature")
 
@@ -59,6 +60,7 @@ class ParallelTransformIterator:
         self._max_in_flight = max_in_flight or self.num_workers + 2
         self._exhausted = False
         self._closed = False
+        register_pipeline(self)
         self._fill()
 
     def _fill(self):
@@ -160,6 +162,7 @@ class DeviceStagingIterator:
         self._staged: deque = deque()       # StagedChunk, oldest first
         self._pending: deque = deque()      # host batches awaiting staging
         self._eof = False
+        register_pipeline(self)
 
     def _fetch_host(self) -> Optional[MiniBatch]:
         if self._pending:

@@ -1,0 +1,19 @@
+"""zoo-launch: the ``init_spark_on_yarn`` analogue for TPU-host jobs.
+
+The reference brings a cluster up with one call — ``init_spark_on_yarn``
+submits executors, propagates conf/env and wires the driver (pyzoo
+``zoo/common/nncontext.py``). This package does the same for the
+multi-controller JAX runtime: ``zoo-launch --hosts N train.py`` spawns N
+host processes, picks a coordinator address, propagates the
+``ZOO_TPU_COORDINATOR/NUM_PROCESSES/PROCESS_ID`` env contract that
+``init_nncontext`` consumes, fans worker logs into prefixed streams and
+supervises child health — replacing the hand-set env dance.
+
+Kept import-light on purpose: the supervisor never imports jax, so the
+CLI starts instantly and survives on hosts where the accelerator runtime
+is broken (the workers are the ones that need it).
+"""
+
+from .launch import HostSpec, LaunchError, launch, parse_hosts_file
+
+__all__ = ["HostSpec", "LaunchError", "launch", "parse_hosts_file"]

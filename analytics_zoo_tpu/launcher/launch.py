@@ -1,0 +1,256 @@
+"""Multi-process job launcher: spawn, propagate env, fan in logs, supervise.
+
+Local multi-process today (one worker per ``--hosts`` slot on this
+machine — the CPU-backend test topology and the single-TPU-host
+multi-process layout); the ``--hosts-file`` surface is already parsed so
+ssh/pod-slice placement can slot in without changing the contract.
+
+Env contract handed to every worker (consumed by
+``common/nncontext._maybe_init_distributed``):
+
+- ``ZOO_TPU_COORDINATOR``   host:port of process 0's coordination service
+- ``ZOO_TPU_NUM_PROCESSES`` world size
+- ``ZOO_TPU_PROCESS_ID``    this worker's rank
+
+Failure policy (``on_failure``):
+
+- ``kill-all`` (default): first nonzero exit terminates the remaining
+  workers (SIGTERM, then SIGKILL after ``grace_s``) — fail fast, the
+  collective is dead anyway once one member is gone;
+- ``report``: let the surviving workers run to completion and report the
+  failure at the end.
+
+Either way :func:`launch` returns the **first nonzero exit code** (0 when
+every worker succeeded).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shlex
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, IO, List, NamedTuple, Optional, Sequence
+
+logger = logging.getLogger("analytics_zoo_tpu.launcher")
+
+
+class LaunchError(RuntimeError):
+    """Launcher-level misconfiguration (bad hosts file, no workers...)."""
+
+
+class HostSpec(NamedTuple):
+    """One placement row: hostname + number of worker slots on it."""
+
+    host: str
+    slots: int
+
+
+_LOCAL_HOSTS = ("localhost", "127.0.0.1", "::1")
+
+
+def parse_hosts_file(path: str) -> List[HostSpec]:
+    """Parse an MPI-style hosts file: ``host [slots]`` per line, ``#``
+    comments. Only localhost rows are launchable today; remote rows
+    parse fine but :func:`launch` rejects them with a clear error so the
+    file format is already the forward-compatible surface."""
+    specs: List[HostSpec] = []
+    with open(path) as f:
+        for lineno, raw in enumerate(f, 1):
+            line = raw.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            if len(parts) > 2:
+                raise LaunchError(
+                    f"{path}:{lineno}: expected 'host [slots]', got "
+                    f"{raw.strip()!r}")
+            slots = 1
+            if len(parts) == 2:
+                try:
+                    slots = int(parts[1])
+                except ValueError as e:
+                    raise LaunchError(
+                        f"{path}:{lineno}: bad slot count "
+                        f"{parts[1]!r}") from e
+                if slots < 1:
+                    raise LaunchError(
+                        f"{path}:{lineno}: slots must be >= 1")
+            specs.append(HostSpec(parts[0], slots))
+    if not specs:
+        raise LaunchError(f"hosts file {path} has no host entries")
+    return specs
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _pump(pid: int, pipe: IO[str], stream, lock: threading.Lock,
+          prefix: bool):
+    """Fan one worker's merged stdout/stderr into ``stream``, one line at
+    a time under ``lock`` so workers never interleave mid-line."""
+    tag = f"[worker-{pid}] "
+    for line in iter(pipe.readline, ""):
+        with lock:
+            stream.write((tag if prefix else "") + line)
+            stream.flush()
+    pipe.close()
+
+
+def _worker_env(base: Dict[str, str], coordinator: str, num_processes: int,
+                process_id: int, extra: Optional[Dict[str, str]]) -> dict:
+    env = dict(base)
+    # workers must import the same package tree the supervisor runs from,
+    # regardless of their cwd (the repo may not be pip-installed)
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    parts = [pkg_root] + [p for p in
+                          env.get("PYTHONPATH", "").split(os.pathsep) if p]
+    env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(parts))
+    if extra:
+        env.update({str(k): str(v) for k, v in extra.items()})
+    env["ZOO_TPU_COORDINATOR"] = coordinator
+    env["ZOO_TPU_NUM_PROCESSES"] = str(num_processes)
+    env["ZOO_TPU_PROCESS_ID"] = str(process_id)
+    return env
+
+
+def launch(script_argv: Sequence[str], num_hosts: Optional[int] = None,
+           hosts_file: Optional[str] = None,
+           env: Optional[Dict[str, str]] = None,
+           on_failure: str = "kill-all",
+           coordinator_port: Optional[int] = None,
+           grace_s: float = 10.0, stream=None, prefix: bool = True,
+           python: Optional[str] = None) -> int:
+    """Run ``script_argv`` (a train script + its args) as a multi-process
+    job. See module docstring for the env contract and failure policy.
+    Returns the first nonzero worker exit code, or 0."""
+    if on_failure not in ("kill-all", "report"):
+        raise LaunchError(
+            f"on_failure must be 'kill-all' or 'report', got "
+            f"{on_failure!r}")
+    if not script_argv:
+        raise LaunchError("no train script given")
+    if hosts_file is not None:
+        specs = parse_hosts_file(hosts_file)
+        remote = [s.host for s in specs if s.host not in _LOCAL_HOSTS]
+        if remote:
+            raise LaunchError(
+                f"remote hosts not supported yet (only localhost rows "
+                f"launch; got {remote}); run zoo-launch on each host with "
+                f"ZOO_TPU_COORDINATOR pointing at host 0, or use "
+                f"--hosts N for local multi-process")
+        world = sum(s.slots for s in specs)
+        if num_hosts is not None and num_hosts != world:
+            raise LaunchError(
+                f"--hosts {num_hosts} disagrees with hosts file "
+                f"({world} slots)")
+    else:
+        world = num_hosts if num_hosts is not None else 1
+    if world < 1:
+        raise LaunchError(f"need >= 1 worker, got {world}")
+    stream = stream if stream is not None else sys.stdout
+    python = python or sys.executable
+    port = coordinator_port or _free_port()
+    coordinator = f"127.0.0.1:{port}"
+    base_env = dict(os.environ)
+
+    cmd_tail = [os.fspath(a) for a in script_argv]
+    logger.info("zoo-launch: %d worker(s), coordinator %s, on-failure=%s: "
+                "%s", world, coordinator, on_failure,
+                " ".join(shlex.quote(c) for c in cmd_tail))
+    lock = threading.Lock()
+    procs: List[subprocess.Popen] = []
+    pumps: List[threading.Thread] = []
+    try:
+        for pid in range(world):
+            p = subprocess.Popen(
+                [python, "-m", "analytics_zoo_tpu.launcher.worker",
+                 *cmd_tail],
+                env=_worker_env(base_env, coordinator, world, pid, env),
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, bufsize=1)
+            procs.append(p)
+            t = threading.Thread(target=_pump,
+                                 args=(pid, p.stdout, stream, lock, prefix),
+                                 daemon=True)
+            t.start()
+            pumps.append(t)
+    except BaseException:
+        _terminate_all(procs, grace_s)
+        raise
+
+    first_rc = 0
+    failed_pid: Optional[int] = None
+    killed = False
+    pending = set(range(world))
+    while pending:
+        for pid in sorted(pending):
+            rc = procs[pid].poll()
+            if rc is None:
+                continue
+            pending.discard(pid)
+            if rc != 0:
+                with lock:
+                    stream.write(
+                        f"[zoo-launch] worker-{pid} exited rc={rc}\n")
+                    stream.flush()
+                if first_rc == 0:
+                    first_rc, failed_pid = rc, pid
+                if on_failure == "kill-all" and not killed and pending:
+                    with lock:
+                        stream.write(
+                            f"[zoo-launch] on-failure=kill-all: "
+                            f"terminating {len(pending)} remaining "
+                            f"worker(s)\n")
+                        stream.flush()
+                    _terminate_all([procs[q] for q in pending], grace_s)
+                    killed = True
+        if pending:
+            time.sleep(0.05)
+    for t in pumps:
+        t.join(timeout=5.0)
+    rcs = [p.returncode for p in procs]
+    if first_rc != 0:
+        with lock:
+            stream.write(
+                f"[zoo-launch] job FAILED: first failure worker-"
+                f"{failed_pid} rc={first_rc}; exit codes {rcs}\n")
+            stream.flush()
+    else:
+        with lock:
+            stream.write(
+                f"[zoo-launch] job complete: {world} worker(s) exited 0\n")
+            stream.flush()
+    return first_rc
+
+
+def _terminate_all(procs: Sequence[subprocess.Popen], grace_s: float):
+    """SIGTERM everything still alive (workers run their pipeline
+    teardown handler), escalate to SIGKILL after ``grace_s``."""
+    live = [p for p in procs if p.poll() is None]
+    for p in live:
+        try:
+            p.send_signal(signal.SIGTERM)
+        except OSError:
+            pass
+    deadline = time.time() + grace_s
+    for p in live:
+        try:
+            p.wait(timeout=max(0.0, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            try:
+                p.kill()
+                p.wait(timeout=5.0)
+            except OSError:
+                pass

@@ -1,0 +1,103 @@
+"""Launcher end-to-end smoke (``scripts/launch-smoke``; CI fast tier).
+
+Generates an 8-shard partitioned parquet dataset, runs ``zoo-launch
+--hosts 2`` over :mod:`launcher.smoke_train` on the CPU backend, and
+asserts the distributed-platform contract:
+
+- both workers printed ``SHARDS`` lines whose shard sets are disjoint,
+  non-empty, and together cover all 8 shards;
+- both workers completed ``NNEstimator.fit(dataset_uri)`` with params
+  that actually moved from init (``FIT_DONE ... trained=1``);
+- the job exit code is 0 — with **no hand-set ZOO_TPU_* env** anywhere.
+
+Exit 0 on success, 1 on any violated assertion (printing the captured
+worker log for diagnosis).
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import os
+import re
+import shutil
+import sys
+import tempfile
+
+
+def run_smoke(hosts: int = 2, shards: int = 8, rows: int = 128,
+              batch: int = 8, stream=None) -> int:
+    import numpy as np
+
+    from ..feature.dataset import write_parquet_shards
+    from .launch import launch
+
+    out = stream if stream is not None else sys.stdout
+    dataset = tempfile.mkdtemp(prefix="zoo_launch_smoke_")
+    try:
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((rows, 4)).astype(np.float32)
+        y = (x[:, :1].sum(axis=1) > 0).astype(np.float32)
+        write_parquet_shards(dataset, x, y, num_shards=shards)
+
+        script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "smoke_train.py")
+        cap = io.StringIO()
+        env = {"JAX_PLATFORMS": "cpu"}
+        rc = launch([script, dataset, str(batch)], num_hosts=hosts,
+                    env=env, on_failure="kill-all", stream=cap)
+        log = cap.getvalue()
+        out.write(log)
+
+        def fail(msg):
+            out.write(f"LAUNCH_SMOKE_FAIL: {msg}\n")
+            return 1
+
+        if rc != 0:
+            return fail(f"zoo-launch exited rc={rc}")
+        shard_sets = {}
+        for m in re.finditer(r"SHARDS pid=(\d+) (\S+)", log):
+            shard_sets[int(m.group(1))] = set(m.group(2).split(","))
+        if sorted(shard_sets) != list(range(hosts)):
+            return fail(f"expected SHARDS lines from {hosts} workers, "
+                        f"got pids {sorted(shard_sets)}")
+        union = set()
+        for pid, s in sorted(shard_sets.items()):
+            if not s:
+                return fail(f"worker {pid} got no shards")
+            overlap = union & s
+            if overlap:
+                return fail(f"shard sets overlap: {sorted(overlap)}")
+            union |= s
+        expected = {f"part-{i:05d}.parquet" for i in range(shards)}
+        if union != expected:
+            return fail(f"coverage gap: missing {sorted(expected - union)}")
+        done = {int(m.group(1)): int(m.group(2)) for m in
+                re.finditer(r"FIT_DONE pid=(\d+) trained=(\d)", log)}
+        if set(done) != set(range(hosts)):
+            return fail(f"FIT_DONE missing for workers "
+                        f"{sorted(set(range(hosts)) - set(done))}")
+        untrained = sorted(p for p, t in done.items() if not t)
+        if untrained:
+            return fail(f"fit completed but params never moved on "
+                        f"workers {untrained}")
+        out.write(f"LAUNCH_SMOKE_OK hosts={hosts} shards={shards} "
+                  f"rows={rows} disjoint=1 covered=1\n")
+        return 0
+    finally:
+        shutil.rmtree(dataset, ignore_errors=True)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="launch-smoke")
+    ap.add_argument("--hosts", type=int, default=2)
+    ap.add_argument("--shards", type=int, default=8)
+    ap.add_argument("--rows", type=int, default=128)
+    ap.add_argument("--batch", type=int, default=8)
+    args = ap.parse_args(argv)
+    return run_smoke(hosts=args.hosts, shards=args.shards, rows=args.rows,
+                     batch=args.batch)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

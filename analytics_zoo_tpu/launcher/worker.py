@@ -1,0 +1,58 @@
+"""Per-worker bootstrap: signal-safe teardown around the user's script.
+
+``zoo-launch`` runs every worker as ``python -m
+analytics_zoo_tpu.launcher.worker <script> [args...]`` so that:
+
+1. a supervisor-driven SIGTERM (kill-all failure policy, operator ^C)
+   closes every live infeed stage (``feature.shutdown_all_pipelines``)
+   before exiting — otherwise concurrent.futures' atexit hook joins
+   still-busy non-daemon transform-pool threads and a "killed" worker
+   hangs instead of dying;
+2. the script sees a clean ``sys.argv`` (its own name + args), exactly
+   as if launched directly.
+
+Deliberately import-light: jax and the package's heavy modules load only
+if (and when) the user script imports them.
+"""
+
+from __future__ import annotations
+
+import os
+import runpy
+import signal
+import sys
+
+
+def _shutdown_handler(signum, frame):  # noqa: ARG001 - signal signature
+    rank = os.environ.get("ZOO_TPU_PROCESS_ID", "?")
+    try:
+        from analytics_zoo_tpu.feature.feature_set import \
+            shutdown_all_pipelines
+
+        closed = shutdown_all_pipelines()
+        if closed:
+            print(f"[launcher.worker {rank}] closed {closed} pipeline "
+                  f"stage(s) on signal {signum}", file=sys.stderr,
+                  flush=True)
+    finally:
+        # 128+signum, the shell convention the supervisor reports
+        os._exit(128 + signum)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        print("usage: python -m analytics_zoo_tpu.launcher.worker "
+              "<script.py> [args...]", file=sys.stderr)
+        return 2
+    signal.signal(signal.SIGTERM, _shutdown_handler)
+    signal.signal(signal.SIGINT, _shutdown_handler)
+    script, sys.argv = argv[0], argv
+    # scripts resolve siblings relative to themselves, like `python x.py`
+    sys.path.insert(0, os.path.dirname(os.path.abspath(script)) or ".")
+    runpy.run_path(script, run_name="__main__")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

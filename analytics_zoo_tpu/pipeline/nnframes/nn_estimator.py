@@ -330,6 +330,11 @@ class NNEstimator(_Params):
         # directly into the engine instead of materializing columns
         if isinstance(df, FeatureSet):
             return df
+        if isinstance(df, str):
+            # dataset URI (partitioned parquet/arrow directory): every
+            # non-label column is a feature; each host streams its
+            # disjoint size-balanced shard subset (feature/dataset.py)
+            return FeatureSet.from_dataset(df, label_col=self.label_col)
         if isinstance(df, (list, tuple)) and df and \
                 all(isinstance(p, str) for p in df):
             return FeatureSet.files(list(df), label_col=self.label_col)

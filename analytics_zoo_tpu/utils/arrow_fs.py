@@ -87,6 +87,14 @@ class ArrowFileSystem(file_io.FileSystem):
     def remove(self, path: str):
         self.fs.delete_file(path)
 
+    def size(self, path: str) -> int:
+        from pyarrow.fs import FileType
+
+        info = self.fs.get_file_info([path])[0]
+        if info.type == FileType.NotFound:
+            raise FileNotFoundError(path)
+        return int(info.size or 0)
+
     def rename(self, src: str, dst: str):
         self.fs.move(src, dst)
 

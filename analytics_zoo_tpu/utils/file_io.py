@@ -45,6 +45,9 @@ class FileSystem:
     def rename(self, src: str, dst: str):
         raise NotImplementedError
 
+    def size(self, path: str) -> int:
+        raise NotImplementedError
+
 
 class LocalFileSystem(FileSystem):
     def open(self, path: str, mode: str = "rb"):
@@ -70,6 +73,9 @@ class LocalFileSystem(FileSystem):
 
     def rename(self, src: str, dst: str):
         os.replace(src, dst)
+
+    def size(self, path: str) -> int:
+        return os.path.getsize(path)
 
 
 def register_filesystem(scheme: str, fs: FileSystem):
@@ -138,6 +144,12 @@ def remove(uri: str):
 def listdir(uri: str) -> List[str]:
     fs, path = get_filesystem(uri)
     return fs.listdir(path)
+
+
+def file_size(uri: str) -> int:
+    """Size in bytes (shard-balance hint for dataset ingestion)."""
+    fs, path = get_filesystem(uri)
+    return int(fs.size(path))
 
 
 def read_bytes(uri: str) -> bytes:

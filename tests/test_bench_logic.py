@@ -65,3 +65,93 @@ def test_peak_flops_table(bench):
     assert bench._peak_flops("TPU v5 lite") == 197e12
     assert bench._peak_flops("TPU v4") == 275e12
     assert bench._peak_flops("weird accelerator") is None
+
+
+# -- probe_backend resilience -------------------------------------------
+
+def _fake_run(script):
+    """A subprocess.run stand-in driven by a scripted list of outcomes:
+    'ok' -> device JSON, 'err' -> rc=1, 'hang' -> TimeoutExpired."""
+    import json as _json
+    import subprocess as _sp
+
+    calls = []
+
+    def run(cmd, capture_output=True, text=True, timeout=None):
+        outcome = script[len(calls)]
+        calls.append(outcome)
+        if outcome == "hang":
+            raise _sp.TimeoutExpired(cmd, timeout)
+
+        class R:
+            pass
+
+        r = R()
+        if outcome == "ok":
+            r.returncode = 0
+            r.stdout = _json.dumps({"platform": "tpu",
+                                    "device_kind": "TPU v5 lite", "n": 4})
+            r.stderr = ""
+        else:
+            r.returncode = 1
+            r.stdout = ""
+            r.stderr = "RuntimeError: tunnel flapped\n"
+        return r
+
+    return run, calls
+
+
+def test_probe_retries_then_succeeds(bench, monkeypatch, tmp_path):
+    run, calls = _fake_run(["err", "hang", "ok"])
+    monkeypatch.setattr(bench, "_PROBE_MEMO", None)
+    monkeypatch.setattr(bench.subprocess, "run", run)
+    cache = str(tmp_path / "probe.json")
+    info, err = bench.probe_backend(attempts=3, timeout_s=1,
+                                    retry_delay_s=0, cache_path=cache)
+    assert err is None
+    assert len(calls) == 3
+    assert info["platform"] == "tpu"
+    assert info["provenance"] == "probe"
+    # success was persisted as the known-good record
+    cached = bench._read_probe_cache(cache)
+    assert cached["device_kind"] == "TPU v5 lite"
+    assert cached["probed_at"] > 0
+
+
+def test_probe_memoizes_known_good_handle(bench, monkeypatch, tmp_path):
+    run, calls = _fake_run(["ok", "err", "err", "err"])
+    monkeypatch.setattr(bench, "_PROBE_MEMO", None)
+    monkeypatch.setattr(bench.subprocess, "run", run)
+    cache = str(tmp_path / "probe.json")
+    first, _ = bench.probe_backend(attempts=1, retry_delay_s=0,
+                                   cache_path=cache)
+    assert first["provenance"] == "probe"
+    # re-entry (helper legs) must NOT spawn another probe subprocess
+    again, err = bench.probe_backend(attempts=3, retry_delay_s=0,
+                                     cache_path=cache)
+    assert err is None
+    assert len(calls) == 1
+    assert again["platform"] == "tpu"
+    assert again["provenance"] == "memo"
+
+
+def test_probe_total_failure_reports_tail(bench, monkeypatch, tmp_path):
+    run, calls = _fake_run(["err", "err"])
+    monkeypatch.setattr(bench, "_PROBE_MEMO", None)
+    monkeypatch.setattr(bench.subprocess, "run", run)
+    info, err = bench.probe_backend(attempts=2, retry_delay_s=0,
+                                    cache_path=str(tmp_path / "p.json"))
+    assert info is None
+    assert "tunnel flapped" in err
+    assert len(calls) == 2
+
+
+def test_probe_cache_round_trip_and_corruption(bench, tmp_path):
+    path = str(tmp_path / "cache.json")
+    assert bench._read_probe_cache(path) is None  # missing
+    bench._write_probe_cache({"platform": "tpu",
+                              "device_kind": "TPU v4"}, path)
+    assert bench._read_probe_cache(path)["platform"] == "tpu"
+    with open(path, "w") as f:
+        f.write("{not json")
+    assert bench._read_probe_cache(path) is None  # corrupt -> best effort

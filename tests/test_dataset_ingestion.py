@@ -1,0 +1,245 @@
+"""Distributed dataset ingestion (feature/dataset.py): shard discovery,
+deterministic size-balanced assignment, multi-format round trips, and the
+``FeatureSet.from_dataset`` / ``NNEstimator.fit(dataset_uri)`` seam."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.feature.dataset import (ShardedDatasetFeatureSet,
+                                               assign_shards,
+                                               discover_shards,
+                                               write_parquet_shards)
+from analytics_zoo_tpu.feature.feature_set import FeatureSet
+
+
+# -- assign_shards properties -------------------------------------------
+
+def _plans():
+    rng = np.random.default_rng(7)
+    for num_processes in range(1, 6):
+        for n_shards in range(0, 13):
+            sizes = rng.integers(1, 1 << 20, n_shards).tolist()
+            yield sizes, num_processes
+
+
+def test_assign_disjoint_and_covering():
+    for sizes, p in _plans():
+        plan = assign_shards(sizes, p)
+        assert len(plan) == p
+        flat = [i for host in plan for i in host]
+        assert sorted(flat) == list(range(len(sizes)))  # exactly once each
+
+
+def test_assign_deterministic_across_hosts():
+    """Every host computes the plan independently — same inputs must give
+    byte-identical output (coordination-free agreement)."""
+    for sizes, p in _plans():
+        assert assign_shards(sizes, p) == assign_shards(list(sizes), p)
+
+
+def test_assign_balanced_equal_sizes():
+    for n in range(0, 13):
+        for p in range(1, 6):
+            plan = assign_shards([100] * n, p)
+            counts = [len(host) for host in plan]
+            assert max(counts) - min(counts) <= 1
+            # all-unknown (0) sizes degrade to the same balanced counts
+            plan0 = assign_shards([0] * n, p)
+            assert [len(h) for h in plan0] == counts
+
+
+def test_assign_load_spread_bounded_by_largest_shard():
+    for sizes, p in _plans():
+        if len(sizes) < p:
+            continue
+        plan = assign_shards(sizes, p)
+        loads = [sum(sizes[i] for i in host) for host in plan]
+        assert max(loads) - min(loads) <= max(sizes)
+
+
+def test_assign_fewer_shards_than_hosts():
+    plan = assign_shards([10, 20], 4)
+    nonempty = [h for h in plan if h]
+    assert len(nonempty) == 2
+    assert sorted(i for h in plan for i in h) == [0, 1]
+
+
+def test_assign_validation():
+    with pytest.raises(ValueError, match="num_processes"):
+        assign_shards([1, 2], 0)
+    with pytest.raises(ValueError, match="negative"):
+        assign_shards([1, -2], 2)
+
+
+# -- discovery ----------------------------------------------------------
+
+def test_discover_sorted_and_filtered(tmp_path):
+    d = tmp_path / "ds"
+    d.mkdir()
+    for name in ["part-00002.parquet", "part-00000.parquet",
+                 "part-00001.parquet", "_SUCCESS", ".part-0.crc",
+                 "README.txt"]:
+        (d / name).write_bytes(b"x" * 10)
+    shards = discover_shards(str(d))
+    assert [s.path.rsplit("/", 1)[1] for s in shards] == [
+        "part-00000.parquet", "part-00001.parquet", "part-00002.parquet"]
+    assert all(s.size == 10 for s in shards)
+
+
+def test_discover_single_file_and_errors(tmp_path):
+    f = tmp_path / "data.parquet"
+    f.write_bytes(b"z" * 5)
+    shards = discover_shards(str(f))
+    assert len(shards) == 1 and shards[0].size == 5
+
+    with pytest.raises(FileNotFoundError):
+        discover_shards(str(tmp_path / "missing"))
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    (empty / "_SUCCESS").write_bytes(b"")
+    with pytest.raises(ValueError, match="no dataset shards"):
+        discover_shards(str(empty))
+
+
+# -- ingestion round trips ----------------------------------------------
+
+def _collect_rows(fs, batch_size=8):
+    xs, ys = [], []
+    for mb in fs.batches(batch_size, drop_remainder=False):
+        xs.append(np.asarray(mb.inputs[0]))
+        if mb.targets is not None:
+            lab = mb.targets[0] if isinstance(mb.targets, (list, tuple)) \
+                else mb.targets
+            ys.append(np.asarray(lab))
+    return (np.concatenate(xs),
+            np.concatenate(ys) if ys else None)
+
+
+def test_parquet_two_host_disjoint_union(tmp_path):
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((64, 3)).astype(np.float32)
+    y = np.arange(64, dtype=np.float32)
+    uri = str(tmp_path / "parquet_ds")
+    write_parquet_shards(uri, x, y, num_shards=8)
+
+    parts = []
+    for pid in range(2):
+        fs = FeatureSet.from_dataset(uri, label_col="label",
+                                     process_index=pid, num_processes=2)
+        assert isinstance(fs, ShardedDatasetFeatureSet)
+        assert len(fs.local_shards) == 4
+        parts.append(_collect_rows(fs))
+    names0 = set(FeatureSet.from_dataset(
+        uri, label_col="label", process_index=0,
+        num_processes=2).local_shards)
+    names1 = set(FeatureSet.from_dataset(
+        uri, label_col="label", process_index=1,
+        num_processes=2).local_shards)
+    assert not names0 & names1
+    assert names0 | names1 == {f"part-{i:05d}.parquet" for i in range(8)}
+
+    got_y = np.concatenate([p[1] for p in parts])
+    assert sorted(got_y.tolist()) == y.tolist()  # disjoint + covering rows
+    got_x = np.concatenate([p[0] for p in parts])
+    order = np.argsort(got_y)
+    np.testing.assert_allclose(got_x[order], x, rtol=1e-6)
+
+
+def test_zero_shards_for_host_raises(tmp_path):
+    uri = str(tmp_path / "tiny")
+    write_parquet_shards(uri, np.zeros((4, 2), np.float32),
+                         np.zeros(4, np.float32), num_shards=1)
+    # process 0 holds the single shard; process 1 must fail loudly
+    FeatureSet.from_dataset(uri, label_col="label",
+                            process_index=0, num_processes=2)
+    with pytest.raises(ValueError, match="no shards for process 1"):
+        FeatureSet.from_dataset(uri, label_col="label",
+                                process_index=1, num_processes=2)
+
+
+def test_arrow_ipc_with_list_column(tmp_path):
+    import pyarrow as pa
+
+    n = 12
+    rng = np.random.default_rng(1)
+    img = rng.standard_normal((n, 6)).astype(np.float32)
+    scalar = np.arange(n, dtype=np.float32)
+    y = rng.standard_normal(n).astype(np.float32)
+    table = pa.table({"img": [row.tolist() for row in img],
+                      "s": scalar, "label": y})
+    path = tmp_path / "shard-0.arrow"
+    with pa.ipc.new_file(str(path), table.schema) as w:
+        w.write_table(table)
+
+    fs = FeatureSet.from_dataset(str(path), label_col="label",
+                                 process_index=0, num_processes=1)
+    mb = next(iter(fs.batches(n, drop_remainder=False)))
+    # scalar column -> x0 matrix, list column -> its own stacked tensor
+    feats = [np.asarray(f) for f in mb.inputs]
+    assert sorted(f.shape for f in feats) == [(n, 1), (n, 6)]
+    by_shape = {f.shape: f for f in feats}
+    np.testing.assert_allclose(by_shape[(n, 1)][:, 0], scalar)
+    np.testing.assert_allclose(by_shape[(n, 6)], img, rtol=1e-6)
+    lab = mb.targets[0] if isinstance(mb.targets, (list, tuple)) \
+        else mb.targets
+    np.testing.assert_allclose(np.asarray(lab), y, rtol=1e-6)
+
+
+def test_npz_dataset_dir(tmp_path):
+    from analytics_zoo_tpu.feature.feature_set import DiskFeatureSet
+
+    d = tmp_path / "npz_ds"
+    d.mkdir()
+    for i in range(3):
+        DiskFeatureSet.write_shard(
+            str(d / f"shard-{i}.npz"),
+            np.full((5, 2), i, np.float32), np.full(5, i, np.float32))
+    fs = FeatureSet.from_dataset(str(d), process_index=0, num_processes=1)
+    x, _ = _collect_rows(fs, batch_size=5)
+    assert x.shape == (15, 2)
+    assert sorted(set(x[:, 0].tolist())) == [0.0, 1.0, 2.0]
+
+
+def test_epoch_reshuffle_is_shard_granular(tmp_path):
+    """shuffle=True permutes shard order by seed: different seeds visit
+    shards in a different order, same seed replays identically."""
+    uri = str(tmp_path / "shuf")
+    n, shards = 64, 8
+    x = np.repeat(np.arange(shards, dtype=np.float32),
+                  n // shards)[:, None]
+    write_parquet_shards(uri, x, num_shards=shards)
+    fs = FeatureSet.from_dataset(uri, process_index=0, num_processes=1)
+
+    def shard_order(seed):
+        per_shard = n // shards
+        rows = np.concatenate([
+            np.asarray(mb.inputs[0])[:, 0]
+            for mb in fs.batches(per_shard, shuffle=True, seed=seed)])
+        return [int(rows[i * per_shard]) for i in range(shards)]
+
+    orders = {seed: shard_order(seed) for seed in range(4)}
+    assert all(sorted(o) == list(range(shards)) for o in orders.values())
+    assert orders[0] == shard_order(0)  # replayable
+    assert any(orders[s] != orders[0] for s in range(1, 4))
+
+
+def test_nn_estimator_fit_dataset_uri(tmp_path):
+    """The Spark-parity entry point: point fit() at a table URI."""
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    from analytics_zoo_tpu.pipeline.api.keras.models import Sequential
+    from analytics_zoo_tpu.pipeline.nnframes import NNEstimator
+
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((32, 4)).astype(np.float32)
+    w = np.array([1.0, -2.0, 0.5, 3.0], np.float32)
+    y = x @ w
+    uri = str(tmp_path / "train_ds")
+    write_parquet_shards(uri, x, y, num_shards=4)
+
+    model = Sequential()
+    model.add(Dense(1, input_shape=(4,)))
+    est = (NNEstimator(model, "mse")
+           .setBatchSize(8).setMaxEpoch(3).setLabelCol("label"))
+    nn_model = est.fit(uri)
+    preds = np.asarray(nn_model.model.predict(x, batch_size=8))
+    assert preds.shape[0] == 32

@@ -396,3 +396,59 @@ class TestEngineIntegration:
             assert fs.stats().as_dict()["cache_hits"] > 0
         finally:
             set_nncontext(None)
+
+
+# ---------------------------------------------------------------------------
+# Launcher-driven teardown: shutdown_all_pipelines closes every live stage
+# ---------------------------------------------------------------------------
+class TestShutdownAllPipelines:
+    def _alive_transform_threads(self):
+        return [t for t in threading.enumerate()
+                if t.is_alive() and t.name.startswith("zoo-transform")]
+
+    def test_closes_stages_and_stops_threads(self):
+        """The zoo-launch SIGTERM path: mid-stream pipelines (busy
+        transform pool + prefetch thread + staging) must all close via the
+        registry, with no transform-pool thread left running — the hang
+        concurrent.futures' atexit join would otherwise cause."""
+        from analytics_zoo_tpu.feature.feature_set import (
+            shutdown_all_pipelines)
+
+        baseline = len(self._alive_transform_threads())
+
+        def slow_double(batch):
+            time.sleep(0.01)
+            return _double(batch)
+
+        fs = _array_fs(n=512).transform(LambdaPreprocessing(slow_double))
+        host_it = build_host_pipeline(fs, 8, transform_workers=3,
+                                      prefetch_depth=2)
+        staged = DeviceStagingIterator(host_it, lambda b: b,
+                                       lambda bs: bs, depth=2)
+        assert staged.next_chunk(2) is not None  # live and mid-stream
+        prefetch_thread = host_it.thread
+        assert prefetch_thread.is_alive()
+        assert len(self._alive_transform_threads()) > baseline
+
+        closed = shutdown_all_pipelines()
+        # transform iterator + prefetch + staging all registered
+        assert closed >= 3
+
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            if not prefetch_thread.is_alive() and \
+                    len(self._alive_transform_threads()) <= baseline:
+                break
+            time.sleep(0.05)
+        assert not prefetch_thread.is_alive()
+        assert len(self._alive_transform_threads()) <= baseline
+
+    def test_idempotent_and_weakset_drains(self):
+        from analytics_zoo_tpu.feature.feature_set import (
+            shutdown_all_pipelines)
+
+        shutdown_all_pipelines()  # from a clean slate
+        it = PrefetchIterator(iter([1, 2, 3]), depth=1)
+        next(it)
+        assert shutdown_all_pipelines() >= 1
+        assert shutdown_all_pipelines() == 0  # registry drained
