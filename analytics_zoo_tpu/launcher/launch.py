@@ -29,13 +29,15 @@ from __future__ import annotations
 import logging
 import os
 import shlex
-import signal
 import socket
 import subprocess
 import sys
 import threading
 import time
 from typing import Dict, IO, List, NamedTuple, Optional, Sequence
+
+from .supervisor import (inject_pythonpath, pump_lines, spawn_supervised,
+                         terminate_all)
 
 logger = logging.getLogger("analytics_zoo_tpu.launcher")
 
@@ -97,26 +99,15 @@ def _free_port() -> int:
 
 def _pump(pid: int, pipe: IO[str], stream, lock: threading.Lock,
           prefix: bool):
-    """Fan one worker's merged stdout/stderr into ``stream``, one line at
-    a time under ``lock`` so workers never interleave mid-line."""
-    tag = f"[worker-{pid}] "
-    for line in iter(pipe.readline, ""):
-        with lock:
-            stream.write((tag if prefix else "") + line)
-            stream.flush()
-    pipe.close()
+    """Fan one worker's merged output into ``stream`` (supervisor seam)."""
+    pump_lines(f"worker-{pid}", pipe, stream, lock, prefix)
 
 
 def _worker_env(base: Dict[str, str], coordinator: str, num_processes: int,
                 process_id: int, extra: Optional[Dict[str, str]]) -> dict:
-    env = dict(base)
     # workers must import the same package tree the supervisor runs from,
     # regardless of their cwd (the repo may not be pip-installed)
-    pkg_root = os.path.dirname(os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__))))
-    parts = [pkg_root] + [p for p in
-                          env.get("PYTHONPATH", "").split(os.pathsep) if p]
-    env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(parts))
+    env = inject_pythonpath(dict(base))
     if extra:
         env.update({str(k): str(v) for k, v in extra.items()})
     env["ZOO_TPU_COORDINATOR"] = coordinator
@@ -174,18 +165,14 @@ def launch(script_argv: Sequence[str], num_hosts: Optional[int] = None,
     pumps: List[threading.Thread] = []
     try:
         for pid in range(world):
-            p = subprocess.Popen(
+            sp = spawn_supervised(
                 [python, "-m", "analytics_zoo_tpu.launcher.worker",
                  *cmd_tail],
                 env=_worker_env(base_env, coordinator, world, pid, env),
-                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                text=True, bufsize=1)
-            procs.append(p)
-            t = threading.Thread(target=_pump,
-                                 args=(pid, p.stdout, stream, lock, prefix),
-                                 daemon=True)
-            t.start()
-            pumps.append(t)
+                tag=f"worker-{pid}", stream=stream, lock=lock,
+                prefix=prefix)
+            procs.append(sp.proc)
+            pumps.append(sp.pump)
     except BaseException:
         _terminate_all(procs, grace_s)
         raise
@@ -236,21 +223,5 @@ def launch(script_argv: Sequence[str], num_hosts: Optional[int] = None,
 
 
 def _terminate_all(procs: Sequence[subprocess.Popen], grace_s: float):
-    """SIGTERM everything still alive (workers run their pipeline
-    teardown handler), escalate to SIGKILL after ``grace_s``."""
-    live = [p for p in procs if p.poll() is None]
-    for p in live:
-        try:
-            p.send_signal(signal.SIGTERM)
-        except OSError:
-            pass
-    deadline = time.time() + grace_s
-    for p in live:
-        try:
-            p.wait(timeout=max(0.0, deadline - time.time()))
-        except subprocess.TimeoutExpired:
-            try:
-                p.kill()
-                p.wait(timeout=5.0)
-            except OSError:
-                pass
+    """SIGTERM then SIGKILL after ``grace_s`` (supervisor seam)."""
+    terminate_all(procs, grace_s)

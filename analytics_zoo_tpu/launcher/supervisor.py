@@ -1,0 +1,101 @@
+"""Process-supervision primitives shared by zoo-launch and the serving
+fleet.
+
+PR 6's launcher grew the supervision machinery (spawn with env
+propagation, per-worker log fan-in, SIGTERM→SIGKILL teardown) inline in
+:func:`~analytics_zoo_tpu.launcher.launch.launch`; the serving fleet
+(docs/serving-fleet.md) needs exactly the same mechanics with a
+different lifecycle (long-running workers that get *restarted* rather
+than a batch job that runs to completion).  This module is the common
+seam both build on:
+
+- :func:`inject_pythonpath` — child processes import the same package
+  tree the supervisor runs from, regardless of cwd or pip state;
+- :func:`spawn_supervised` — Popen with merged stdout/stderr and a
+  daemon pump thread fanning lines into one stream under a shared lock,
+  each line prefixed ``[tag]`` so interleaved workers stay readable;
+- :func:`terminate_all` — SIGTERM everything still alive (children run
+  their teardown handlers), escalate to SIGKILL after a grace period.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import threading
+import time
+from typing import Dict, IO, List, NamedTuple, Optional, Sequence
+
+
+def inject_pythonpath(env: Dict[str, str]) -> Dict[str, str]:
+    """Prepend the package root to ``env``'s PYTHONPATH (deduplicated,
+    order-preserving) so spawned workers resolve ``analytics_zoo_tpu``
+    identically to the supervisor."""
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    parts = [pkg_root] + [p for p in
+                          env.get("PYTHONPATH", "").split(os.pathsep) if p]
+    env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(parts))
+    return env
+
+
+def pump_lines(tag: str, pipe: IO[str], stream, lock: threading.Lock,
+               prefix: bool = True):
+    """Fan one child's merged stdout/stderr into ``stream``, one line at
+    a time under ``lock`` so workers never interleave mid-line."""
+    head = f"[{tag}] "
+    for line in iter(pipe.readline, ""):
+        with lock:
+            stream.write((head if prefix else "") + line)
+            stream.flush()
+    pipe.close()
+
+
+class SupervisedProc(NamedTuple):
+    """One supervised child: the Popen handle plus its log pump."""
+
+    proc: subprocess.Popen
+    pump: threading.Thread
+    tag: str
+
+
+def spawn_supervised(cmd: Sequence[str], env: Dict[str, str], tag: str,
+                     stream, lock: threading.Lock,
+                     prefix: bool = True,
+                     cwd: Optional[str] = None) -> SupervisedProc:
+    """Start ``cmd`` with merged stdout/stderr pumped into ``stream``
+    line-by-line under ``lock``, each line tagged ``[tag]``."""
+    p = subprocess.Popen(list(cmd), env=env, cwd=cwd,
+                         stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                         text=True, bufsize=1)
+    t = threading.Thread(target=pump_lines,
+                         args=(tag, p.stdout, stream, lock, prefix),
+                         daemon=True, name=f"pump-{tag}")
+    t.start()
+    return SupervisedProc(p, t, tag)
+
+
+def terminate_all(procs: Sequence[subprocess.Popen], grace_s: float):
+    """SIGTERM everything still alive (workers run their teardown
+    handlers), escalate to SIGKILL after ``grace_s``."""
+    live = [p for p in procs if p.poll() is None]
+    for p in live:
+        try:
+            p.send_signal(signal.SIGTERM)
+        except OSError:
+            pass
+    deadline = time.time() + grace_s
+    for p in live:
+        try:
+            p.wait(timeout=max(0.0, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            try:
+                p.kill()
+                p.wait(timeout=5.0)
+            except OSError:
+                pass
+
+
+__all__: List[str] = ["inject_pythonpath", "pump_lines", "spawn_supervised",
+                      "SupervisedProc", "terminate_all"]

@@ -3,12 +3,18 @@
 Parity: ``zoo/.../serving/ClusterServing.scala`` + client
 ``pyzoo/zoo/serving/client.py``; the model registry / router layer
 (versioned hot-swap, canary rollout) is TPU-rebuild-native
-(docs/model-registry.md).
+(docs/model-registry.md), as are the serving fleet + deadline-aware
+admission control (docs/serving-fleet.md).
 """
 
-from .client import API, InputQueue, OutputQueue, ServingError
+from .admission import (AdaptiveBatcher, AdmissionController, SHED_DEADLINE,
+                        SHED_EXPIRED)
+from .client import (API, InputQueue, OutputQueue, ServingError,
+                     ServingRejected, ServingResult, ServingTimeout)
 from .cluster_serving import (ClusterServing, ClusterServingHelper,
-                              pick_bucket, power_of_two_buckets)
+                              EchoStubModel, RecordMeta, pick_bucket,
+                              power_of_two_buckets)
+from .fleet import ServingFleet, fleet_status
 from .queue_backend import (FileStreamQueue, InProcessStreamQueue,
                             StreamQueue, get_queue_backend)
 from .registry import (CanaryState, DeployError, ModelRegistry,
@@ -17,9 +23,13 @@ from .registry import (CanaryState, DeployError, ModelRegistry,
 from .router import RoutedClusterServing
 
 __all__ = ["InputQueue", "OutputQueue", "API", "ServingError",
-           "ClusterServing", "ClusterServingHelper", "StreamQueue",
+           "ServingRejected", "ServingResult", "ServingTimeout",
+           "ClusterServing", "ClusterServingHelper", "EchoStubModel",
+           "RecordMeta", "StreamQueue",
            "InProcessStreamQueue", "FileStreamQueue", "get_queue_backend",
            "pick_bucket", "power_of_two_buckets", "ModelRegistry",
            "ModelVersion", "CanaryState", "RegistryError",
            "UnknownModelError", "DeployError", "RegistryControlServer",
-           "control_request", "RoutedClusterServing"]
+           "control_request", "RoutedClusterServing",
+           "AdmissionController", "AdaptiveBatcher", "SHED_DEADLINE",
+           "SHED_EXPIRED", "ServingFleet", "fleet_status"]

@@ -1,0 +1,166 @@
+"""Deadline-aware admission control + adaptive re-batching for serving.
+
+The reference's Cluster Serving queues everything and lets the tail
+land where it may; at saturating offered load that makes p99 a function
+of queue depth, i.e. of luck.  This module bounds the tail by policy
+instead:
+
+- every wire record may carry ``deadline_ms`` (relative to its client
+  ``enqueue_ts_ms`` stamp).  At intake the serving loop asks
+  :meth:`AdmissionController.admit` whether the record can still meet
+  its deadline given the measured per-record service time and the
+  current backlog; a record that cannot is **shed immediately** with a
+  typed rejection payload (clients see
+  :class:`~analytics_zoo_tpu.serving.client.ServingRejected`) instead
+  of rotting in the queue and dragging the tail out;
+- records whose deadline expires while queued are shed again at
+  dispatch time (``shed_expired``) so the accelerator never spends a
+  batch on an answer nobody is waiting for;
+- :class:`AdaptiveBatcher` gives the compute stage a *linger budget*:
+  under load it may wait a bounded extra moment to round a partial
+  batch up to the next padding-bucket boundary (continuous
+  re-batching), but never longer than the oldest queued record's
+  deadline slack allows.
+
+Service-time estimates are :class:`~analytics_zoo_tpu.utils.profiling.
+Ewma` so the controller adapts as traffic or the model mix shifts.
+All decisions are O(1) per record — this sits on the intake hot path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional, Sequence, Tuple
+
+from ..utils.profiling import Ewma
+
+#: typed rejection codes carried in the shed result payload ("code")
+SHED_DEADLINE = "shed_deadline"   # unmeetable at admission time
+SHED_EXPIRED = "shed_expired"     # expired while queued, shed at dispatch
+
+
+def now_ms() -> float:
+    """Epoch milliseconds — the wire-timestamp clock (clients and
+    workers share a host or NTP; perf_counter is not comparable across
+    processes)."""
+    return time.time() * 1e3
+
+
+class AdmissionController:
+    """Shed-or-admit decisions from measured service time + backlog.
+
+    ``safety_ms`` is the scheduling slop added to every estimate (queue
+    polling, GIL, host jitter); a record is admitted only when
+    ``backlog * per_record_ms + batch_ms + safety_ms`` fits inside its
+    remaining deadline slack.  Until the first batch has been observed
+    both estimates are unknown and only the safety margin is applied —
+    the controller never sheds on a guess it has no data for.
+    """
+
+    def __init__(self, safety_ms: float = 2.0, alpha: float = 0.25):
+        self.safety_ms = float(safety_ms)
+        self._record_ms = Ewma(alpha)   # per-record service time
+        self._batch_ms = Ewma(alpha)    # per-dispatch wall time
+        self._lock = threading.Lock()
+        self.shed_deadline = 0
+        self.shed_expired = 0
+
+    # -- estimate maintenance (fed by the writer stage) ----------------
+    def observe_batch(self, n: int, seconds: float):
+        """One dispatched batch of ``n`` records took ``seconds``."""
+        ms = float(seconds) * 1e3
+        self._batch_ms.update(ms)
+        self._record_ms.update(ms / max(int(n), 1))
+
+    @property
+    def record_ms(self) -> float:
+        return self._record_ms.value or 0.0
+
+    @property
+    def batch_ms(self) -> float:
+        return self._batch_ms.value or 0.0
+
+    # -- decisions ------------------------------------------------------
+    def estimate_wait_ms(self, backlog: int) -> float:
+        """Expected time for a record arriving now to finish: drain the
+        backlog ahead of it plus its own batch."""
+        return max(int(backlog), 0) * self.record_ms + self.batch_ms
+
+    def admit(self, slack_ms: Optional[float],
+              backlog: int) -> Tuple[bool, Optional[str]]:
+        """(admitted, shed_code).  ``slack_ms`` is the record's remaining
+        deadline budget (``None`` = no deadline, always admitted)."""
+        if slack_ms is None:
+            return True, None
+        if self.estimate_wait_ms(backlog) + self.safety_ms > slack_ms:
+            with self._lock:
+                self.shed_deadline += 1
+            return False, SHED_DEADLINE
+        return True, None
+
+    def expired(self, deadline_at_ms: Optional[float],
+                at_ms: Optional[float] = None) -> bool:
+        """True when a queued record can no longer produce a useful
+        answer: its deadline lands before even an immediate dispatch
+        would complete."""
+        if deadline_at_ms is None:
+            return False
+        at = now_ms() if at_ms is None else at_ms
+        if at + self.batch_ms + self.safety_ms > deadline_at_ms:
+            with self._lock:
+                self.shed_expired += 1
+            return True
+        return False
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"shed_deadline": self.shed_deadline,
+                    "shed_expired": self.shed_expired,
+                    "est_record_ms": round(self.record_ms, 3),
+                    "est_batch_ms": round(self.batch_ms, 3),
+                    "safety_ms": self.safety_ms}
+
+
+class AdaptiveBatcher:
+    """Linger budget for the compute stage's batch assembly.
+
+    The greedy assembler takes whatever is already decoded; with a
+    linger budget it may additionally block a bounded moment for more
+    records so partial batches round up to the next padding-bucket
+    boundary — amortizing MXU time under load without ever spending a
+    queued record's deadline slack.  ``linger_ms = 0`` (the default)
+    disables lingering and preserves the latency-first behavior.
+    """
+
+    def __init__(self, buckets: Sequence[int],
+                 controller: Optional[AdmissionController] = None,
+                 linger_ms: float = 0.0):
+        self.buckets = sorted(buckets)
+        self.controller = controller
+        self.linger_ms = max(float(linger_ms), 0.0)
+
+    def next_boundary(self, n: int) -> int:
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.buckets[-1]
+
+    def linger_budget_s(self, n_have: int,
+                        oldest_deadline_at_ms: Optional[float],
+                        at_ms: Optional[float] = None) -> float:
+        """Seconds the assembler may block waiting for record number
+        ``n_have + 1``; 0.0 means dispatch now."""
+        if self.linger_ms <= 0.0 or n_have >= self.buckets[-1]:
+            return 0.0
+        if n_have in self.buckets:
+            # already exactly on a bucket boundary: lingering would only
+            # trade latency for a *larger* signature — dispatch
+            return 0.0
+        budget = self.linger_ms
+        if oldest_deadline_at_ms is not None:
+            at = now_ms() if at_ms is None else at_ms
+            cost = (self.controller.batch_ms + self.controller.safety_ms
+                    if self.controller is not None else 0.0)
+            budget = min(budget, oldest_deadline_at_ms - at - cost)
+        return max(budget, 0.0) / 1e3

@@ -16,6 +16,7 @@ Usage::
     python -m analytics_zoo_tpu.serving.cli init   [--dir DIR]
     python -m analytics_zoo_tpu.serving.cli start  [--dir DIR] [--foreground]
                                                    [--warmup]
+    python -m analytics_zoo_tpu.serving.cli fleet  [--dir DIR] [--workers N]
     python -m analytics_zoo_tpu.serving.cli status [--dir DIR]
     python -m analytics_zoo_tpu.serving.cli stop   [--dir DIR]
     python -m analytics_zoo_tpu.serving.cli restart [--dir DIR]
@@ -70,6 +71,13 @@ params:
   # queue_depth: 64          # bound on each inter-stage queue
   # bucket_sizes: 1,2,4,8,16,32   # padding buckets (default: powers of 2)
   # warmup: false            # pre-compile all buckets before serving
+  ## serving fleet + deadline-aware admission (docs/serving-fleet.md):
+  # workers: 2               # fleet size for `zoo-serving fleet`
+  # health_interval: 1.0     # worker heartbeat period, seconds
+  # health_timeout: 10.0     # stale heartbeat -> restart threshold
+  # default_deadline_ms: 250 # deadline for records that carry none
+  # admission_safety_ms: 2.0 # slop subtracted from every slack estimate
+  # linger_ms: 0             # max wait to round batches up to a bucket
 
 ## model registry (docs/model-registry.md): uncomment to serve many
 ## named, versioned models with hot-swap + canary rollout
@@ -219,6 +227,28 @@ def cmd_start(workdir: str, foreground: bool = False,
     os._exit(0)
 
 
+def cmd_fleet(workdir: str, workers=None) -> int:
+    """Run a supervised multi-worker serving fleet in the foreground
+    (docs/serving-fleet.md): N worker processes over the shared
+    transport, heartbeat-watched, dead workers restarted."""
+    cfg, _, _ = _paths(workdir)
+    if not os.path.exists(cfg):
+        print(f"no {cfg}; run `cluster-serving-init` first",
+              file=sys.stderr)
+        return 1
+    from .fleet import ServingFleet
+
+    fleet = ServingFleet(cfg, workdir, workers=workers).start()
+    print(f"fleet: supervising {fleet.workers} worker(s); Ctrl-C to stop",
+          flush=True)
+    signal.signal(signal.SIGTERM, lambda _s, _f: fleet.stop())
+    try:
+        fleet.supervise()
+    except KeyboardInterrupt:
+        fleet.shutdown()
+    return 0
+
+
 def _load_config(workdir: str) -> dict:
     cfg, _, _ = _paths(workdir)
     try:
@@ -260,13 +290,30 @@ def _print_models(models: dict):
                   f"inflight={vs.get('inflight', 0)}")
 
 
+def _print_fleet(workdir: str) -> bool:
+    """Per-worker rows from the fleet's health files (fleet mode only);
+    returns True when any worker row was printed."""
+    from .fleet import fleet_status
+
+    rows = fleet_status(workdir)
+    for r in rows:
+        state = "up" if r["alive"] else "DOWN"
+        print(f"  worker {r['worker_id']}: pid={r['pid']} {state:4s} "
+              f"health_age={r['health_age_s']:.1f}s "
+              f"served={r['records_served']} shed={r['shed']} "
+              f"restarts={r['restarts']}")
+    return bool(rows)
+
+
 def cmd_status(workdir: str) -> int:
     _, pidfile, _ = _paths(workdir)
     pid = _read_pid(pidfile)
-    if pid is None:
+    if pid is not None:
+        print(f"running (pid {pid})")
+    fleet_rows = _print_fleet(workdir)
+    if pid is None and not fleet_rows:
         print("not running")
         return 3
-    print(f"running (pid {pid})")
     # pipeline stats: the serving process dumps pipeline_stats() to
     # stats.json every ~2s (atomic rename, safe to read concurrently)
     stats = None
@@ -398,10 +445,13 @@ def cmd_shutdown(workdir: str) -> int:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="zoo-serving")
-    ap.add_argument("command", choices=["init", "start", "status", "stop",
-                                        "restart", "shutdown", "deploy",
-                                        "promote", "undeploy"])
+    ap.add_argument("command", choices=["init", "start", "fleet", "status",
+                                        "stop", "restart", "shutdown",
+                                        "deploy", "promote", "undeploy"])
     ap.add_argument("--dir", default=".", help="serving working directory")
+    ap.add_argument("--workers", default=None, type=int,
+                    help="fleet: worker process count (default: config "
+                         "params.workers)")
     ap.add_argument("--foreground", action="store_true",
                     help="start: run in the foreground (containers)")
     ap.add_argument("--warmup", action="store_true",
@@ -437,6 +487,8 @@ def main(argv=None) -> int:
     if args.command == "start":
         return cmd_start(workdir, foreground=args.foreground,
                          warmup=args.warmup)
+    if args.command == "fleet":
+        return cmd_fleet(workdir, workers=args.workers)
     if args.command == "status":
         return cmd_status(workdir)
     if args.command == "stop":

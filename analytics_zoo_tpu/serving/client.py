@@ -4,6 +4,16 @@ Parity: ``pyzoo/zoo/serving/client.py`` — ``InputQueue.enqueue_image``
 (:83, base64-encoded jpg into the stream), ``OutputQueue.dequeue``/``query``
 (:131,142).  The transport is pluggable (§queue_backend) instead of
 hard-coded Redis.
+
+Latency decomposition + deadlines (docs/serving-fleet.md): every enqueue
+stamps ``enqueue_ts_ms`` (epoch ms) and may carry ``deadline_ms``; the
+server threads its dequeue/compute timestamps into the result payload,
+so a decoded result is a :class:`ServingResult` whose ``timing`` dict
+splits ``transport_ms`` (wire + result-poll time) from ``device_ms``
+(accelerator time) per row.  Requests the server cannot finish inside
+their deadline come back as typed :class:`ServingRejected` values
+instead of silent timeouts, and :meth:`OutputQueue.wait_all` raises
+:class:`ServingTimeout` rather than backing off past a client deadline.
 """
 
 from __future__ import annotations
@@ -33,6 +43,58 @@ class ServingError(Exception):
         self.version = version
 
 
+class ServingRejected(ServingError):
+    """Typed load-shed rejection: admission control determined the
+    record could not meet its ``deadline_ms`` (``code`` is
+    ``shed_deadline`` at intake, ``shed_expired`` when the deadline
+    passed while queued — docs/serving-fleet.md#admission)."""
+
+    def __init__(self, uri: Optional[str], message: str,
+                 code: str = "shed_deadline",
+                 model: Optional[str] = None,
+                 version: Optional[int] = None):
+        super().__init__(uri, message, model, version)
+        self.code = code
+
+
+class ServingTimeout(ServingError):
+    """Client-side deadline expiry in :meth:`OutputQueue.wait_all`:
+    results for ``missing`` uris had not landed when the deadline
+    passed.  ``partial`` holds everything that did arrive."""
+
+    def __init__(self, missing, partial: Optional[dict] = None,
+                 deadline_ms: Optional[float] = None):
+        self.missing = sorted(missing)
+        self.partial = partial or {}
+        self.deadline_ms = deadline_ms
+        super().__init__(
+            None,
+            f"{len(self.missing)} of "
+            f"{len(self.missing) + len(self.partial)} results missing "
+            f"after deadline"
+            + (f" of {deadline_ms:.0f}ms" if deadline_ms else "")
+            + f": {self.missing[:5]}"
+            + ("..." if len(self.missing) > 5 else ""))
+
+
+class ServingResult(np.ndarray):
+    """A prediction plus its latency decomposition: behaves exactly like
+    the float32 ndarray it always was, with a ``timing`` dict attached
+    (``device_ms``, ``transport_ms``, ``queue_ms``, ``rtt_ms``, raw
+    timestamps) when the server reported one."""
+
+    timing: Optional[dict]
+
+    def __array_finalize__(self, obj):
+        self.timing = getattr(obj, "timing", None)
+
+    @classmethod
+    def wrap(cls, value, timing: Optional[dict]) -> "ServingResult":
+        out = np.asarray(value, np.float32).view(cls)
+        out.timing = timing
+        return out
+
+
 class API:
     """Shared client base (client.py:25)."""
 
@@ -45,20 +107,26 @@ class API:
 class InputQueue(API):
     @staticmethod
     def _route_fields(rec: dict, model: Optional[str],
-                      version: Optional[int]) -> dict:
+                      version: Optional[int],
+                      deadline_ms: Optional[float] = None) -> dict:
         # optional on the wire: absent fields route to the server's
         # default model, so pre-registry clients keep working unchanged
         if model is not None:
             rec["model"] = model
         if version is not None:
             rec["version"] = int(version)
+        if deadline_ms is not None:
+            rec["deadline_ms"] = float(deadline_ms)
+        rec["enqueue_ts_ms"] = time.time() * 1e3
         return rec
 
     def enqueue_image(self, uri: str, img, model: Optional[str] = None,
-                      version: Optional[int] = None) -> str:
+                      version: Optional[int] = None,
+                      deadline_ms: Optional[float] = None) -> str:
         """Put one image on the stream; ``img`` is an ndarray (HWC BGR
         uint8) or pre-encoded jpg/png bytes (client.py:83-122).
-        ``model``/``version`` target a registry-served model."""
+        ``model``/``version`` target a registry-served model;
+        ``deadline_ms`` opts into deadline-aware admission control."""
         if isinstance(img, np.ndarray):
             import cv2
 
@@ -69,16 +137,19 @@ class InputQueue(API):
         else:
             data = bytes(img)
         rec = {"uri": uri, "image": self.base64_encode_image(data)}
-        return self.db.enqueue(self._route_fields(rec, model, version))
+        return self.db.enqueue(
+            self._route_fields(rec, model, version, deadline_ms))
 
     def enqueue(self, uri: str, model: Optional[str] = None,
-                version: Optional[int] = None, **tensors) -> str:
+                version: Optional[int] = None,
+                deadline_ms: Optional[float] = None, **tensors) -> str:
         """General tensor input: each kwarg becomes a (shape, data) entry."""
         rec = {"uri": uri, "tensors": {
             k: {"shape": list(np.asarray(v).shape),
                 "data": np.asarray(v, np.float32).tobytes()}
             for k, v in tensors.items()}}
-        return self.db.enqueue(self._route_fields(rec, model, version))
+        return self.db.enqueue(
+            self._route_fields(rec, model, version, deadline_ms))
 
     @staticmethod
     def base64_encode_image(data: bytes) -> str:
@@ -98,19 +169,29 @@ class OutputQueue(API):
 
     def wait_all(self, uris: Iterable[str], timeout: float = 30.0,
                  poll: float = 0.01, max_poll: float = 0.5,
-                 raise_on_error: bool = False) -> Dict[str, np.ndarray]:
+                 raise_on_error: bool = False,
+                 deadline_ms: Optional[float] = None
+                 ) -> Dict[str, np.ndarray]:
         """Poll until every uri has a result (popping as they land) or
         the deadline passes; returns whatever arrived.  The interval
         backs off exponentially from ``poll`` to ``max_poll`` while
-        nothing lands and snaps back to ``poll`` on progress, so a hot
-        stream is polled tightly and an idle one cheaply.
+        nothing lands and snaps back to ``poll`` on progress — but never
+        sleeps past the deadline, so the budget is honored, not merely
+        approximated.
 
-        Dead-lettered uris come back as :class:`ServingError` values
-        (structured error instead of a silent timeout); with
-        ``raise_on_error`` the first one raises."""
+        ``deadline_ms`` is the typed-deadline form: it bounds the wait
+        (overriding ``timeout``) and raises :class:`ServingTimeout`
+        listing the missing uris when it expires, instead of silently
+        returning a partial dict.
+
+        Dead-lettered uris come back as :class:`ServingError` values and
+        load-shed uris as :class:`ServingRejected` (structured errors
+        instead of a silent timeout); with ``raise_on_error`` the first
+        one raises."""
         want = set(uris)
         got: Dict[str, np.ndarray] = {}
-        deadline = time.time() + timeout
+        budget_s = deadline_ms / 1e3 if deadline_ms is not None else timeout
+        deadline = time.time() + budget_s
         interval = poll
         while want and time.time() < deadline:
             progressed = False
@@ -127,13 +208,37 @@ class OutputQueue(API):
                     interval = poll
                 else:
                     interval = min(interval * 2, max_poll)
-                time.sleep(interval)
+                # honor the deadline: never back off past it
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    break
+                time.sleep(min(interval, remaining))
+        if want and deadline_ms is not None:
+            raise ServingTimeout(want, partial=got, deadline_ms=deadline_ms)
         return got
 
     @staticmethod
     def _decode(value: bytes, uri: Optional[str] = None):
         obj = json.loads(value.decode("utf-8"))
         if isinstance(obj, dict) and "error" in obj:
+            code = obj.get("code")
+            if code in ("shed_deadline", "shed_expired"):
+                return ServingRejected(uri, obj["error"], code,
+                                       obj.get("model"),
+                                       obj.get("version"))
             return ServingError(uri, obj["error"], obj.get("model"),
                                 obj.get("version"))
-        return np.asarray(obj["value"], np.float32)
+        timing = obj.get("timing")
+        if timing:
+            # complete the round trip client-side: total wall from the
+            # enqueue stamp, minus time inside the server = wire +
+            # result-poll transport
+            recv_ms = time.time() * 1e3
+            enq = timing.get("enqueue_ts_ms")
+            if enq is not None:
+                timing["rtt_ms"] = round(recv_ms - enq, 3)
+                server_ms = timing.get("server_ms")
+                if server_ms is not None:
+                    timing["transport_ms"] = round(
+                        max(timing["rtt_ms"] - server_ms, 0.0), 3)
+        return ServingResult.wrap(obj["value"], timing)

@@ -31,6 +31,19 @@ The original single-thread loop survives as ``pipelined=False`` (config
 and the slow comparison test measure against.  Per-stage latency
 percentiles, queue depths, and bucket usage are recorded in
 :class:`InferenceSummary` so the overlap is observable.
+
+Deadline-aware admission + latency decomposition (docs/serving-fleet.md):
+records carrying ``deadline_ms`` pass through an
+:class:`~analytics_zoo_tpu.serving.admission.AdmissionController` at
+intake (unmeetable → typed ``shed_deadline`` rejection) and again at
+dispatch (``shed_expired``); the compute stage may *linger* a bounded
+moment (``params.linger_ms``) to round partial batches up to the next
+padding bucket.  Each record's ``enqueue_ts_ms`` (client) and
+``dequeue_ts_ms`` (backend) stamps travel in a :class:`RecordMeta`
+through the stages, and the writer emits a per-row ``timing`` payload
+splitting ``transport_in_ms`` / ``queue_ms`` / ``device_ms`` /
+``server_ms`` — so a fat tail is attributable to the wire or the
+accelerator, not guessed at.
 """
 
 from __future__ import annotations
@@ -42,18 +55,53 @@ import queue
 import threading
 import time
 from collections import Counter
-from typing import List, Optional, Sequence
+from typing import List, NamedTuple, Optional, Sequence
 
 import numpy as np
 
 from ..pipeline.inference import InferenceModel
+from ..pipeline.inference.inference_model import AbstractModel
 from ..pipeline.inference.inference_summary import InferenceSummary
+from .admission import (AdaptiveBatcher, AdmissionController, SHED_DEADLINE,
+                        SHED_EXPIRED, now_ms)
 from .queue_backend import StreamQueue, get_queue_backend
 
 logger = logging.getLogger("analytics_zoo_tpu.serving")
 
 #: shutdown marker passed through the stage queues
 _SENTINEL = object()
+
+
+class RecordMeta(NamedTuple):
+    """Per-record identity + timestamps threaded through the pipeline
+    stages (all ``*_ms`` are epoch milliseconds; ``t_in`` is the server's
+    perf_counter at intake, for the e2e stage percentile)."""
+
+    t_in: float
+    uri: str
+    enqueue_ts_ms: Optional[float]   # stamped by the client
+    dequeue_ts_ms: Optional[float]   # stamped by the queue backend
+    deadline_at_ms: Optional[float]  # absolute deadline; None = no deadline
+
+
+class EchoStubModel(AbstractModel):
+    """Deterministic stand-in for a real model: sleeps a fixed
+    ``ms_per_batch`` (a perfectly flat "device" time) and echoes each
+    row's mean.  Lets fleet workers, smoke tests, and bench legs exercise
+    the full wire path in subprocesses without a saved model — enabled
+    via config ``model.stub_ms_per_batch``."""
+
+    def __init__(self, ms_per_batch: float = 5.0):
+        self.ms_per_batch = float(ms_per_batch)
+
+    def predict(self, batch):
+        batch = np.asarray(batch, np.float32)
+        if self.ms_per_batch > 0:
+            time.sleep(self.ms_per_batch / 1e3)
+        return batch.reshape(batch.shape[0], -1).mean(axis=1, keepdims=True)
+
+    def predict_async(self, batch):
+        return self.predict(batch)
 
 
 def power_of_two_buckets(batch_size: int) -> List[int]:
@@ -99,13 +147,20 @@ class ClusterServingHelper:
         data = config.get("data") or {}
         params = config.get("params") or {}
         self.model_path = model.get("path")
+        # deterministic echo stub (EchoStubModel) instead of a saved
+        # model — fleet smoke / bench workers (docs/serving-fleet.md)
+        raw_stub = model.get("stub_ms_per_batch")
+        self.stub_ms_per_batch = None if raw_stub is None else float(raw_stub)
         self.src = data.get("src")  # transport spec
         shape = data.get("image_shape") or "3, 224, 224"
         if isinstance(shape, str):
             shape = [int(s) for s in shape.split(",")]
         self.image_shape = tuple(shape)
         self.batch_size = int(params.get("batch_size") or 4)
-        self.top_n = int(params.get("top_n") or 1)
+        # explicit 0 means raw output (no top-n formatting), so the
+        # falsy-default idiom would silently re-enable it
+        raw_top = params.get("top_n")
+        self.top_n = 1 if raw_top is None else int(raw_top)
         # watermark: trim stream when it exceeds maxlen (60%*80% parity)
         self.stream_maxlen = int(params.get("stream_maxlen") or 10000)
         # -- pipeline knobs (docs/serving-pipeline.md) ------------------
@@ -121,6 +176,16 @@ class ClusterServingHelper:
         # periodic pipeline_stats() JSON dump for `zoo-serving status`
         # (the CLI start path defaults this to <workdir>/stats.json)
         self.stats_path = params.get("stats_path")
+        # -- admission / adaptive batching (docs/serving-fleet.md) ------
+        self.linger_ms = float(params.get("linger_ms") or 0.0)
+        raw_dl = params.get("default_deadline_ms")
+        self.default_deadline_ms = None if raw_dl is None else float(raw_dl)
+        self.admission_safety_ms = float(
+            params.get("admission_safety_ms") or 2.0)
+        # -- fleet (serving/fleet.py) -----------------------------------
+        self.workers = int(params.get("workers") or 1)
+        self.health_interval = float(params.get("health_interval") or 1.0)
+        self.health_timeout = float(params.get("health_timeout") or 10.0)
         # -- model registry (docs/model-registry.md) --------------------
         reg = config.get("registry") or {}
         self.registry_root = reg.get("root")
@@ -169,15 +234,28 @@ class ClusterServing:
         self.results_out = 0
         self.dropped = 0
         self.dead_letters = 0
+        self.shed = 0
         self.batches = 0
         self.bucket_counts: Counter = Counter()
         self.stats_path = getattr(h, "stats_path", None)
+        # deadline-aware admission + bounded linger (serving/admission.py)
+        self.admission = AdmissionController(
+            safety_ms=float(getattr(h, "admission_safety_ms", 2.0)))
+        self.batcher = AdaptiveBatcher(
+            self.buckets, self.admission,
+            linger_ms=float(getattr(h, "linger_ms", 0.0)))
+        self.default_deadline_ms = getattr(h, "default_deadline_ms", None)
+        # intake backlog sources, populated by _serve_pipelined (admission
+        # reads live queue depths instead of guessing from counters)
+        self._backlog_queues: List[queue.Queue] = []
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
-    def _default_model(self) -> Optional[InferenceModel]:
+    def _default_model(self):
         """Model used when none is injected; the registry router
         overrides this (models come from the ModelRegistry instead)."""
+        if getattr(self.helper, "stub_ms_per_batch", None) is not None:
+            return EchoStubModel(self.helper.stub_ms_per_batch)
         if self.helper.model_path:
             return self.helper.load_inference_model()
         return None
@@ -225,10 +303,75 @@ class ClusterServing:
                    "results_out": self.results_out,
                    "dropped": self.dropped,
                    "dead_letters": self.dead_letters,
+                   "shed": self.shed,
                    "batches": self.batches,
                    "buckets": dict(self.bucket_counts)}
+        out["admission"] = self.admission.stats()
+        if hasattr(self.db, "consumer_stats"):
+            out["queue"] = self.db.consumer_stats()
         out.update(self.summary.snapshot())
         return out
+
+    # -- deadline admission + timing decomposition ----------------------
+    def _meta_for(self, rid: str, rec: dict, t_in: float) -> RecordMeta:
+        enq = rec.get("enqueue_ts_ms")
+        deadline_ms = rec.get("deadline_ms", self.default_deadline_ms)
+        deadline_at = None
+        if deadline_ms is not None:
+            # relative to the client stamp when present, else to arrival
+            deadline_at = (enq if enq is not None else now_ms()) \
+                + float(deadline_ms)
+        return RecordMeta(t_in, rec.get("uri", rid), enq,
+                          rec.get("dequeue_ts_ms"), deadline_at)
+
+    def _backlog(self) -> int:
+        return sum(q.qsize() for q in self._backlog_queues)
+
+    def _shed(self, metas: Sequence[RecordMeta], code: str):
+        """Commit typed rejection payloads for records that cannot meet
+        their deadline (clients decode these as ServingRejected)."""
+        if not metas:
+            return
+        msg = ("deadline unmeetable at admission"
+               if code == SHED_DEADLINE else "deadline expired in queue")
+        payload = {}
+        for m in metas:
+            payload[m.uri] = json.dumps(
+                {"error": msg, "code": code}).encode()
+        self.db.put_results(payload)
+        self._count(shed=len(metas))
+
+    @staticmethod
+    def _timing_payload(meta: RecordMeta, disp_ts_ms: float,
+                        device_ms: float, done_ms: float) -> dict:
+        """Per-row latency decomposition committed with the result:
+        transport_in_ms (client enqueue → backend dequeue), queue_ms
+        (dequeue → dispatch), device_ms (dispatch → host transfer done),
+        server_ms (dequeue → result committed).  The client adds
+        rtt_ms/transport_ms from its own receive stamp."""
+        t = {"device_ms": round(device_ms, 3), "done_ts_ms": round(done_ms, 3)}
+        if meta.enqueue_ts_ms is not None:
+            t["enqueue_ts_ms"] = meta.enqueue_ts_ms
+        if meta.dequeue_ts_ms is not None:
+            t["dequeue_ts_ms"] = meta.dequeue_ts_ms
+            t["queue_ms"] = round(max(disp_ts_ms - meta.dequeue_ts_ms,
+                                      0.0), 3)
+            t["server_ms"] = round(max(done_ms - meta.dequeue_ts_ms,
+                                       0.0), 3)
+            if meta.enqueue_ts_ms is not None:
+                t["transport_in_ms"] = round(
+                    max(meta.dequeue_ts_ms - meta.enqueue_ts_ms, 0.0), 3)
+        return t
+
+    def _record_row_timing(self, timing: dict):
+        """Feed the decomposition into the summary so percentiles for
+        the new stages ride the existing snapshot machinery."""
+        self.summary.record_stage("device", timing["device_ms"] / 1e3)
+        if "transport_in_ms" in timing:
+            self.summary.record_stage("transport",
+                                      timing["transport_in_ms"] / 1e3)
+        if "queue_ms" in timing:
+            self.summary.record_stage("queue_wait", timing["queue_ms"] / 1e3)
 
     # ------------------------------------------------------------------
     # synchronous loop (the pre-pipeline baseline, pipelined=False)
@@ -241,11 +384,12 @@ class ClusterServing:
             self._process_chunk(items[i:i + bs], t_in)
 
     def _process_chunk(self, items, t_in: Optional[float] = None):
-        uris, arrays = [], []
+        metas, arrays = [], []
         for rid, rec in items:
             try:
                 arrays.append(self._decode_record(rec))
-                uris.append(rec.get("uri", rid))
+                metas.append(self._meta_for(rid, rec,
+                                            t_in or time.perf_counter()))
             except Exception as e:  # bad record: report, keep serving
                 logger.warning("skipping record %s: %s", rid, e)
                 self._count(dropped=1)
@@ -258,15 +402,22 @@ class ClusterServing:
         if n < self.helper.batch_size:
             pad = np.repeat(batch[-1:], self.helper.batch_size - n, axis=0)
             batch = np.concatenate([batch, pad])
+        disp_ts_ms = now_ms()
         t0 = time.perf_counter()
         preds = np.asarray(self.model.predict(batch))[:n]
         dt = time.perf_counter() - t0
         self.summary.record_batch(n, dt)
+        self.admission.observe_batch(n, dt)
         self._count(batches=1, records_in=n)
         self.bucket_counts[batch.shape[0]] += 1
+        done_ms = now_ms()
         results = {}
-        for uri, p in zip(uris, preds):
-            results[uri] = json.dumps(self._format_result(p)).encode()
+        for meta, p in zip(metas, preds):
+            obj = self._format_result(p)
+            obj["timing"] = self._timing_payload(
+                meta, disp_ts_ms, dt * 1e3, done_ms)
+            self._record_row_timing(obj["timing"])
+            results[meta.uri] = json.dumps(obj).encode()
         self.db.put_results(results)
         self._count(results_out=n)
         if t_in is not None:
@@ -287,10 +438,10 @@ class ClusterServing:
     # ------------------------------------------------------------------
     # pipelined loop (decode pool -> bucketed async compute -> writer)
     # ------------------------------------------------------------------
-    def _ready_item(self, t_in: float, rid: str, rec: dict, arr):
+    def _ready_item(self, meta: RecordMeta, rec: dict, arr):
         """Tuple pushed onto the ready queue for one decoded record; the
         registry router appends the record's routing fields."""
-        return (t_in, rec.get("uri", rid), arr)
+        return (meta, arr)
 
     def _on_decode_error(self, rid: str, rec: dict, exc: Exception):
         """Undecodable record; the router dead-letters instead."""
@@ -302,7 +453,7 @@ class ClusterServing:
             item = decode_in.get()
             if item is _SENTINEL:
                 return
-            t_in, rid, rec = item
+            meta, rid, rec = item
             t0 = time.perf_counter()
             try:
                 arr = self._decode_record(rec)
@@ -310,7 +461,13 @@ class ClusterServing:
                 self._on_decode_error(rid, rec, e)
                 continue
             self.summary.record_stage("decode", time.perf_counter() - t0)
-            ready.put(self._ready_item(t_in, rid, rec, arr))
+            ready.put(self._ready_item(meta, rec, arr))
+
+    @staticmethod
+    def _oldest_deadline(batch_items) -> Optional[float]:
+        deadlines = [it[0].deadline_at_ms for it in batch_items
+                     if it[0].deadline_at_ms is not None]
+        return min(deadlines) if deadlines else None
 
     def _compute_loop(self, ready: queue.Queue, write_q: queue.Queue):
         bs = self.helper.batch_size
@@ -320,13 +477,23 @@ class ClusterServing:
                 return
             batch_items, saw_sentinel = [item], False
             # greedy assembly: take whatever is already decoded, up to
-            # batch_size — no artificial linger, buckets absorb the
-            # partial batches
+            # batch_size; with a linger budget (params.linger_ms) the
+            # assembler may additionally block a bounded moment to round
+            # a partial batch up to the next padding bucket — never past
+            # the oldest queued record's deadline slack
             while len(batch_items) < bs:
                 try:
                     nxt = ready.get_nowait()
                 except queue.Empty:
-                    break
+                    budget = self.batcher.linger_budget_s(
+                        len(batch_items),
+                        self._oldest_deadline(batch_items))
+                    if budget <= 0.0:
+                        break
+                    try:
+                        nxt = ready.get(timeout=budget)
+                    except queue.Empty:
+                        break
                 if nxt is _SENTINEL:
                     saw_sentinel = True
                     break
@@ -336,9 +503,21 @@ class ClusterServing:
                 return
 
     def _dispatch_batch(self, batch_items, write_q: queue.Queue):
-        t_ins = [it[0] for it in batch_items]
-        uris = [it[1] for it in batch_items]
-        arrays = [it[2] for it in batch_items]
+        # second shed point: a record whose deadline expired while it
+        # sat decoded in the ready queue gets a typed rejection instead
+        # of a batch slot nobody is waiting on
+        at = now_ms()
+        live, expired = [], []
+        for it in batch_items:
+            if self.admission.expired(it[0].deadline_at_ms, at):
+                expired.append(it[0])
+            else:
+                live.append(it)
+        self._shed(expired, SHED_EXPIRED)
+        if not live:
+            return
+        metas = [it[0] for it in live]
+        arrays = [it[1] for it in live]
         n = len(arrays)
         bucket = pick_bucket(n, self.buckets)
         try:
@@ -346,6 +525,7 @@ class ClusterServing:
             if n < bucket:
                 pad = np.repeat(batch[-1:], bucket - n, axis=0)
                 batch = np.concatenate([batch, pad])
+            disp_ts_ms = now_ms()
             t0 = time.perf_counter()
             # async dispatch: don't block on the host transfer of batch
             # k before submitting k+1 — the writer stage synchronizes
@@ -358,14 +538,14 @@ class ClusterServing:
         self._count(batches=1)
         with self._ctr_lock:
             self.bucket_counts[bucket] += 1
-        write_q.put((t_ins, uris, n, t0, out))
+        write_q.put((metas, n, t0, disp_ts_ms, out))
 
     def _writer_loop(self, write_q: queue.Queue):
         while True:
             item = write_q.get()
             if item is _SENTINEL:
                 return
-            t_ins, uris, n, t_disp, out = item
+            metas, n, t_disp, disp_ts_ms, out = item
             try:
                 preds = np.asarray(out)[:n]   # host transfer = sync point
             except Exception as e:
@@ -376,21 +556,29 @@ class ClusterServing:
             dt = time.perf_counter() - t_disp
             self.summary.record_batch(n, dt)   # Throughput/LatencyMs parity
             self.summary.record_stage("compute", dt, batch_size=n)
+            # feed the admission controller's service-time estimates
+            self.admission.observe_batch(n, dt)
+            done_ms = now_ms()
             t0 = time.perf_counter()
             results = {}
-            for uri, p in zip(uris, preds):
-                results[uri] = json.dumps(self._format_result(p)).encode()
+            for meta, p in zip(metas, preds):
+                obj = self._format_result(p)
+                obj["timing"] = self._timing_payload(
+                    meta, disp_ts_ms, dt * 1e3, done_ms)
+                self._record_row_timing(obj["timing"])
+                results[meta.uri] = json.dumps(obj).encode()
             self.db.put_results(results)
             now = time.perf_counter()
             self.summary.record_stage("write", now - t0, batch_size=n)
-            for t_in in t_ins:
-                self.summary.record_stage("e2e", now - t_in)
+            for meta in metas:
+                self.summary.record_stage("e2e", now - meta.t_in)
             self._count(results_out=n)
 
     def _serve_pipelined(self, poll_timeout: float = 0.5):
         decode_in: queue.Queue = queue.Queue(self.queue_depth)
         ready: queue.Queue = queue.Queue(self.queue_depth)
         write_q: queue.Queue = queue.Queue(self.queue_depth)
+        self._backlog_queues = [decode_in, ready]
         decoders = [threading.Thread(target=self._decode_worker,
                                      args=(decode_in, ready), daemon=True,
                                      name=f"serving-decode-{i}")
@@ -410,7 +598,17 @@ class ClusterServing:
                 if items:
                     now = time.perf_counter()
                     for rid, rec in items:
-                        decode_in.put((now, rid, rec))  # backpressure here
+                        meta = self._meta_for(rid, rec, now)
+                        # first shed point: admission control against the
+                        # measured service time + live backlog
+                        if meta.deadline_at_ms is not None:
+                            slack = meta.deadline_at_ms - now_ms()
+                            ok, code = self.admission.admit(
+                                slack, self._backlog())
+                            if not ok:
+                                self._shed([meta], code)
+                                continue
+                        decode_in.put((meta, rid, rec))  # backpressure here
                     self._count(records_in=len(items))
                     self.summary.record_queue_depth("decode",
                                                     decode_in.qsize())

@@ -1,0 +1,262 @@
+"""ServingFleet: N supervised serving worker processes over one queue.
+
+The reference scales Cluster Serving by running multiple Flink task
+replicas behind Redis pub/sub; here the fleet manager composes the
+pieces this repo already has (docs/serving-fleet.md):
+
+- **supervision** comes from the launcher seam
+  (:mod:`analytics_zoo_tpu.launcher.supervisor`): each worker is a
+  subprocess with env propagation, ``[fleet-N]``-tagged log fan-in into
+  one stream, and SIGTERM→SIGKILL teardown;
+- **work partitioning** is the queue backend's delivery contract: the
+  file transport's atomic rename *claim* hands each record to exactly
+  one worker process (queue_backend.py), so no record is double-served
+  — workers share ``data.src`` and nothing else on the hot path;
+- **control plane**: all workers recover the same registry manifest;
+  worker 0 owns the file-RPC :class:`RegistryControlServer` (and the
+  manifest writes), workers >0 follow the manifest by mtime
+  (fleet_worker.py);
+- **health**: every worker heartbeats an atomic JSON file under
+  ``<workdir>/health/`` (pid, records served, shed count).  The
+  supervise loop restarts a worker whose process died *or* whose
+  heartbeat went stale past ``health_timeout`` (after a startup grace
+  for interpreter + jax import).
+
+``zoo-serving status`` renders :func:`fleet_status` rows from the same
+health files, so fleet observability needs no RPC into the workers.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..launcher.supervisor import (SupervisedProc, inject_pythonpath,
+                                   spawn_supervised, terminate_all)
+from ..utils import file_io
+
+logger = logging.getLogger("analytics_zoo_tpu.serving.fleet")
+
+HEALTH_DIR = "health"
+
+
+def health_path(workdir: str, worker_id: int) -> str:
+    return os.path.join(workdir, HEALTH_DIR, f"worker-{worker_id}.json")
+
+
+def write_health(workdir: str, worker_id: int, payload: dict):
+    """Atomic heartbeat write (rename) — readers never see a torn file."""
+    payload = dict(payload, worker_id=worker_id, ts=time.time())
+    file_io.write_bytes_atomic(health_path(workdir, worker_id),
+                               json.dumps(payload).encode())
+
+
+def read_health(workdir: str, worker_id: int) -> Optional[dict]:
+    try:
+        with open(health_path(workdir, worker_id)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def fleet_status(workdir: str) -> List[dict]:
+    """Per-worker status rows from the health files: worker id, pid,
+    heartbeat age, liveness (signal-0 probe), records served, shed count.
+    Works from any process — `zoo-serving status` renders these."""
+    hdir = os.path.join(workdir, HEALTH_DIR)
+    rows = []
+    try:
+        names = sorted(n for n in os.listdir(hdir)
+                       if n.startswith("worker-") and n.endswith(".json"))
+    except FileNotFoundError:
+        return rows
+    now = time.time()
+    for name in names:
+        try:
+            with open(os.path.join(hdir, name)) as f:
+                h = json.load(f)
+        except (OSError, ValueError):
+            continue
+        pid = h.get("pid")
+        alive = False
+        if pid:
+            try:
+                os.kill(int(pid), 0)
+                alive = True
+            except (OSError, ValueError):
+                alive = False
+        rows.append({
+            "worker_id": h.get("worker_id"),
+            "pid": pid,
+            "alive": alive,
+            "health_age_s": round(now - h.get("ts", 0.0), 2),
+            "records_served": h.get("records_served", 0),
+            "shed": h.get("shed", 0),
+            "restarts": h.get("restarts", 0),
+        })
+    return rows
+
+
+class ServingFleet:
+    """Spawn, heartbeat-watch, and restart N serving workers.
+
+    ``config_path`` is the standard serving ``config.yaml`` (all workers
+    share it; ``data.src`` must be a cross-process transport —
+    ``file:<dir>`` or redis).  Worker count and health knobs default to
+    the config's ``params.workers`` / ``params.health_*``.
+    """
+
+    def __init__(self, config_path: str, workdir: str,
+                 workers: Optional[int] = None,
+                 health_interval: Optional[float] = None,
+                 health_timeout: Optional[float] = None,
+                 grace_s: float = 5.0, startup_grace_s: float = 60.0,
+                 stream=None, env: Optional[Dict[str, str]] = None,
+                 python: Optional[str] = None):
+        from .cluster_serving import ClusterServingHelper
+
+        self.config_path = os.path.abspath(config_path)
+        self.workdir = os.path.abspath(workdir)
+        helper = ClusterServingHelper(config_path=self.config_path)
+        self.workers = int(workers if workers is not None
+                           else helper.workers)
+        if self.workers < 1:
+            raise ValueError(f"need >= 1 worker, got {self.workers}")
+        self.health_interval = float(
+            health_interval if health_interval is not None
+            else helper.health_interval)
+        self.health_timeout = float(
+            health_timeout if health_timeout is not None
+            else helper.health_timeout)
+        self.grace_s = float(grace_s)
+        self.startup_grace_s = float(startup_grace_s)
+        self.stream = stream if stream is not None else sys.stdout
+        self.env = dict(env or {})
+        self.python = python or sys.executable
+        self._lock = threading.Lock()
+        self._procs: Dict[int, SupervisedProc] = {}
+        self._spawned_at: Dict[int, float] = {}
+        self.restarts: Dict[int, int] = {}
+        self._stop = threading.Event()
+        os.makedirs(os.path.join(self.workdir, HEALTH_DIR), exist_ok=True)
+
+    # -- lifecycle ------------------------------------------------------
+    def _worker_env(self, worker_id: int) -> dict:
+        env = inject_pythonpath(dict(os.environ))
+        env.update(self.env)
+        env["ZOO_SERVING_WORKER_ID"] = str(worker_id)
+        env["ZOO_SERVING_FLEET_SIZE"] = str(self.workers)
+        env["ZOO_SERVING_WORKER_RESTARTS"] = str(
+            self.restarts.get(worker_id, 0))
+        return env
+
+    def _spawn(self, worker_id: int):
+        # drop the previous heartbeat so a freshly restarted worker is
+        # not judged by its predecessor's stale file
+        try:
+            os.remove(health_path(self.workdir, worker_id))
+        except OSError:
+            pass
+        cmd = [self.python, "-m", "analytics_zoo_tpu.serving.fleet_worker",
+               "--config", self.config_path, "--workdir", self.workdir,
+               "--worker-id", str(worker_id)]
+        sp = spawn_supervised(cmd, env=self._worker_env(worker_id),
+                              tag=f"fleet-{worker_id}", stream=self.stream,
+                              lock=self._lock, prefix=True)
+        self._procs[worker_id] = sp
+        self._spawned_at[worker_id] = time.time()
+        logger.info("fleet: worker-%d spawned (pid %d)", worker_id,
+                    sp.proc.pid)
+
+    def start(self) -> "ServingFleet":
+        self._stop.clear()
+        for wid in range(self.workers):
+            self._spawn(wid)
+        return self
+
+    def poll_once(self) -> List[int]:
+        """One supervision pass: restart workers whose process exited or
+        whose heartbeat is stale.  Returns the worker ids restarted."""
+        restarted = []
+        now = time.time()
+        for wid, sp in list(self._procs.items()):
+            rc = sp.proc.poll()
+            stale = False
+            if rc is None:
+                h = read_health(self.workdir, wid)
+                age = now - h["ts"] if h else now - self._spawned_at[wid]
+                grace = (self.startup_grace_s if h is None
+                         else self.health_timeout)
+                stale = age > max(grace, self.health_timeout)
+            if rc is None and not stale:
+                continue
+            if self._stop.is_set():
+                continue
+            reason = (f"exited rc={rc}" if rc is not None
+                      else "heartbeat stale")
+            self.restarts[wid] = self.restarts.get(wid, 0) + 1
+            with self._lock:
+                self.stream.write(
+                    f"[fleet] worker-{wid} {reason}; restarting "
+                    f"(restart #{self.restarts[wid]})\n")
+                self.stream.flush()
+            if rc is None:
+                terminate_all([sp.proc], self.grace_s)
+            self._spawn(wid)
+            restarted.append(wid)
+        return restarted
+
+    def supervise(self, poll_s: float = 0.25):
+        """Block supervising until :meth:`stop` (or KeyboardInterrupt)."""
+        try:
+            while not self._stop.is_set():
+                self.poll_once()
+                if self._stop.wait(poll_s):
+                    break
+        finally:
+            self.shutdown()
+
+    def wait_healthy(self, timeout: float = 60.0) -> bool:
+        """Block until every worker has written a heartbeat (i.e. its
+        serve loop is up), or ``timeout`` elapses."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if all(read_health(self.workdir, w) is not None
+                   for w in range(self.workers)):
+                return True
+            time.sleep(0.05)
+        return False
+
+    def stop(self):
+        self._stop.set()
+
+    def shutdown(self):
+        """SIGTERM every worker (they drain their pipelines), SIGKILL
+        stragglers after the grace period."""
+        self._stop.set()
+        terminate_all([sp.proc for sp in self._procs.values()],
+                      self.grace_s)
+        for sp in self._procs.values():
+            sp.pump.join(timeout=5.0)
+
+    # -- observability --------------------------------------------------
+    def status(self) -> List[dict]:
+        return fleet_status(self.workdir)
+
+    def worker_stats(self) -> List[dict]:
+        """Per-worker pipeline_stats() snapshots (from each worker's
+        stats-worker-N.json dump); missing/unreadable files are skipped."""
+        out = []
+        for wid in range(self.workers):
+            path = os.path.join(self.workdir, f"stats-worker-{wid}.json")
+            try:
+                with open(path) as f:
+                    out.append(dict(json.load(f), worker_id=wid))
+            except (OSError, ValueError):
+                continue
+        return out

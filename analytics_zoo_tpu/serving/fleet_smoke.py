@@ -1,0 +1,177 @@
+"""Serving-fleet end-to-end smoke (``scripts/fleet-smoke``; CI fast tier).
+
+Brings up a 2-worker :class:`ServingFleet` over the file queue backend
+with the deterministic echo stub model and asserts the fleet contract
+(docs/serving-fleet.md):
+
+- **no double-serving**: every enqueued uri gets exactly one result with
+  *its own* record's value, the workers' combined ``results_out`` equals
+  the offered record count, and no worker's consumer ledger saw a
+  duplicate delivery;
+- **restart**: a SIGKILLed worker is detected and replaced (new pid,
+  fresh heartbeat) within the health timeout, and the fleet keeps
+  serving afterwards;
+- **typed shedding**: a request with an unmeetable ``deadline_ms`` comes
+  back as a typed rejection (``shed_deadline``/``shed_expired``), not a
+  silent timeout.
+
+Exit 0 on success, 1 on any violated assertion (printing the fan-in
+worker log for diagnosis).
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import os
+import shutil
+import signal
+import sys
+import tempfile
+import threading
+import time
+
+CONFIG_TMPL = """\
+model:
+  stub_ms_per_batch: {stub_ms}
+
+data:
+  src: file:{stream_dir}
+  image_shape: 3, 4, 4
+
+params:
+  batch_size: 8
+  top_n: 0
+  workers: 2
+  health_interval: 0.25
+  health_timeout: {health_timeout}
+"""
+
+
+def run_smoke(records: int = 96, stub_ms: float = 2.0,
+              health_timeout: float = 3.0, stream=None) -> int:
+    import numpy as np
+
+    from .client import (InputQueue, OutputQueue, ServingRejected)
+    from .fleet import ServingFleet, read_health
+    from .queue_backend import FileStreamQueue
+
+    out = stream if stream is not None else sys.stdout
+    workdir = tempfile.mkdtemp(prefix="zoo_fleet_smoke_")
+    stream_dir = os.path.join(workdir, "stream")
+    cfg = os.path.join(workdir, "config.yaml")
+    with open(cfg, "w") as f:
+        f.write(CONFIG_TMPL.format(stub_ms=stub_ms, stream_dir=stream_dir,
+                                   health_timeout=health_timeout))
+    shape = (3, 4, 4)
+    cap = io.StringIO()
+
+    def fail(msg):
+        out.write(cap.getvalue())
+        out.write(f"FLEET_SMOKE_FAIL: {msg}\n")
+        return 1
+
+    fleet = ServingFleet(cfg, workdir, stream=cap,
+                         env={"JAX_PLATFORMS": "cpu"})
+    sup = threading.Thread(target=fleet.supervise, daemon=True)
+    try:
+        fleet.start()
+        sup.start()
+        if not fleet.wait_healthy(timeout=90.0):
+            return fail("workers never became healthy")
+
+        # -- phase 1: partitioned serving, no double-delivery ----------
+        in_q = InputQueue(backend=FileStreamQueue(stream_dir))
+        out_q = OutputQueue(backend=FileStreamQueue(stream_dir))
+        uris = [f"u-{i}" for i in range(records)]
+        for i, uri in enumerate(uris):
+            in_q.enqueue(uri, input=np.full(shape, i, np.float32))
+        got = out_q.wait_all(uris, timeout=90.0)
+        if len(got) != records:
+            return fail(f"only {len(got)}/{records} results")
+        for i, uri in enumerate(uris):
+            v = got[uri]
+            if isinstance(v, Exception):
+                return fail(f"{uri} errored: {v}")
+            if abs(float(np.asarray(v).ravel()[0]) - i) > 1e-4:
+                return fail(f"{uri} value {float(v)} != {i} (cross-wired)")
+        # the workers' own counters must account for every record exactly
+        # once (stats dumps are periodic — poll until they catch up)
+        deadline = time.time() + 20.0
+        served = split = None
+        while time.time() < deadline:
+            stats = fleet.worker_stats()
+            split = {s["worker_id"]: s.get("results_out", 0) for s in stats}
+            served = sum(split.values())
+            dups = sum((s.get("queue") or {}).get("duplicates", 0)
+                       for s in stats)
+            if served >= records and len(split) == fleet.workers:
+                break
+            time.sleep(0.5)
+        if served != records:
+            return fail(f"combined results_out {served} != {records} "
+                        f"(split {split}) — double or lost serving")
+        if dups:
+            return fail(f"{dups} duplicate deliveries in consumer ledgers")
+
+        # -- phase 2: SIGKILL a worker; supervision must replace it ----
+        victim = 1
+        h0 = read_health(workdir, victim)
+        if not h0:
+            return fail("no health file for victim worker")
+        os.kill(int(h0["pid"]), signal.SIGKILL)
+        t_kill = time.time()
+        replaced = False
+        while time.time() - t_kill < health_timeout + 60.0:
+            h1 = read_health(workdir, victim)
+            if h1 and h1["pid"] != h0["pid"]:
+                replaced = True
+                break
+            time.sleep(0.1)
+        if not replaced:
+            return fail(f"worker {victim} not replaced after SIGKILL")
+        if fleet.restarts.get(victim, 0) < 1:
+            return fail("fleet restart counter did not move")
+        # fleet still serves end-to-end after the restart
+        uris2 = [f"v-{i}" for i in range(16)]
+        for i, uri in enumerate(uris2):
+            in_q.enqueue(uri, input=np.full(shape, 100 + i, np.float32))
+        got2 = out_q.wait_all(uris2, timeout=60.0)
+        if len(got2) != len(uris2):
+            return fail(f"post-restart: only {len(got2)}/{len(uris2)} "
+                        f"results")
+
+        # -- phase 3: unmeetable deadline -> typed rejection -----------
+        in_q.enqueue("doomed", deadline_ms=1.0,
+                     input=np.full(shape, 1, np.float32))
+        got3 = out_q.wait_all(["doomed"], timeout=30.0)
+        v = got3.get("doomed")
+        if not isinstance(v, ServingRejected):
+            return fail(f"expected ServingRejected for doomed request, "
+                        f"got {type(v).__name__}: {v}")
+        if v.code not in ("shed_deadline", "shed_expired"):
+            return fail(f"unexpected shed code {v.code!r}")
+
+        out.write(f"FLEET_SMOKE_OK workers={fleet.workers} "
+                  f"records={records} split={split} "
+                  f"restarted=worker-{victim} shed_code={v.code}\n")
+        return 0
+    finally:
+        fleet.stop()
+        sup.join(timeout=30.0)
+        fleet.shutdown()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="fleet-smoke")
+    ap.add_argument("--records", type=int, default=96)
+    ap.add_argument("--stub-ms", type=float, default=2.0)
+    ap.add_argument("--health-timeout", type=float, default=3.0)
+    args = ap.parse_args(argv)
+    return run_smoke(records=args.records, stub_ms=args.stub_ms,
+                     health_timeout=args.health_timeout)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,159 @@
+"""One serving-fleet worker process (spawned by serving/fleet.py).
+
+Runs the standard pipelined serve loop against the shared transport,
+plus the fleet-specific plumbing (docs/serving-fleet.md):
+
+- **heartbeat**: a daemon thread writes ``health/worker-N.json`` every
+  ``params.health_interval`` seconds with pid, records served, and shed
+  count — the fleet manager's liveness signal and `zoo-serving status`'s
+  data source;
+- **registry sharing**: worker 0 owns the file-RPC control plane (and
+  manifest writes); workers >0 watch the manifest's mtime and
+  ``recover(save=False)`` on change, so a deploy/promote through worker
+  0 reaches every replica without cross-process RPC;
+- **teardown**: SIGTERM/SIGINT set the serve loop's stop event — the
+  pipeline drains in order (no in-flight record is lost) before exit.
+
+Usage (normally via ServingFleet, runnable standalone for debugging)::
+
+    python -m analytics_zoo_tpu.serving.fleet_worker \
+        --config config.yaml --workdir /tmp/fleet --worker-id 0
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import sys
+import threading
+import time
+
+from .fleet import HEALTH_DIR, write_health
+
+logger = logging.getLogger("analytics_zoo_tpu.serving.fleet_worker")
+
+
+def _build_serving(cfg: str, workdir: str, worker_id: int):
+    """Worker-side twin of cli._build_serving: per-worker stats path,
+    control-plane ownership only on worker 0, manifest following on the
+    rest."""
+    from .cluster_serving import ClusterServing, ClusterServingHelper
+
+    helper = ClusterServingHelper(config_path=cfg)
+    helper.stats_path = os.path.join(workdir,
+                                     f"stats-worker-{worker_id}.json")
+    if not helper.registry_root:
+        return ClusterServing(helper=helper), None
+    from .registry import ModelRegistry, RegistryControlServer
+    from .router import RoutedClusterServing
+
+    registry = ModelRegistry(
+        root=helper.registry_root,
+        default_model=helper.default_model,
+        canary_error_threshold=helper.canary_error_threshold,
+        canary_min_requests=helper.canary_min_requests)
+    serving = RoutedClusterServing(registry, helper=helper)
+    registry.recover(load=True, warmup=serving.registry_warmup(),
+                     save=worker_id == 0)
+    ctl = None
+    if worker_id == 0:
+        if helper.model_path and not registry.routed_versions():
+            serving.deploy(path=helper.model_path)
+        ctl = RegistryControlServer(registry, helper.registry_root,
+                                    serving=serving).start()
+    return serving, ctl
+
+
+def _watch_manifest(serving, stop: threading.Event, interval: float = 1.0):
+    """Followers poll the shared manifest's mtime; on change, re-recover
+    (idempotent over loaded versions, never writes the manifest)."""
+    registry = serving.registry
+    uri = registry.manifest_uri
+    last = None
+    while not stop.wait(interval):
+        try:
+            mtime = os.path.getmtime(uri)
+        except OSError:
+            continue
+        if last is not None and mtime != last:
+            try:
+                registry.recover(load=True,
+                                 warmup=serving.registry_warmup(),
+                                 save=False)
+                logger.info("manifest change picked up")
+            except Exception as e:  # noqa: BLE001 - keep serving
+                logger.warning("manifest refresh failed: %s", e)
+        last = mtime
+
+
+def _heartbeat(serving, workdir: str, worker_id: int,
+               stop: threading.Event, interval: float, restarts: int):
+    started = time.time()
+    while True:
+        with serving._ctr_lock:
+            served, shed = serving.results_out, serving.shed
+        write_health(workdir, worker_id, {
+            "pid": os.getpid(),
+            "started_at": started,
+            "records_served": served,
+            "shed": shed,
+            "restarts": restarts,
+        })
+        if stop.wait(interval):
+            return
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="zoo-serving-fleet-worker")
+    ap.add_argument("--config", required=True)
+    ap.add_argument("--workdir", required=True)
+    ap.add_argument("--worker-id", type=int, required=True)
+    args = ap.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format=f"%(asctime)s worker-{args.worker_id} %(message)s")
+    # honor JAX_PLATFORMS even when a TPU plugin is registered (the env
+    # var alone is ignored then; the config update is authoritative)
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        try:
+            import jax
+            jax.config.update("jax_platforms", plat)
+        except Exception:  # noqa: BLE001 - serving may not need jax yet
+            pass
+    workdir = os.path.abspath(args.workdir)
+    os.makedirs(os.path.join(workdir, HEALTH_DIR), exist_ok=True)
+    serving, _ctl = _build_serving(args.config, workdir, args.worker_id)
+    if serving.helper.warmup:
+        serving.warmup()
+    stop = threading.Event()
+
+    def _term(_sig, _frm):
+        stop.set()
+        serving._stop.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    restarts = int(os.environ.get("ZOO_SERVING_WORKER_RESTARTS", "0"))
+    hb = threading.Thread(
+        target=_heartbeat,
+        args=(serving, workdir, args.worker_id, stop,
+              float(serving.helper.health_interval), restarts),
+        daemon=True, name="fleet-heartbeat")
+    hb.start()
+    if args.worker_id > 0 and getattr(serving, "registry", None) is not None:
+        threading.Thread(target=_watch_manifest, args=(serving, stop),
+                         daemon=True, name="fleet-manifest-watch").start()
+    logger.info("fleet worker %d serving (pid %d)", args.worker_id,
+                os.getpid())
+    try:
+        serving.serve_forever()
+    finally:
+        stop.set()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,6 +15,17 @@ transport-agnostic:
 All three implement XADD-like ``enqueue``, XREAD-like ``read_batch``, a
 results hash (``put_result``/``get_result``), and the memory-watermark trim
 (ClusterServing.scala:130-136).
+
+Latency decomposition (docs/serving-fleet.md): ``read_batch`` stamps
+every delivered record with ``dequeue_ts_ms`` (epoch ms) so the serving
+loop can split wire/transport time (``dequeue_ts_ms - enqueue_ts_ms``,
+the client stamps the latter) from device time.
+
+Fleet delivery contract: :class:`FileStreamQueue` claims records by
+atomic rename, so N worker processes reading one stream directory never
+double-serve a record; each consumer additionally tracks delivered
+record ids (duplicate redelivery is detected and skipped) and
+per-producer sequence gaps — see :meth:`FileStreamQueue.consumer_stats`.
 """
 
 from __future__ import annotations
@@ -25,7 +36,7 @@ import tempfile
 import threading
 import time
 import uuid
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from typing import Dict, List, Optional, Tuple
 
 import msgpack
@@ -64,6 +75,17 @@ class StreamQueue:
         """Watermark trim (xtrim parity)."""
         raise NotImplementedError
 
+    @staticmethod
+    def _stamp_dequeue(items: List[Tuple[str, dict]]
+                       ) -> List[Tuple[str, dict]]:
+        """Stamp delivery time (epoch ms) on every record so the server
+        can report transport vs device latency per row."""
+        ts = time.time() * 1e3
+        for _rid, rec in items:
+            if isinstance(rec, dict):
+                rec.setdefault("dequeue_ts_ms", ts)
+        return items
+
 
 class InProcessStreamQueue(StreamQueue):
     def __init__(self, name: str = "image_stream"):
@@ -88,7 +110,7 @@ class InProcessStreamQueue(StreamQueue):
             while self._stream and len(out) < max_items:
                 rid, rec = self._stream.popitem(last=False)
                 out.append((rid, rec))
-            return out
+            return self._stamp_dequeue(out)
 
     def put_result(self, uri, value):
         with self._cv:
@@ -126,6 +148,9 @@ class FileStreamQueue(StreamQueue):
     Results land in ``<root>/results/<safe-uri>``.  Good enough for
     multi-process single-host serving without Redis."""
 
+    #: delivered-rid memory per consumer (duplicate detection window)
+    DELIVERED_WINDOW = 65536
+
     def __init__(self, root: str, name: str = "image_stream",
                  orphan_tmp_age: float = 60.0):
         self.root = root
@@ -136,12 +161,23 @@ class FileStreamQueue(StreamQueue):
         # per-producer monotonic sequence: timestamp collisions (same
         # time_ns on fast enqueues, coarse clocks) still sort FIFO
         self._seq = itertools.count()
+        # producer identity baked into every rid so a consumer can track
+        # per-producer sequence continuity under concurrent writers
+        self._producer = uuid.uuid4().hex[:8]
         self.orphan_tmp_age = orphan_tmp_age
         self._last_gc = 0.0
+        # consumer-side delivery ledger: rids served by THIS instance
+        # (bounded ring), per-producer last-seen seq, and the counters
+        # consumer_stats() reports
+        self._delivered: set = set()
+        self._delivered_ring: deque = deque()
+        self._producer_seq: Dict[str, int] = {}
+        self._duplicates = 0
+        self._seq_gaps = 0
 
     def enqueue(self, record):
-        rid = (f"{time.time_ns():020d}-{next(self._seq):08d}"
-               f"-{uuid.uuid4().hex[:8]}")
+        rid = (f"{time.time_ns():020d}-{self._producer}"
+               f"-{next(self._seq):08d}")
         payload = msgpack.packb(record, use_bin_type=True)
         fd, tmp = tempfile.mkstemp(dir=self.stream_dir, suffix=".tmp")
         with os.fdopen(fd, "wb") as f:
@@ -176,6 +212,40 @@ class FileStreamQueue(StreamQueue):
                 except OSError:
                     pass
 
+    def _note_delivery(self, rid: str) -> bool:
+        """Record one delivery; False when ``rid`` was already served by
+        this consumer (duplicate redelivery — e.g. an operator restoring
+        ``.claimed`` orphans a second time) and must be skipped."""
+        if rid in self._delivered:
+            self._duplicates += 1
+            return False
+        self._delivered.add(rid)
+        self._delivered_ring.append(rid)
+        while len(self._delivered_ring) > self.DELIVERED_WINDOW:
+            self._delivered.discard(self._delivered_ring.popleft())
+        # per-producer sequence continuity (advisory: a gap means a
+        # record this consumer never saw — lost, trimmed, or claimed by
+        # another fleet worker; per-worker gaps are expected in a fleet,
+        # a gap with ONE consumer means loss)
+        parts = rid.rsplit("-", 2)
+        if len(parts) == 3:
+            try:
+                seq = int(parts[2])
+            except ValueError:
+                return True
+            last = self._producer_seq.get(parts[1])
+            if last is not None and seq > last + 1:
+                self._seq_gaps += seq - last - 1
+            if last is None or seq > last:
+                self._producer_seq[parts[1]] = seq
+        return True
+
+    def consumer_stats(self) -> dict:
+        """Delivery-integrity counters for THIS consumer instance."""
+        return {"duplicates": self._duplicates,
+                "seq_gaps": self._seq_gaps,
+                "producers_seen": len(self._producer_seq)}
+
     def read_batch(self, max_items, timeout=1.0):
         self._gc_orphans()
         deadline = time.time() + timeout
@@ -189,13 +259,16 @@ class FileStreamQueue(StreamQueue):
                 try:
                     os.rename(path, claimed)  # atomic claim
                 except OSError:
-                    continue
+                    continue    # another fleet worker won the claim
                 with open(claimed, "rb") as f:
                     rec = msgpack.unpackb(f.read(), raw=False)
                 os.unlink(claimed)
-                out.append((n[:-len(".msgpack")], rec))
+                rid = n[:-len(".msgpack")]
+                if not self._note_delivery(rid):
+                    continue    # duplicate redelivery: drop, don't serve
+                out.append((rid, rec))
             if out or time.time() >= deadline:
-                return out
+                return self._stamp_dequeue(out)
             time.sleep(0.02)
 
     @staticmethod
@@ -273,7 +346,7 @@ class RedisStreamQueue(StreamQueue):  # pragma: no cover - needs a server
                 self._last_id = rid
                 rec = {k.decode(): v for k, v in fields.items()}
                 out.append((rid.decode(), rec))
-        return out
+        return self._stamp_dequeue(out)
 
     def put_result(self, uri, value):
         self.r.hset("result:" + uri, "value", value)

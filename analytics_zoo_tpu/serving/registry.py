@@ -546,13 +546,19 @@ class ModelRegistry:
             uri, json.dumps(data, indent=2).encode())
 
     def recover(self, load: bool = True,
-                warmup: Optional[Callable] = None) -> "ModelRegistry":
+                warmup: Optional[Callable] = None,
+                save: bool = True) -> "ModelRegistry":
         """Rebuild the deployed set from the manifest.  With ``load``,
         the active (and canary) version of each model is re-loaded from
         its path and warmed; other versions stay ``cold`` (re-loadable
         via promote).  Load failures are logged and leave the version
         ``failed`` — the server still starts and dead-letters traffic
-        for that model rather than crashing."""
+        for that model rather than crashing.
+
+        Idempotent over loaded state: a version whose in-memory object
+        already holds a loaded model is kept, not replaced with a cold
+        shell — fleet workers call recover() on every manifest change
+        (docs/serving-fleet.md) and must not drop live models mid-serve."""
         uri = self.manifest_uri
         if uri is None or not file_io.exists(uri):
             return self
@@ -564,6 +570,9 @@ class ModelRegistry:
                 versions = self._models.setdefault(name, {})
                 for vd in m.get("versions", []):
                     v = int(vd["version"])
+                    prior = versions.get(v)
+                    if prior is not None and prior.model is not None:
+                        continue   # already live in this process
                     mv = ModelVersion(name, v, path=vd.get("path"),
                                       dtype=vd.get("dtype", "f32"),
                                       calibration=vd.get("calibration"))
@@ -588,7 +597,10 @@ class ModelRegistry:
                 except Exception as e:  # noqa: BLE001 - keep serving rest
                     logger.warning("recover: %s failed to load: %s",
                                    mv.key, e)
-            self._save()
+            if save:
+                # follower workers refresh with save=False: only the
+                # control-plane owner may rewrite the shared manifest
+                self._save()
         return self
 
     def _cold_routed(self) -> List[ModelVersion]:

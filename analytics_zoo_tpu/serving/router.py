@@ -30,6 +30,7 @@ from typing import Optional
 
 import numpy as np
 
+from .admission import SHED_EXPIRED, now_ms
 from .cluster_serving import (ClusterServing, ClusterServingHelper,
                               _SENTINEL, pick_bucket)
 from .registry import ModelRegistry
@@ -68,7 +69,7 @@ class RoutedClusterServing(ClusterServing):
         return None
 
     # -- decode stage: carry the routing fields ------------------------
-    def _ready_item(self, t_in, rid, rec, arr):
+    def _ready_item(self, meta, rec, arr):
         # Redis transports hand back bytes keys *and* values; normalize
         # here so routing compares strings/ints everywhere downstream
         model = _as_text(rec.get("model") or rec.get(b"model"))
@@ -76,7 +77,7 @@ class RoutedClusterServing(ClusterServing):
             version = _as_version(rec.get("version") or rec.get(b"version"))
         except (TypeError, ValueError):
             version = None
-        return (t_in, rec.get("uri", rid), arr, (model, version))
+        return (meta, arr, (model, version))
 
     def _on_decode_error(self, rid, rec, exc):
         uri = rec.get("uri", rid)
@@ -85,27 +86,36 @@ class RoutedClusterServing(ClusterServing):
 
     # -- compute stage: resolve routes, group, dispatch per version ----
     def _dispatch_batch(self, batch_items, write_q: queue.Queue):
+        # shed deadline-expired records before routing (same policy as
+        # the base engine's dispatch shed point)
+        at = now_ms()
+        live, expired = [], []
+        for it in batch_items:
+            if self.admission.expired(it[0].deadline_at_ms, at):
+                expired.append(it[0])
+            else:
+                live.append(it)
+        self._shed(expired, SHED_EXPIRED)
         groups, dead = {}, []
-        for t_in, uri, arr, (model, version) in batch_items:
+        for meta, arr, (model, version) in live:
             try:
-                mv = self.registry.route(model, version, uri=uri)
+                mv = self.registry.route(model, version, uri=meta.uri)
             except Exception as e:  # unknown model/version -> dead-letter
-                dead.append((uri, str(e) or repr(e), model, version))
+                dead.append((meta.uri, str(e) or repr(e), model, version))
                 continue
             # (model, version, dtype) + the bucket picked per group is
             # the full dispatch key: an int8 canary version never shares
             # a batch (or a compile-cache entry) with its f32 baseline
             groups.setdefault((mv.name, mv.version, mv.dtype),
-                              (mv, []))[1].append((t_in, uri, arr))
+                              (mv, []))[1].append((meta, arr))
         if dead:
             self._dead_letter(dead)
         for mv, items in groups.values():
             self._dispatch_to_version(mv, items, write_q)
 
     def _dispatch_to_version(self, mv, items, write_q: queue.Queue):
-        t_ins = [it[0] for it in items]
-        uris = [it[1] for it in items]
-        arrays = [it[2] for it in items]
+        metas = [it[0] for it in items]
+        arrays = [it[1] for it in items]
         n = len(arrays)
         bucket = pick_bucket(n, self.buckets)
         mv.acquire()  # held until the writer commits (promote drains it)
@@ -114,19 +124,20 @@ class RoutedClusterServing(ClusterServing):
             if n < bucket:
                 pad = np.repeat(batch[-1:], bucket - n, axis=0)
                 batch = np.concatenate([batch, pad])
+            disp_ts_ms = now_ms()
             t0 = time.perf_counter()
             out = mv.model.predict_async(batch)
         except Exception as e:
             mv.release()
             self.registry.record_result(mv, error=True, n=n)
-            self._dead_letter([(u, f"dispatch failed: {e}",
-                                mv.name, mv.version) for u in uris])
+            self._dead_letter([(m.uri, f"dispatch failed: {e}",
+                                mv.name, mv.version) for m in metas])
             return
         self.summary.record_stage("dispatch", time.perf_counter() - t0)
         self._count(batches=1)
         with self._ctr_lock:
             self.bucket_counts[f"{mv.key}:{bucket}:{mv.dtype}"] += 1
-        write_q.put((t_ins, uris, n, t0, out, mv))
+        write_q.put((metas, n, t0, disp_ts_ms, out, mv))
 
     # -- write stage: per-version accounting + refcount release --------
     def _writer_loop(self, write_q: queue.Queue):
@@ -134,29 +145,35 @@ class RoutedClusterServing(ClusterServing):
             item = write_q.get()
             if item is _SENTINEL:
                 return
-            t_ins, uris, n, t_disp, out, mv = item
+            metas, n, t_disp, disp_ts_ms, out, mv = item
             try:
                 preds = np.asarray(out)[:n]  # host transfer = sync point
             except Exception as e:
                 self.registry.record_result(mv, error=True, n=n)
                 mv.release()
-                self._dead_letter([(u, f"predict failed: {e}",
-                                    mv.name, mv.version) for u in uris])
+                self._dead_letter([(m.uri, f"predict failed: {e}",
+                                    mv.name, mv.version) for m in metas])
                 continue
             dt = time.perf_counter() - t_disp
             self.summary.record_batch(n, dt)
             self.summary.record_stage("compute", dt, batch_size=n)
+            self.admission.observe_batch(n, dt)
             mv.summary.record_batch(n, dt)
+            done_ms = now_ms()
             t0 = time.perf_counter()
             results = {}
-            for uri, p in zip(uris, preds):
-                results[uri] = json.dumps(self._format_result(p)).encode()
+            for meta, p in zip(metas, preds):
+                obj = self._format_result(p)
+                obj["timing"] = self._timing_payload(
+                    meta, disp_ts_ms, dt * 1e3, done_ms)
+                self._record_row_timing(obj["timing"])
+                results[meta.uri] = json.dumps(obj).encode()
             self.db.put_results(results)
             now = time.perf_counter()
             self.summary.record_stage("write", now - t0, batch_size=n)
-            for t_in in t_ins:
-                self.summary.record_stage("e2e", now - t_in)
-                mv.summary.record_stage("e2e", now - t_in)
+            for meta in metas:
+                self.summary.record_stage("e2e", now - meta.t_in)
+                mv.summary.record_stage("e2e", now - meta.t_in)
             self._count(results_out=n)
             self.registry.record_result(mv, error=False, n=n)
             mv.release()

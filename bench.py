@@ -895,11 +895,15 @@ def _serving_pipeline_compare(make_serving, enqueue, n_records,
         serving.stop()
         stats = serving.pipeline_stats()
         e2e = stats["stages"].get("e2e", {})
+        device = stats["stages"].get("device", {})
+        transport = stats["stages"].get("transport", {})
         out[mode] = {"rec_per_s": round(len(got) / wall, 1),
                      "served": len(got),
                      "dropped": stats["dropped"],
                      "e2e_p50_ms": e2e.get("p50"),
                      "e2e_p99_ms": e2e.get("p99"),
+                     "device_p50_ms": device.get("p50"),
+                     "transport_p50_ms": transport.get("p50"),
                      "buckets": stats["buckets"]}
     if out["sync"]["rec_per_s"]:
         out["pipe_vs_sync"] = round(
@@ -1115,6 +1119,158 @@ def bench_registry_serving(n_records=240, batch_size=8):
         out["registry_multi_vs_single"] = round(
             out["registry_multi_rec_per_s"] /
             out["registry_single_rec_per_s"], 2)
+    return out
+
+
+def bench_admission(n_records=400, batch_size=8, stub_ms=5.0,
+                    deadline_ms=80.0):
+    """Deadline-aware admission leg (docs/serving-fleet.md#admission):
+    the same saturating burst (records offered far faster than the stub
+    model can serve them) through the pipelined server twice —
+
+    - **open** — no deadlines: every record queues, so the tail grows
+      with the backlog (p99 is the whole burst's drain time);
+    - **admission** — every record carries ``deadline_ms``: unmeetable
+      requests are shed with typed rejections and partial batches
+      re-batch under a linger budget, so served-row latency stays
+      bounded (acceptance gate: p99 <= 3x p50 on served rows).
+
+    Served-row latency is the server-side enqueue->committed span from
+    the per-row decomposition (client poll cadence excluded); every
+    served row must carry transport and device components.
+    """
+    from analytics_zoo_tpu.serving import (ClusterServing,
+                                           ClusterServingHelper,
+                                           InProcessStreamQueue,
+                                           InputQueue, OutputQueue,
+                                           ServingRejected, ServingResult)
+
+    def _run(with_deadline):
+        helper = ClusterServingHelper(config={
+            "model": {"stub_ms_per_batch": stub_ms},
+            "data": {"image_shape": "3, 8, 8"},
+            "params": {"batch_size": batch_size, "top_n": 0,
+                       "decode_workers": 2, "pipelined": True,
+                       "linger_ms": 2.0}})
+        backend = InProcessStreamQueue()
+        serving = ClusterServing(helper=helper, backend=backend)
+        in_q = InputQueue(backend=backend)
+        uris = [f"a-{i}" for i in range(n_records)]
+        serving.start()
+        t0 = time.perf_counter()
+        x = np.full((3, 8, 8), 7, np.float32)
+        for uri in uris:      # saturating: offered rate >> service rate
+            in_q.enqueue(uri, input=x,
+                         deadline_ms=deadline_ms if with_deadline else None)
+        got = OutputQueue(backend=backend).wait_all(
+            uris, timeout=180, max_poll=0.02)
+        wall = time.perf_counter() - t0
+        serving.stop()
+        served_ms, decomposed, shed = [], 0, 0
+        for v in got.values():
+            if isinstance(v, ServingRejected):
+                shed += 1
+                continue
+            t = getattr(v, "timing", None) if isinstance(v, ServingResult) \
+                else None
+            if t and "device_ms" in t and "transport_ms" in t:
+                decomposed += 1
+            if t and t.get("enqueue_ts_ms") and t.get("done_ts_ms"):
+                served_ms.append(t["done_ts_ms"] - t["enqueue_ts_ms"])
+        stats = serving.pipeline_stats()
+        res = {"served": len(got) - shed, "shed": shed,
+               "rows_with_decomposition": decomposed,
+               "rec_per_s": round(len(got) / wall, 1)}
+        if served_ms:
+            arr = np.asarray(served_ms)
+            res["p50_ms"] = round(float(np.percentile(arr, 50)), 2)
+            res["p99_ms"] = round(float(np.percentile(arr, 99)), 2)
+            res["p99_over_p50"] = round(res["p99_ms"] /
+                                        max(res["p50_ms"], 1e-9), 2)
+        res["admission"] = stats.get("admission", {})
+        return res
+
+    out = {}
+    for name, with_deadline in (("open", False), ("admission", True)):
+        r = _run(with_deadline)
+        for k, v in r.items():
+            if k == "admission":
+                continue
+            out[f"admission_{name}_{k}"] = v
+    out["admission_gate_p99_le_3x_p50"] = bool(
+        out.get("admission_admission_p99_over_p50", 99.0) <= 3.0)
+    return out
+
+
+def bench_serving_fleet(n_records=320, stub_ms=16.0):
+    """Serving-fleet leg (docs/serving-fleet.md): the identical record
+    burst through a 1-worker and a 2-worker :class:`ServingFleet` over
+    the file queue backend with the echo stub model (device time
+    dominated by the stub sleep, so worker parallelism is the only
+    lever).  Reports per-fleet records/s, the per-worker serve split,
+    and the 2w/1w ratio — the ISSUE acceptance gate is >= 1.7x.
+    """
+    import io as _io
+    import shutil as _shutil
+    import tempfile as _tempfile
+    import threading
+
+    from analytics_zoo_tpu.serving import (InputQueue, OutputQueue,
+                                           ServingFleet)
+    from analytics_zoo_tpu.serving.queue_backend import FileStreamQueue
+
+    cfg_tmpl = ("model:\n  stub_ms_per_batch: {stub_ms}\n\n"
+                "data:\n  src: file:{stream}\n  image_shape: 3, 4, 4\n\n"
+                "params:\n  batch_size: 8\n  top_n: 0\n"
+                "  workers: {workers}\n  health_interval: 0.25\n"
+                "  health_timeout: 10.0\n")
+    out = {}
+    x = np.full((3, 4, 4), 7, np.float32)
+    for workers in (1, 2):
+        workdir = _tempfile.mkdtemp(prefix=f"zoo_bench_fleet{workers}_")
+        stream = os.path.join(workdir, "stream")
+        cfg = os.path.join(workdir, "config.yaml")
+        with open(cfg, "w") as f:
+            f.write(cfg_tmpl.format(stub_ms=stub_ms, stream=stream,
+                                    workers=workers))
+        fleet = ServingFleet(cfg, workdir, stream=_io.StringIO(),
+                             env={"JAX_PLATFORMS": "cpu"})
+        sup = threading.Thread(target=fleet.supervise, daemon=True)
+        try:
+            fleet.start()
+            sup.start()
+            if not fleet.wait_healthy(timeout=90.0):
+                raise RuntimeError(f"{workers}-worker fleet never healthy")
+            in_q = InputQueue(backend=FileStreamQueue(stream))
+            out_q = OutputQueue(backend=FileStreamQueue(stream))
+            uris = [f"f-{i}" for i in range(n_records)]
+            t0 = time.perf_counter()
+            for uri in uris:
+                in_q.enqueue(uri, input=x)
+            got = out_q.wait_all(uris, timeout=240, max_poll=0.05)
+            wall = time.perf_counter() - t0
+            out[f"fleet_{workers}w_served"] = len(got)
+            out[f"fleet_{workers}w_rec_per_s"] = round(len(got) / wall, 1)
+            # stats dumps are periodic: poll briefly so the reported
+            # per-worker split accounts for the whole burst
+            split = {}
+            poll_until = time.time() + 15.0
+            while time.time() < poll_until:
+                split = {s["worker_id"]: s.get("results_out", 0)
+                         for s in fleet.worker_stats()}
+                if sum(split.values()) >= len(got):
+                    break
+                time.sleep(0.5)
+            out[f"fleet_{workers}w_split"] = \
+                {str(k): v for k, v in sorted(split.items())}
+        finally:
+            fleet.stop()
+            sup.join(timeout=30.0)
+            fleet.shutdown()
+            _shutil.rmtree(workdir, ignore_errors=True)
+    if out.get("fleet_1w_rec_per_s"):
+        out["fleet_2w_vs_1w"] = round(
+            out["fleet_2w_rec_per_s"] / out["fleet_1w_rec_per_s"], 2)
     return out
 
 
@@ -1573,6 +1729,33 @@ def main():
             traceback.print_exc()
             RESULT["registry_error"] = (str(e).splitlines()[0][:500]
                                         if str(e) else repr(e)[:500])
+        emit()
+
+    # Admission-control leg: saturating burst with vs without deadlines
+    # through the pipelined server — typed shedding + linger re-batching
+    # must hold served-row p99 <= 3x p50, and every served row must
+    # carry the transport/device decomposition (docs/serving-fleet.md).
+    if time.time() - T_START < TOTAL_BUDGET_S * 0.9:
+        try:
+            RESULT.update(bench_admission())
+        except Exception as e:  # noqa: BLE001
+            import traceback
+            traceback.print_exc()
+            RESULT["admission_error"] = (str(e).splitlines()[0][:500]
+                                         if str(e) else repr(e)[:500])
+        emit()
+
+    # Serving-fleet leg: 2 supervised worker processes vs 1 over the
+    # file queue backend, stub device time — work partitioning must
+    # scale throughput >= 1.7x (docs/serving-fleet.md).
+    if time.time() - T_START < TOTAL_BUDGET_S * 0.9:
+        try:
+            RESULT.update(bench_serving_fleet())
+        except Exception as e:  # noqa: BLE001
+            import traceback
+            traceback.print_exc()
+            RESULT["fleet_error"] = (str(e).splitlines()[0][:500]
+                                     if str(e) else repr(e)[:500])
         emit()
 
     # Input-pipeline leg — platform-independent (decode is host-side work

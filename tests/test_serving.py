@@ -14,7 +14,7 @@ from analytics_zoo_tpu.pipeline.inference import InferenceModel
 from analytics_zoo_tpu.serving import (ClusterServing, ClusterServingHelper,
                                        FileStreamQueue,
                                        InProcessStreamQueue, InputQueue,
-                                       OutputQueue)
+                                       OutputQueue, ServingTimeout)
 
 
 def _tiny_image_model(c=3, h=16, w=16, classes=5):
@@ -250,6 +250,90 @@ def test_file_queue_orphan_cleanup(tmp_path):
     assert [rec["uri"] for _, rec in items] == ["lost-and-found"]
 
 
+def test_file_queue_two_producers_exactly_once(tmp_path):
+    """Two concurrent producer instances, one consumer: every record is
+    delivered exactly once, the consumer ledger sees both producer tags,
+    and reports zero duplicates / zero sequence gaps."""
+    import threading
+
+    root = str(tmp_path)
+    producers = [FileStreamQueue(root), FileStreamQueue(root)]
+    per_producer = 50
+
+    def feed(q, tag):
+        for i in range(per_producer):
+            q.enqueue({"uri": f"{tag}-{i}"})
+
+    threads = [threading.Thread(target=feed, args=(q, t))
+               for t, q in enumerate(producers)]
+    for t in threads:
+        t.start()
+    consumer = FileStreamQueue(root)
+    got = {}
+    deadline = time.time() + 30.0
+    while len(got) < 2 * per_producer and time.time() < deadline:
+        for rid, rec in consumer.read_batch(16, timeout=0.2):
+            assert rid not in got, f"rid {rid} delivered twice"
+            got[rid] = rec["uri"]
+    for t in threads:
+        t.join()
+    uris = sorted(got.values())
+    assert uris == sorted(f"{t}-{i}" for t in range(2)
+                          for i in range(per_producer))
+    stats = consumer.consumer_stats()
+    assert stats["duplicates"] == 0
+    assert stats["seq_gaps"] == 0
+    assert stats["producers_seen"] == 2
+
+
+def test_file_queue_duplicate_and_gap_detection(tmp_path):
+    """Re-presenting an already-delivered rid (e.g. an operator restoring
+    a .claimed orphan twice) is dropped and counted; a missing sequence
+    number from a producer shows up as a seq gap."""
+    import msgpack
+
+    root = str(tmp_path)
+    producer = FileStreamQueue(root)
+    consumer = FileStreamQueue(root)
+    rids = [producer.enqueue({"uri": f"r-{i}"}) for i in range(4)]
+    # drop seq 2 before the consumer ever sees it: a gap, not a dup
+    os.unlink(os.path.join(producer.stream_dir, rids[2] + ".msgpack"))
+    served = dict(consumer.read_batch(10, timeout=1.0))
+    assert sorted(r["uri"] for r in served.values()) == \
+        ["r-0", "r-1", "r-3"]
+    stats = consumer.consumer_stats()
+    assert stats["seq_gaps"] == 1 and stats["duplicates"] == 0
+    # redeliver rid 0: the consumer's ledger drops it and counts it
+    with open(os.path.join(producer.stream_dir, rids[0] + ".msgpack"),
+              "wb") as f:
+        f.write(msgpack.packb({"uri": "r-0"}, use_bin_type=True))
+    assert consumer.read_batch(10, timeout=0.5) == []
+    assert consumer.consumer_stats()["duplicates"] == 1
+
+
+def test_wait_all_deadline_raises_serving_timeout():
+    """Satellite contract: ``wait_all(deadline_ms=...)`` raises a typed
+    ServingTimeout naming the missing uris and carrying the partial
+    results, instead of silently returning an incomplete dict."""
+    import json as _json
+
+    backend = InProcessStreamQueue()
+    out_q = OutputQueue(backend=backend)
+    backend.put_result("landed", _json.dumps({"value": [1.0]}).encode())
+    with pytest.raises(ServingTimeout) as ei:
+        out_q.wait_all(["landed", "never-a", "never-b"], deadline_ms=80.0,
+                       poll=0.005)
+    err = ei.value
+    assert err.missing == ["never-a", "never-b"]
+    assert set(err.partial) == {"landed"}
+    assert float(np.asarray(err.partial["landed"]).ravel()[0]) == 1.0
+    assert err.deadline_ms == 80.0
+    assert "2 of 3 results missing" in str(err)
+    # the plain-timeout form keeps its lenient partial-return contract
+    got = out_q.wait_all(["still-missing"], timeout=0.05)
+    assert got == {}
+
+
 def test_wait_all_exponential_backoff(monkeypatch):
     """With nothing arriving, the poll interval doubles from ``poll`` up
     to ``max_poll`` instead of spinning at the initial rate."""
@@ -261,4 +345,10 @@ def test_wait_all_exponential_backoff(monkeypatch):
     assert sleeps, "expected at least one poll sleep"
     assert sleeps[0] == pytest.approx(0.02)
     assert max(sleeps) <= 0.08
-    assert sleeps == sorted(sleeps)  # monotone ramp while idle
+    # monotone ramp while idle, until the deadline clamp shrinks the
+    # final sleeps so the budget is never overshot
+    drop = next((i for i, s in enumerate(sleeps)
+                 if i and s < sleeps[i - 1]), len(sleeps))
+    assert sleeps[:drop] == sorted(sleeps[:drop])
+    assert all(sleeps[i] >= sleeps[i + 1]
+               for i in range(drop, len(sleeps) - 1))

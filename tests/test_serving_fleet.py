@@ -1,0 +1,182 @@
+"""Serving fleet + admission control tests: shed/admit policy math,
+adaptive linger budgets, health-file status rows, the supervisor seam,
+and the 2-worker fleet smoke (exactly-once delivery, SIGKILL restart,
+typed rejections) run end-to-end as a subprocess."""
+
+import io
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from analytics_zoo_tpu.serving.admission import (
+    SHED_DEADLINE, AdaptiveBatcher, AdmissionController, now_ms)
+from analytics_zoo_tpu.serving.fleet import (
+    fleet_status, read_health, write_health)
+from analytics_zoo_tpu.utils.profiling import Ewma
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# admission controller policy
+# ---------------------------------------------------------------------------
+
+def test_ewma_estimates():
+    e = Ewma(alpha=0.5)
+    assert e.value is None
+    assert e.update(10.0) == pytest.approx(10.0)   # first sample seeds
+    assert e.update(20.0) == pytest.approx(15.0)
+    assert e.update(20.0) == pytest.approx(17.5)
+    with pytest.raises(ValueError):
+        Ewma(alpha=0.0)
+
+
+def test_admission_admits_everything_without_estimates():
+    """Before the first measured batch the controller has no data: only
+    the safety margin applies, so generous deadlines always admit."""
+    ctl = AdmissionController(safety_ms=2.0)
+    ok, code = ctl.admit(slack_ms=None, backlog=1000)   # no deadline
+    assert ok and code is None
+    ok, code = ctl.admit(slack_ms=50.0, backlog=1000)
+    assert ok and code is None
+    # but a slack inside the safety margin is still shed
+    ok, code = ctl.admit(slack_ms=1.0, backlog=0)
+    assert not ok and code == SHED_DEADLINE
+    assert ctl.stats()["shed_deadline"] == 1
+
+
+def test_admission_sheds_on_backlog_estimate():
+    ctl = AdmissionController(safety_ms=1.0)
+    ctl.observe_batch(10, 0.050)          # 5 ms/record, 50 ms/batch
+    assert ctl.record_ms == pytest.approx(5.0)
+    assert ctl.batch_ms == pytest.approx(50.0)
+    # wait estimate = backlog*record + batch
+    assert ctl.estimate_wait_ms(10) == pytest.approx(100.0)
+    ok, _ = ctl.admit(slack_ms=200.0, backlog=10)
+    assert ok
+    ok, code = ctl.admit(slack_ms=80.0, backlog=10)    # 101 > 80
+    assert not ok and code == SHED_DEADLINE
+    # deeper backlog sheds at slack a shallow backlog admits
+    ok, _ = ctl.admit(slack_ms=80.0, backlog=2)        # 61 <= 80
+    assert ok
+
+
+def test_admission_expired_at_dispatch():
+    ctl = AdmissionController(safety_ms=0.0)
+    ctl.observe_batch(1, 0.010)           # 10 ms/batch
+    t = now_ms()
+    assert not ctl.expired(None, t)                  # no deadline
+    assert not ctl.expired(t + 100.0, t)             # plenty of slack
+    assert ctl.expired(t + 5.0, t)                   # can't finish in 5ms
+    assert ctl.expired(t - 1.0, t)                   # already past
+    assert ctl.stats()["shed_expired"] == 2
+
+
+def test_adaptive_batcher_linger_budget():
+    ctl = AdmissionController(safety_ms=1.0)
+    ctl.observe_batch(4, 0.004)           # 4 ms/batch
+    bat = AdaptiveBatcher([1, 2, 4, 8], ctl, linger_ms=10.0)
+    assert bat.next_boundary(3) == 4
+    t = now_ms()
+    # off-boundary partial batch, no deadline: the full linger budget
+    assert bat.linger_budget_s(3, None) == pytest.approx(0.010)
+    # exactly on a bucket boundary: dispatch now, lingering only grows
+    # the signature
+    assert bat.linger_budget_s(4, None) == 0.0
+    # at the largest bucket: nothing to round up to
+    assert bat.linger_budget_s(8, None) == 0.0
+    # deadline slack caps the budget: 9ms slack - 4ms batch - 1ms safety
+    assert bat.linger_budget_s(3, t + 9.0, at_ms=t) == \
+        pytest.approx(0.004)
+    # exhausted slack: no linger at all
+    assert bat.linger_budget_s(3, t + 2.0, at_ms=t) == 0.0
+    # linger disabled (the default) always dispatches immediately
+    off = AdaptiveBatcher([1, 2, 4, 8], ctl, linger_ms=0.0)
+    assert off.linger_budget_s(3, None) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# health files + status rows
+# ---------------------------------------------------------------------------
+
+def test_health_files_and_fleet_status(tmp_path):
+    wd = str(tmp_path)
+    write_health(wd, 0, {"pid": os.getpid(), "records_served": 42,
+                         "shed": 3, "restarts": 1})
+    write_health(wd, 1, {"pid": 999999999, "records_served": 7, "shed": 0})
+    h = read_health(wd, 0)
+    assert h["worker_id"] == 0 and h["records_served"] == 42
+    rows = fleet_status(wd)
+    assert [r["worker_id"] for r in rows] == [0, 1]
+    me = rows[0]
+    assert me["alive"] is True          # our own pid is signal-0 probeable
+    assert me["records_served"] == 42 and me["shed"] == 3
+    assert me["restarts"] == 1
+    assert me["health_age_s"] < 5.0
+    assert rows[1]["alive"] is False    # pid 999999999 does not exist
+    assert fleet_status(str(tmp_path / "nope")) == []
+
+
+def test_status_cli_renders_worker_rows(tmp_path, capsys):
+    from analytics_zoo_tpu.serving.cli import cmd_status
+
+    wd = str(tmp_path)
+    write_health(wd, 0, {"pid": os.getpid(), "records_served": 5,
+                         "shed": 2, "restarts": 0})
+    rc = cmd_status(wd)
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "worker 0:" in out and "served=5" in out and "shed=2" in out
+
+
+# ---------------------------------------------------------------------------
+# supervisor seam
+# ---------------------------------------------------------------------------
+
+def test_spawn_supervised_tags_and_terminate():
+    from analytics_zoo_tpu.launcher.supervisor import (
+        spawn_supervised, terminate_all)
+
+    buf, lock = io.StringIO(), threading.Lock()
+    sp = spawn_supervised(
+        [sys.executable, "-c", "print('hello'); print('world')"],
+        env=dict(os.environ), tag="t-0", stream=buf, lock=lock)
+    assert sp.proc.wait(timeout=30) == 0
+    sp.pump.join(timeout=10)
+    assert buf.getvalue() == "[t-0] hello\n[t-0] world\n"
+    # terminate_all: SIGTERM ends a sleeping child promptly
+    sp2 = spawn_supervised(
+        [sys.executable, "-c", "import time; time.sleep(60)"],
+        env=dict(os.environ), tag="t-1", stream=buf, lock=lock)
+    t0 = time.time()
+    terminate_all([sp2.proc], grace_s=5.0)
+    assert sp2.proc.poll() is not None
+    assert time.time() - t0 < 10.0
+
+
+# ---------------------------------------------------------------------------
+# fleet end-to-end smoke (subprocess; the ISSUE acceptance path)
+# ---------------------------------------------------------------------------
+
+def test_fleet_smoke_end_to_end():
+    """2-worker fleet over the file queue backend: exactly-once record
+    delivery across workers, a SIGKILLed worker replaced within the
+    health timeout, and unmeetable deadlines shed with typed
+    rejections."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("ZOO_")}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "analytics_zoo_tpu.serving.fleet_smoke",
+         "--records", "64"],
+        capture_output=True, text=True, timeout=480, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "FLEET_SMOKE_OK workers=2 records=64" in proc.stdout
+    assert "restarted=worker-1" in proc.stdout
+    assert "shed_code=shed_" in proc.stdout

@@ -67,6 +67,12 @@ def test_bucket_math():
     assert pick_bucket(9, buckets) == 8
 
 
+def _meta(uri, enqueue_ts_ms=None, dequeue_ts_ms=None, deadline_at_ms=None):
+    from analytics_zoo_tpu.serving.cluster_serving import RecordMeta
+    return RecordMeta(time.perf_counter(), uri, enqueue_ts_ms,
+                      dequeue_ts_ms, deadline_at_ms)
+
+
 def test_bucket_selection_smallest_geq():
     """A partial batch of n executes at the smallest bucket >= n —
     asserted on the executed signature shape."""
@@ -74,15 +80,14 @@ def test_bucket_selection_smallest_geq():
     serving = _serving(InProcessStreamQueue(), stub=stub)
     assert serving.buckets == [1, 2, 4, 8]
     write_q = queue.Queue()
-    now = time.perf_counter()
-    items = [(now, f"u-{i}", np.full(SHAPE, i, np.float32))
+    items = [(_meta(f"u-{i}"), np.full(SHAPE, i, np.float32))
              for i in range(3)]
     serving._dispatch_batch(items, write_q)
     assert stub.calls == [(4,) + SHAPE]      # 3 -> bucket 4, not 8
-    t_ins, uris, n, _t0, out = write_q.get_nowait()
-    assert n == 3 and uris == ["u-0", "u-1", "u-2"]
+    metas, n, _t0, _disp, out = write_q.get_nowait()
+    assert n == 3 and [m.uri for m in metas] == ["u-0", "u-1", "u-2"]
     # writer slices padding away and keeps uri->value pairing
-    write_q.put((t_ins, uris, n, _t0, out))
+    write_q.put((metas, n, _t0, _disp, out))
     write_q.put(serving_sentinel())
     serving._writer_loop(write_q)
     for i in range(3):
@@ -261,6 +266,110 @@ def test_latency_stats_reservoir_bound():
     # reservoir keeps only the newest 8 (993..1000 ms)
     assert st.percentile(0) * 1e3 == pytest.approx(993.0)
     assert st.percentile(100) * 1e3 == pytest.approx(1000.0)
+
+
+def test_latency_stats_percentile_edges():
+    """Degenerate sample sizes must not produce nonsense: n=1 returns
+    the sample for every percentile, n=2 interpolates linearly, and
+    all-equal samples collapse to that value."""
+    one = LatencyStats()
+    one.record(0.007)
+    for p in (0, 1, 50, 95, 99, 100):
+        assert one.percentile(p) == pytest.approx(0.007), p
+    assert one.mean() == pytest.approx(0.007)
+    two = LatencyStats()
+    two.record(0.010)
+    two.record(0.020)
+    assert two.percentile(0) == pytest.approx(0.010)
+    assert two.percentile(50) == pytest.approx(0.015)
+    assert two.percentile(100) == pytest.approx(0.020)
+    # p99 interpolates between the two points, never beyond them
+    assert 0.010 <= two.percentile(99) <= 0.020
+    flat = LatencyStats()
+    for _ in range(17):
+        flat.record(0.004)
+    for p in (1, 50, 99):
+        assert flat.percentile(p) == pytest.approx(0.004), p
+
+
+def test_timing_decomposition_per_row():
+    """Every result row carries a timing payload splitting device_ms
+    from transport: server-side stamps flow client -> backend -> writer,
+    and the client completes rtt_ms / transport_ms from its own clock."""
+    backend = InProcessStreamQueue()
+    serving = _serving(backend, stub=SlowStub(sec_per_row=0.0005)).start()
+    try:
+        in_q = InputQueue(backend=backend)
+        uris = [f"u-{i}" for i in range(12)]
+        for i, uri in enumerate(uris):
+            in_q.enqueue(uri, input=np.full(SHAPE, i, np.float32))
+        got = OutputQueue(backend=backend).wait_all(uris, timeout=30)
+    finally:
+        serving.stop()
+    assert len(got) == 12
+    for i, uri in enumerate(uris):
+        res = got[uri]
+        assert float(res) == pytest.approx(float(i))
+        t = res.timing
+        assert t is not None, uri
+        for field in ("device_ms", "transport_in_ms", "queue_ms",
+                      "server_ms", "rtt_ms", "transport_ms"):
+            assert field in t, field
+            assert t[field] >= 0.0, (field, t[field])
+        # decomposition is consistent: rtt covers the server span
+        assert t["rtt_ms"] + 1e-6 >= t["server_ms"]
+        assert t["transport_ms"] == pytest.approx(
+            max(t["rtt_ms"] - t["server_ms"], 0.0), abs=1e-3)
+    # the new stages ride the standard percentile machinery
+    stats = serving.pipeline_stats()
+    for stage in ("device", "transport", "queue_wait"):
+        assert stats["stages"][stage]["count"] == 12, stage
+
+
+def test_sync_path_reports_timing_too():
+    backend = InProcessStreamQueue()
+    serving = _serving(backend, stub=SlowStub(), batch_size=4,
+                       pipelined=False)
+    in_q = InputQueue(backend=backend)
+    in_q.enqueue("s-0", input=np.full(SHAPE, 3, np.float32))
+    serving._process_batch(backend.read_batch(4, timeout=1.0))
+    res = OutputQueue(backend=backend).query("s-0")
+    assert float(res) == pytest.approx(3.0)
+    assert res.timing is not None
+    assert res.timing["device_ms"] >= 0.0
+    assert "transport_in_ms" in res.timing
+
+
+def test_admission_sheds_unmeetable_deadline():
+    """A record whose deadline cannot be met given the measured service
+    time is shed at intake with a typed rejection the client decodes as
+    ServingRejected; deadline-free records are never shed."""
+    from analytics_zoo_tpu.serving.client import ServingRejected
+
+    backend = InProcessStreamQueue()
+    serving = _serving(backend, stub=SlowStub(sec_per_row=0.002),
+                       batch_size=4)
+    # prime the service-time estimate: ~40ms per batch, ~10ms per record
+    serving.admission.observe_batch(4, 0.040)
+    serving.start()
+    try:
+        in_q = InputQueue(backend=backend)
+        in_q.enqueue("tight", deadline_ms=1.0,
+                     input=np.full(SHAPE, 1, np.float32))
+        in_q.enqueue("loose", deadline_ms=60_000.0,
+                     input=np.full(SHAPE, 2, np.float32))
+        in_q.enqueue("free", input=np.full(SHAPE, 3, np.float32))
+        got = OutputQueue(backend=backend).wait_all(
+            ["tight", "loose", "free"], timeout=30)
+    finally:
+        serving.stop()
+    assert isinstance(got["tight"], ServingRejected)
+    assert got["tight"].code == "shed_deadline"
+    assert float(got["loose"]) == pytest.approx(2.0)
+    assert float(got["free"]) == pytest.approx(3.0)
+    stats = serving.pipeline_stats()
+    assert stats["shed"] == 1
+    assert stats["admission"]["shed_deadline"] == 1
 
 
 def test_summary_stage_tracking_without_writer():
