@@ -1,0 +1,32 @@
+"""Version-tolerant wrappers over jax APIs that moved between releases.
+
+The library targets current jax, where ``shard_map`` is a top-level
+export with a ``check_vma`` knob. Older jaxlibs (<= 0.4.x) only ship
+``jax.experimental.shard_map.shard_map`` with the same semantics under
+the ``check_rep`` name. Every in-library shard_map site goes through
+:func:`shard_map` here so one interpreter works against both.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:                                     # jax >= 0.5: top-level export
+    _shard_map_impl = jax.shard_map
+    _CHECK_KW = "check_vma"
+except AttributeError:                   # jax 0.4.x: experimental home
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f=None, *, mesh, in_specs, out_specs, check_vma=None):
+    """``jax.shard_map`` with the replication-check flag translated to
+    whatever this jax version calls it (``check_vma``/``check_rep``).
+    Supports the same ``shard_map(f, ...)`` / decorator-style
+    ``shard_map(mesh=...)(f)`` split as the real API."""
+    kw = {} if check_vma is None else {_CHECK_KW: check_vma}
+    if f is None:
+        return lambda fn: _shard_map_impl(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+    return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, **kw)

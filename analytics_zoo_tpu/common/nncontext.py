@@ -66,8 +66,19 @@ class ZooConfig:
     prefetch_depth: int = 2
     # ordered transform-pool threads running the Preprocessing chain for
     # several batches concurrently (MTSampleToMiniBatch parity). 0 = serial
-    # in the prefetch thread.
-    transform_workers: int = 0
+    # in the prefetch thread; -1 (default) auto-sizes the pool from the
+    # host core count so decode/transform keeps pace with the model's
+    # consumption rate (feature.host_pipeline.resolve_transform_workers)
+    # instead of bottlenecking the step on one prefetch thread.
+    transform_workers: int = -1
+    # flash-attention backward remat policy (ops/attention.py
+    # _flash_remat_policy): "" = default ("save-lse-recompute-probs" —
+    # keep only q/k/v/lse/o and recompute probabilities blockwise in the
+    # backward kernel, O(L) residual memory), "full-residual" = run the
+    # reference backward via XLA over saved activations (O(L^2) probs
+    # residual — more HBM, no recompute flops). Env hatch:
+    # ZOO_TPU_FLASH_REMAT.
+    flash_remat: str = ""
     # dispatch chunks kept already device_put onto the mesh data sharding
     # ahead of the compiled step, overlapping H2D with device compute
     device_ahead: int = 2

@@ -22,6 +22,7 @@ can emit input-wait and input-bound-fraction telemetry per logging window.
 from __future__ import annotations
 
 import logging
+import os
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Iterator, List, Optional, Tuple
@@ -247,18 +248,34 @@ class DeviceStagingIterator:
             host_close()
 
 
+def resolve_transform_workers(transform_workers: int) -> int:
+    """Resolve the transform-pool size: >= 0 is taken literally (0 =
+    serial in the prefetch thread); negative means auto — size the
+    decode/transform pool from the host core count so the host half can
+    keep pace with the model's consumption rate. The auto pool is
+    clamped to [2, 8]: below 2 a single worker cannot hide per-batch
+    transform latency behind the device step, above 8 the ordered
+    hand-off queue is the bottleneck, not the pool."""
+    if transform_workers >= 0:
+        return int(transform_workers)
+    return max(2, min(8, os.cpu_count() or 2))
+
+
 def build_host_pipeline(fs: FeatureSet, batch_size: int, *,
                         shuffle: bool = False, drop_remainder: bool = True,
                         pad_remainder: bool = False, seed: int = 0,
-                        transform_workers: int = 0,
+                        transform_workers: int = -1,
                         prefetch_depth: int = 2) -> PrefetchIterator:
     """Host half of the staged pipeline: (parallel) transform + prefetch.
 
     Returns a closeable iterator of host MiniBatches; wrap it in a
     ``DeviceStagingIterator`` for the device half. ``transform_workers``
     only applies when ``fs`` carries a Preprocessing chain
-    (TransformedFeatureSet); raw array slicing is already cheap.
+    (TransformedFeatureSet); raw array slicing is already cheap. The
+    default (-1) auto-sizes the pool from the host core count
+    (:func:`resolve_transform_workers`).
     """
+    transform_workers = resolve_transform_workers(transform_workers)
     kw = dict(shuffle=shuffle, drop_remainder=drop_remainder,
               pad_remainder=pad_remainder, seed=seed)
     if transform_workers > 0 and isinstance(fs, TransformedFeatureSet):

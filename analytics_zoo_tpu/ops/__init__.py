@@ -1,5 +1,5 @@
-from .attention import (attention_reference, flash_attention,
-                        flash_attention_blhd)
+from .attention import (attention_blockwise, attention_reference,
+                        flash_attention, flash_attention_blhd)
 
-__all__ = ["attention_reference", "flash_attention",
+__all__ = ["attention_blockwise", "attention_reference", "flash_attention",
            "flash_attention_blhd"]

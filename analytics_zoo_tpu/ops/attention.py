@@ -12,8 +12,11 @@ f32 accumulation. ``ring`` sequence parallelism layers on top of this in
 The kernel takes an optional *key bias* — an additive (B, Lk) bias broadcast
 over heads and query positions, which is exactly the shape of the BERT/
 padding-mask bias ``(1-mask)*-10000`` (self_attention.py) — so the model-zoo
-transformer path runs through the kernel, not the fallback.  Full (B,H,Lq,Lk)
-biases fall back to the fused-XLA reference path.
+transformer path runs through the kernel, not the fallback.  Shapes the
+kernel declines (full (B,H,Lq,Lk) biases, odd dims, short/non-TPU runs)
+take :func:`attention_blockwise`, a ``lax.scan`` online-softmax fallback
+that is O(L) memory in both directions; :func:`attention_reference`
+remains as the test oracle.
 """
 
 from __future__ import annotations
@@ -38,6 +41,45 @@ def _interpret_mode() -> bool:
     return os.environ.get("ZOO_TPU_PALLAS_INTERPRET", "0") == "1"
 
 
+_REMAT_POLICIES = {
+    "": "lse", "lse": "lse", "save-lse-recompute-probs": "lse",
+    "kernel": "lse",
+    "full": "full", "full-residual": "full", "xla": "full",
+}
+
+
+def _flash_remat_policy() -> str:
+    """Backward remat policy for the flash custom_vjp rules.
+
+    ``lse`` (alias ``save-lse-recompute-probs``, the default): backward
+    runs the dedicated blockwise kernels, rebuilding score blocks from
+    (q, k, bias) and normalizing with the saved per-row lse — O(L)
+    memory both directions.  ``full`` (alias ``full-residual``):
+    backward differentiates through the reference math instead,
+    materializing the full O(L^2) probs residual — can win at short L
+    where the two recompute passes dominate, and doubles as the escape
+    hatch when a backward kernel miscompiles.  Resolution order:
+    ``ZOO_TPU_FLASH_REMAT`` env, then ``ZooConfig.flash_remat`` when a
+    context is live (the engine plumbs it through ``from_env``), then
+    the legacy ``ZOO_TPU_FLASH_BWD=xla`` hatch (the r3 spelling of
+    ``full``)."""
+    raw = os.environ.get("ZOO_TPU_FLASH_REMAT")
+    if raw is None:
+        from ..common import nncontext as _nn
+        ctx = _nn._global_context
+        cfg = getattr(ctx, "config", None) if ctx is not None else None
+        raw = getattr(cfg, "flash_remat", "") or None
+    if raw is None:
+        raw = os.environ.get("ZOO_TPU_FLASH_BWD", "kernel")
+    key = str(raw).strip().lower()
+    if key not in _REMAT_POLICIES:
+        raise ValueError(
+            "unknown flash remat policy %r (expected 'lse'/"
+            "'save-lse-recompute-probs' or 'full'/'full-residual')"
+            % (raw,))
+    return _REMAT_POLICIES[key]
+
+
 # ---------------------------------------------------------------------------
 # Reference implementation (also the CPU / short-sequence path)
 # ---------------------------------------------------------------------------
@@ -56,6 +98,253 @@ def attention_reference(q, k, v, bias=None, causal=False, sm_scale=None):
         logits = jnp.where(mask, logits, DEFAULT_MASK_VALUE)
     probs = jax.nn.softmax(logits, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+
+
+# ---------------------------------------------------------------------------
+# Blockwise XLA fallback: lax.scan online softmax, O(L) memory fwd AND bwd.
+# This is the FlashAttention scheme expressed in plain XLA — it takes over
+# every shape the Pallas kernel declines (odd head dims, tiny or non-128
+# sequence lengths, full (B,H,Lq,Lk) biases, non-TPU backends), so the
+# (B, H, L, L) probs tensor the old ``attention_reference`` fallback
+# materialized never exists on any route. The reference stays above as the
+# test oracle only.
+# ---------------------------------------------------------------------------
+
+def _fallback_block(n, env):
+    """Block length for the scan fallback: prefers 256 (then 128), the
+    largest candidate strictly smaller than ``n`` that divides it —
+    strict, so any L >= 256 splits into at least two blocks and no
+    (L, L) score tile is ever built. 256 won the block sweep on both
+    ends: tiles stay cache-resident on host CPU and fill a TPU
+    (8, 128)-lane register tile, while 512+ blocks regress wall time
+    ~15-40% at L = 2048. Lengths with no such divisor (tiny or odd L,
+    where L^2 is noise) run as a single block. Env override for tuning
+    sweeps must divide L (the scan has no partial-block masking)."""
+    try:
+        v = int(os.environ.get(env, "0"))
+    except ValueError:
+        v = 0
+    if v > 0 and n % min(v, n) == 0:
+        return min(v, n)
+    for cand in (256, 128):
+        if cand < n and n % cand == 0:
+            return cand
+    return n
+
+
+def _bw_bias_block(bias, start, size, axis, full):
+    """Slice a block from the (broadcastable) bias along ``axis`` when the
+    bias actually extends there (``full``); broadcast dims pass through."""
+    bb = bias.astype(jnp.float32)
+    if full:
+        bb = jax.lax.dynamic_slice_in_dim(bb, start, size, axis=axis)
+    return bb
+
+
+def _blockwise_fwd_impl(q, k, v, bias, causal, sm_scale, block_k):
+    """Returns (o, m, l) with o: (B, H, Lq, d) and the per-row softmax
+    max/denominator (B, H, Lq, 1) f32. m and l are kept separate (not
+    folded into lse = m + log l): on a fully-masked causal row m is the
+    f32-huge DEFAULT_MASK_VALUE and log(l) would be absorbed entirely,
+    making backward's reconstructed probs 1 instead of 1/Lk."""
+    b, h, lq, d = q.shape
+    lk = k.shape[2]
+    nb = lk // block_k
+    offset = lk - lq  # bottom-right-aligned causal, reference semantics
+    slice_k = bias is not None and bias.shape[3] == lk
+
+    def step(carry, j):
+        acc, m, l = carry
+        k_blk = jax.lax.dynamic_slice_in_dim(k, j * block_k, block_k,
+                                             axis=2)
+        v_blk = jax.lax.dynamic_slice_in_dim(v, j * block_k, block_k,
+                                             axis=2)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk,
+                       preferred_element_type=jnp.float32) * sm_scale
+        if bias is not None:
+            s = s + _bw_bias_block(bias, j * block_k, block_k, 3, slice_k)
+        if causal:
+            q_pos = offset + jax.lax.broadcasted_iota(
+                jnp.int32, (lq, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (lq, block_k), 1)
+            s = jnp.where((q_pos >= k_pos)[None, None], s,
+                          DEFAULT_MASK_VALUE)
+        m_cur = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        correction = jnp.exp(m - m_cur)
+        p = jnp.exp(s - m_cur)
+        l_cur = correction * l + p.sum(axis=-1, keepdims=True)
+        acc = acc * correction + jnp.einsum(
+            "bhqk,bhkd->bhqd", p.astype(v.dtype), v_blk,
+            preferred_element_type=jnp.float32)
+        return (acc, m_cur, l_cur), None
+
+    init = (jnp.zeros((b, h, lq, d), jnp.float32),
+            jnp.full((b, h, lq, 1), -jnp.inf, jnp.float32),
+            jnp.zeros((b, h, lq, 1), jnp.float32))
+    (acc, m, l), _ = jax.lax.scan(step, init, jnp.arange(nb))
+    l_safe = jnp.maximum(l, 1e-30)
+    o = (acc / l_safe).astype(q.dtype)
+    return o, m, l_safe
+
+
+def _blockwise_bwd_impl(q, k, v, bias, o, m, l, do, causal, sm_scale,
+                        block_q, block_k):
+    """Single-pass blockwise dq/dk/dv/dbias: ONE scan over key blocks
+    rebuilds each (B, H, Lq, block_k) score tile exactly once — with the
+    saved row max/denominator (p = exp(s - m) / l, the lse split, see
+    _blockwise_fwd_impl) — and emits every cotangent that needs it: dq
+    accumulates in the carry, dk/dv (and the bias cotangent's key rows)
+    come out as stacked per-block scan outputs. One exp and five dots
+    per tile, versus the textbook two-pass layout's two exps and seven
+    dots (a separate dq sweep plus a dkv sweep each rebuilding scores).
+    ``block_q`` is unused here (kept in the signature for the vjp's
+    nondiff slots — forward tiling may still want asymmetric blocks)."""
+    f32 = jnp.float32
+    b, h, lq, d = q.shape
+    lk = k.shape[2]
+    nb = lk // block_k
+    offset = lk - lq
+    # Fold the softmax denominator into the output cotangent once, out
+    # here: with dof = do / l, every per-tile term that needed normalized
+    # probs p = exp(s - m) / l works off the unnormalized exp(s - m)
+    # instead (dv = p^T do = pu^T dof; ds = p (dp - delta) =
+    # pu (dof v^T - delta')), replacing nb full-tile divisions with one
+    # (B, H, Lq, d) one.
+    dof = do.astype(f32) / l
+    delta = (dof * o.astype(f32)).sum(axis=-1, keepdims=True)
+    slice_k = bias is not None and bias.shape[3] == lk
+    # the bias cotangent reduces ds over every broadcast dim; its key dim
+    # either stacks per block (full-Lk bias) or folds into a carry sum
+    # (key-broadcast bias). A shape-() dummy stands in for whichever slot
+    # is unused so the scan carry/ys structure stays fixed.
+    dummy = jnp.zeros((), f32)
+    if bias is not None and not slice_k:
+        db0 = jnp.zeros(bias.shape[:3] + (1,), f32)
+    else:
+        db0 = dummy
+
+    def step(carry, j):
+        dq_acc, db_sum = carry
+        k_blk = jax.lax.dynamic_slice_in_dim(k, j * block_k, block_k,
+                                             axis=2)
+        v_blk = jax.lax.dynamic_slice_in_dim(v, j * block_k, block_k,
+                                             axis=2)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk,
+                       preferred_element_type=f32) * sm_scale
+        mask = None
+        if bias is not None:
+            s = s + _bw_bias_block(bias, j * block_k, block_k, 3, slice_k)
+        if causal:
+            q_pos = offset + jax.lax.broadcasted_iota(
+                jnp.int32, (lq, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (lq, block_k), 1)
+            mask = (q_pos >= k_pos)[None, None]
+            s = jnp.where(mask, s, DEFAULT_MASK_VALUE)
+        pu = jnp.exp(s - m)
+        dv_j = jnp.einsum("bhqk,bhqd->bhkd", pu, dof,
+                          preferred_element_type=f32)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", dof, v_blk,
+                        preferred_element_type=f32)
+        ds = pu * (dp - delta)
+        if mask is not None:
+            # match reference AD: where() passes no gradient to masked
+            # logits, and fully-masked rows (lq > lk causal) have
+            # nonzero uniform p there (which must still reach dv above)
+            ds = jnp.where(mask, ds, 0.0)
+        # sm_scale's chain factor on dq/dk is applied once after the scan
+        dq_acc = dq_acc + jnp.einsum(
+            "bhqk,bhkd->bhqd", ds, k_blk, preferred_element_type=f32)
+        dk_j = jnp.einsum("bhqk,bhqd->bhkd", ds, q,
+                          preferred_element_type=f32)
+        db_j = dummy
+        if bias is not None:
+            red = ds
+            for ax in (0, 1, 2):
+                if bias.shape[ax] == 1:
+                    red = red.sum(axis=ax, keepdims=True)
+            if slice_k:
+                db_j = red
+            else:
+                db_sum = db_sum + red.sum(axis=3, keepdims=True)
+        return (dq_acc, db_sum), (dk_j, dv_j, db_j)
+
+    (dq, db_sum), (dk_blocks, dv_blocks, db_blocks) = jax.lax.scan(
+        step, (jnp.zeros((b, h, lq, d), f32), db0), jnp.arange(nb))
+
+    def unblock(blocks):
+        # (nb, B, H, block_k, d) -> (B, H, Lk, d); blocks are contiguous
+        return jnp.moveaxis(blocks, 0, 2).reshape(
+            blocks.shape[1], blocks.shape[2], lk, blocks.shape[4])
+
+    dq = dq * sm_scale
+    dk = unblock(dk_blocks) * sm_scale
+    dv = unblock(dv_blocks)
+    dbias = None
+    if bias is not None:
+        if slice_k:
+            # (nb, rb, rh, rq, block_k) -> (rb, rh, rq, Lk)
+            db = jnp.moveaxis(db_blocks, 0, 3).reshape(
+                db_blocks.shape[1], db_blocks.shape[2],
+                db_blocks.shape[3], lk)
+        else:
+            db = db_sum
+        dbias = db.astype(bias.dtype)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            dbias)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _attention_blockwise(q, k, v, bias, causal, sm_scale, block_q,
+                         block_k):
+    return _blockwise_fwd_impl(q, k, v, bias, causal, sm_scale, block_k)[0]
+
+
+def _blockwise_fwd_rule(q, k, v, bias, causal, sm_scale, block_q, block_k):
+    # custom_vjp (not AD through the scan): jax would otherwise save every
+    # per-step score block as a residual — O(L^2) again, just chunked.
+    # Residuals are the flash set: inputs + (o, m, l).
+    o, m, l = _blockwise_fwd_impl(q, k, v, bias, causal, sm_scale,
+                                  block_k)
+    return o, (q, k, v, bias, o, m, l)
+
+
+def _blockwise_bwd_rule(causal, sm_scale, block_q, block_k, res, do):
+    q, k, v, bias, o, m, l = res
+    with jax.named_scope("attn_hot"):
+        return _blockwise_bwd_impl(q, k, v, bias, o, m, l, do, causal,
+                                   sm_scale, block_q, block_k)
+
+
+_attention_blockwise.defvjp(_blockwise_fwd_rule, _blockwise_bwd_rule)
+
+
+def attention_blockwise(q, k, v, bias=None, causal=False, sm_scale=None,
+                        block_q=None, block_k=None):
+    """O(L)-memory XLA attention: q,k,v (B, H, L, D) -> (B, H, L, D).
+
+    ``lax.scan`` over key blocks with online softmax in forward and a
+    two-pass lse-recompute backward (custom_vjp), matching
+    ``attention_reference`` numerically while never materializing a
+    (B, H, Lq, Lk) tensor in either direction for L >= 256. This is the
+    default fallback whenever the Pallas kernel is ineligible; block
+    sizes follow :func:`_fallback_block` (env
+    ``ZOO_TPU_ATTN_FALLBACK_BLOCK_Q/K`` for sweeps)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    lq, lk = q.shape[2], k.shape[2]
+    if bias is not None and bias.ndim != 4:
+        bias = bias.reshape((1,) * (4 - bias.ndim) + tuple(bias.shape))
+    bq = _fallback_block(lq, "ZOO_TPU_ATTN_FALLBACK_BLOCK_Q")
+    bk = _fallback_block(lk, "ZOO_TPU_ATTN_FALLBACK_BLOCK_K")
+    if block_q and block_q < lq and lq % block_q == 0:
+        bq = block_q
+    if block_k and block_k < lk and lk % block_k == 0:
+        bk = block_k
+    with jax.named_scope("attn_hot"):
+        return _attention_blockwise(q, k, v, bias, causal, sm_scale, bq,
+                                    bk)
 
 
 # ---------------------------------------------------------------------------
@@ -192,7 +481,9 @@ def _flash_forward(q, k, v, kbias, num_heads, causal, sm_scale,
 
     kbias3 = kbias.reshape(kbias.shape[0], 1, lk)
 
-    return pl.pallas_call(
+    # named_scope: the hlo_accountant attributes ops to the attention hot
+    # path by this scope in HLO metadata (bench zero-relayout gate)
+    call = pl.pallas_call(
         kernel,
         grid=(bh, num_q, num_k),
         in_specs=[
@@ -220,7 +511,9 @@ def _flash_forward(q, k, v, kbias, num_heads, causal, sm_scale,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret_mode(),
-    )(q, k, v, kbias3)
+    )
+    with jax.named_scope("attn_hot"):
+        return call(q, k, v, kbias3)
 
 
 # ---------------------------------------------------------------------------
@@ -351,15 +644,16 @@ def _flash_backward(q, k, v, kbias, o, lse, do, num_heads, causal, sm_scale,
     # delta_i = rowsum(dO_i * O_i) — the softmax-jacobian diagonal term.
     # One fused elementwise+reduce in XLA; (BH, Lq, 1) so backward kernel
     # blocks read it as (block_q, 1) rows.
-    delta = (do.astype(jnp.float32) * o.astype(jnp.float32)).sum(
-        axis=-1, keepdims=True)
+    with jax.named_scope("attn_hot"):
+        delta = (do.astype(jnp.float32) * o.astype(jnp.float32)).sum(
+            axis=-1, keepdims=True)
     kbias3 = kbias.reshape(kbias.shape[0], 1, lk)
 
     qkv_spec_q = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
     qkv_spec_k = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
     row_spec_q = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
 
-    dq = pl.pallas_call(
+    dq_call = pl.pallas_call(
         functools.partial(
             _flash_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
             block_q=block_q, block_k=block_k, num_k_blocks=num_k),
@@ -373,14 +667,16 @@ def _flash_backward(q, k, v, kbias, o, lse, do, num_heads, causal, sm_scale,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret_mode(),
-    )(q, k, v, kbias3, do, lse, delta)
+    )
+    with jax.named_scope("attn_hot"):
+        dq = dq_call(q, k, v, kbias3, do, lse, delta)
 
     # dk/dv/dbias: grid transposed — k blocks parallel, q blocks innermost
     # (accumulation axis).
     kv_spec_k = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
     kv_spec_q = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
     row_spec = pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0))
-    dk, dv, db = pl.pallas_call(
+    dkv_call = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
             block_q=block_q, block_k=block_k, num_q_blocks=num_q),
@@ -407,12 +703,13 @@ def _flash_backward(q, k, v, kbias, o, lse, do, num_heads, causal, sm_scale,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret_mode(),
-    )(q, k, v, kbias3, do, lse, delta)
-
-    # bias grad: the (B, Lk) key bias broadcasts over heads and query rows,
-    # so its cotangent sums ds over both — rows inside the kernel, heads
-    # here.
-    dkb = db.reshape(-1, num_heads, lk).sum(axis=1).astype(kbias.dtype)
+    )
+    with jax.named_scope("attn_hot"):
+        dk, dv, db = dkv_call(q, k, v, kbias3, do, lse, delta)
+        # bias grad: the (B, Lk) key bias broadcasts over heads and query
+        # rows, so its cotangent sums ds over both — rows inside the
+        # kernel, heads here.
+        dkb = db.reshape(-1, num_heads, lk).sum(axis=1).astype(kbias.dtype)
     return dq, dk, dv, dkb
 
 
@@ -433,11 +730,13 @@ def _flash_fwd_rule(q, k, v, kbias, num_heads, causal, sm_scale,
 def _flash_bwd_rule(num_heads, causal, sm_scale, block_q, block_k, res,
                     do):
     """Backward via the dedicated Pallas kernels (O(L) memory, two-pass
-    recompute). ``ZOO_TPU_FLASH_BWD=xla`` restores the round-3 behavior of
-    recomputing through the reference math (materializes O(L^2) probs;
-    kept as an escape hatch)."""
+    lse recompute) under the default remat policy; the ``full`` /
+    ``full-residual`` policy (or the legacy ``ZOO_TPU_FLASH_BWD=xla``
+    spelling) differentiates through the reference math instead,
+    materializing the O(L^2) probs residual — see
+    :func:`_flash_remat_policy`."""
     q, k, v, kbias, o, lse = res
-    if os.environ.get("ZOO_TPU_FLASH_BWD", "kernel") == "xla":
+    if _flash_remat_policy() == "full":
         def ref(q, k, v, kb):
             qf = q[:, None]
             kf = k[:, None]
@@ -514,7 +813,7 @@ def _flash_forward_blhd(q, k, v, kbias, causal, sm_scale,
     kbias3 = kbias.reshape(kbias.shape[0], 1, lk)
     q_spec = _blhd_spec(block_q, d, h, "qi")
 
-    return pl.pallas_call(
+    call = pl.pallas_call(
         kernel,
         grid=(bh, num_q, num_k),
         in_specs=[
@@ -539,7 +838,9 @@ def _flash_forward_blhd(q, k, v, kbias, causal, sm_scale,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret_mode(),
-    )(q, k, v, kbias3)
+    )
+    with jax.named_scope("attn_hot"):
+        return call(q, k, v, kbias3)
 
 
 def _flash_backward_blhd(q, k, v, kbias, o, lse, do, causal, sm_scale,
@@ -555,36 +856,48 @@ def _flash_backward_blhd(q, k, v, kbias, o, lse, do, causal, sm_scale,
     num_q = pl.cdiv(lq, block_q)
     num_k = pl.cdiv(lk, block_k)
 
-    # delta_i = rowsum(dO_i * O_i); tiny (B*H*L f32), so the transpose to
-    # the kernels' (BH, Lq, 1) row layout is noise next to the relayout
-    # copies this path exists to kill.
-    delta = (do.astype(jnp.float32) * o.astype(jnp.float32)).sum(axis=-1)
-    delta = delta.transpose(0, 2, 1).reshape(bh, lq, 1)
+    # delta_i = rowsum(dO_i * O_i), kept in the native (B, Lq, H, 1)
+    # layout: the kernels read it through a squeezed-head BlockSpec (the
+    # last-two block dims stay (block_q, 1), same legality argument as the
+    # lse spec), so the backward pass stays transpose-free end to end —
+    # the r5 version transposed delta to (BH, Lq, 1) rows, the one
+    # copy-transpose op the accountant still attributed to the hot path.
+    with jax.named_scope("attn_hot"):
+        delta = (do.astype(jnp.float32) * o.astype(jnp.float32)).sum(
+            axis=-1, keepdims=True)
     kbias3 = kbias.reshape(kbias.shape[0], 1, lk)
 
     q_spec = _blhd_spec(block_q, d, h, "qi")
     k_spec = _blhd_spec(block_k, d, h, "ki")
     row_spec_q = pl.BlockSpec((1, block_q, 1), lambda g, i, j: (g, i, 0))
+    delta_spec_i = pl.BlockSpec(
+        (1, block_q, None, 1), lambda g, i, j, hh=h: (g // hh, i, g % hh,
+                                                      0))
 
-    dq = pl.pallas_call(
+    dq_call = pl.pallas_call(
         functools.partial(
             _flash_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
             block_q=block_q, block_k=block_k, num_k_blocks=num_k),
         grid=(bh, num_q, num_k),
         in_specs=[q_spec, k_spec, k_spec, _bias_specs_3d(h, block_k),
-                  q_spec, row_spec_q, row_spec_q],
+                  q_spec, row_spec_q, delta_spec_i],
         out_specs=q_spec,
         out_shape=out_struct((b, lq, h, d), q.dtype, q, k, v, do),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret_mode(),
-    )(q, k, v, kbias3, do, lse, delta)
+    )
+    with jax.named_scope("attn_hot"):
+        dq = dq_call(q, k, v, kbias3, do, lse, delta)
 
     kv_spec_k = _blhd_spec(block_k, d, h, "kj")
     kv_spec_q = _blhd_spec(block_q, d, h, "qj")
     row_spec = pl.BlockSpec((1, block_q, 1), lambda g, j, i: (g, i, 0))
-    dk, dv, db = pl.pallas_call(
+    delta_spec_j = pl.BlockSpec(
+        (1, block_q, None, 1), lambda g, j, i, hh=h: (g // hh, i, g % hh,
+                                                      0))
+    dkv_call = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
             block_q=block_q, block_k=block_k, num_q_blocks=num_q),
@@ -592,7 +905,7 @@ def _flash_backward_blhd(q, k, v, kbias, o, lse, do, causal, sm_scale,
         in_specs=[kv_spec_q, kv_spec_k, kv_spec_k,
                   pl.BlockSpec((1, 1, block_k),
                                lambda g, j, i, hh=h: (g // hh, 0, j)),
-                  kv_spec_q, row_spec, row_spec],
+                  kv_spec_q, row_spec, delta_spec_j],
         out_specs=[
             kv_spec_k,
             kv_spec_k,
@@ -611,9 +924,10 @@ def _flash_backward_blhd(q, k, v, kbias, o, lse, do, causal, sm_scale,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret_mode(),
-    )(q, k, v, kbias3, do, lse, delta)
-
-    dkb = db.reshape(b, h, lk).sum(axis=1).astype(kbias.dtype)
+    )
+    with jax.named_scope("attn_hot"):
+        dk, dv, db = dkv_call(q, k, v, kbias3, do, lse, delta)
+        dkb = db.reshape(b, h, lk).sum(axis=1).astype(kbias.dtype)
     return dq, dk, dv, dkb
 
 
@@ -632,12 +946,13 @@ def _flash_fwd_rule_blhd(q, k, v, kbias, causal, sm_scale,
 
 
 def _flash_bwd_rule_blhd(causal, sm_scale, block_q, block_k, res, do):
-    """Backward via the blhd Pallas kernels. ``ZOO_TPU_FLASH_BWD=xla``
-    recomputes through the reference math instead (materializes O(L^2)
-    probs) — the same escape hatch as the bhld rule; before this it
-    silently no-opped on the default layout."""
+    """Backward via the blhd Pallas kernels under the default
+    save-lse-recompute-probs remat policy; the ``full``/``full-residual``
+    policy (or legacy ``ZOO_TPU_FLASH_BWD=xla``) recomputes through the
+    reference math instead (materializes O(L^2) probs) — same hatch as
+    the bhld rule; see :func:`_flash_remat_policy`."""
     q, k, v, kbias, o, lse = res
-    if os.environ.get("ZOO_TPU_FLASH_BWD", "kernel") == "xla":
+    if _flash_remat_policy() == "full":
         def ref(q, k, v, kb):
             # (B, L, H, d) -> the reference's (B, H, L, d); the vjp
             # transposes the cotangents back for free
@@ -906,14 +1221,13 @@ def flash_attention(q, k, v, bias=None, causal=False, sm_scale=None,
     ``ZOO_TPU_PALLAS_INTERPRET=1``) whenever the bias is absent or a
     key-padding bias — BERT-base B=32 L=512 now takes the kernel, which
     also removes its saved-probs HBM cost entirely (O(L) memory both
-    directions). Shorter sequences and full (B,H,Lq,Lk) biases use the
-    fused-XLA reference path. That path runs under ``jax.checkpoint`` only
-    once the *per-call* saved probs exceed 512 MB (or always, with
-    ``ZOO_TPU_ATTN_REMAT=1``): the threshold trades HBM for the ~15%
-    step-time cost of remat only when a single call's probs threaten
-    memory (the saved-probs variant OOMs BERT-base at batch 64 on a 16G
-    chip when forced through XLA). Deeper stacks or smaller chips on the
-    XLA path may need ``ZOO_TPU_ATTN_REMAT=1`` explicitly.
+    directions). Every other shape — shorter sequences, odd head dims,
+    full (B,H,Lq,Lk) biases, non-TPU backends — takes
+    :func:`attention_blockwise`, the scan-blockwise online-softmax
+    fallback that is also O(L) memory fwd+bwd. ``ZOO_TPU_ATTN_FALLBACK=
+    reference`` restores the pre-r6 reference fallback (full probs; runs
+    under ``jax.checkpoint`` once a call's saved probs exceed 512 MB, or
+    always with ``ZOO_TPU_ATTN_REMAT=1``) for A/B runs and as a hatch.
     ``ZOO_TPU_FORCE_PALLAS=1`` routes every eligible shape to the kernel;
     ``ZOO_TPU_DISABLE_PALLAS=1`` disables it entirely.
     """
@@ -928,6 +1242,14 @@ def flash_attention(q, k, v, bias=None, causal=False, sm_scale=None,
     use_kernel = eligible and _kernel_ok_for(b, h, lq, lk, d, causal,
                                              q.dtype, block_q, block_k)
     if not use_kernel:
+        if os.environ.get("ZOO_TPU_ATTN_FALLBACK", "blockwise") \
+                != "reference":
+            # deliberately NOT forwarding the kernel block sizes: they may
+            # equal L (a 512-seq kernel tile is legal, a 512x512 fallback
+            # score tile defeats the O(L) contract) — attention_blockwise
+            # picks strictly-smaller blocks itself
+            return attention_blockwise(q, k, v, bias=bias, causal=causal,
+                                       sm_scale=sm_scale)
         ref = functools.partial(attention_reference, causal=causal,
                                 sm_scale=sm_scale)
         # Remat only when the saved L^2 probs are big enough to threaten

@@ -29,15 +29,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..common.jax_compat import shard_map
 
 
 def _pvary(x, axis):
     """Mark ``x`` as device-varying over ``axis`` (no-op data-wise)."""
     if hasattr(lax, "pcast"):
         return lax.pcast(x, (axis,), to="varying")
-    return lax.pvary(x, (axis,))  # older spelling
+    if hasattr(lax, "pvary"):
+        return lax.pvary(x, (axis,))  # older spelling
+    return x  # pre-vma jax: no device-varying type system to satisfy
 
 
 def stack_stage_params(per_stage_params) -> Any:

@@ -29,6 +29,8 @@ from typing import Optional
 
 import jax
 
+from ..common.jax_compat import shard_map as shard_map_compat
+
 
 def _ulysses_impl(q, k, v, axis_name, head_axis, seq_axis, attn_fn,
                   causal, sm_scale, kbias):
@@ -121,13 +123,14 @@ def sharded_seq_attention(per_shard_fn, q, k, v, mesh, causal=False,
     fn = functools.partial(per_shard_fn, axis_name=seq_axis,
                            causal=causal, sm_scale=sm_scale)
     if kbias is None:
-        return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                             out_specs=spec)(q, k, v)
+        return shard_map_compat(fn, mesh=mesh,
+                                in_specs=(spec, spec, spec),
+                                out_specs=spec)(q, k, v)
     kb_spec = P(None, seq_axis)
     fn2 = lambda q, k, v, kb: fn(q, k, v, kbias=kb)  # noqa: E731
-    return jax.shard_map(fn2, mesh=mesh,
-                         in_specs=(spec, spec, spec, kb_spec),
-                         out_specs=spec)(q, k, v, kbias)
+    return shard_map_compat(fn2, mesh=mesh,
+                            in_specs=(spec, spec, spec, kb_spec),
+                            out_specs=spec)(q, k, v, kbias)
 
 
 def ulysses_attention_sharded(q, k, v, mesh, causal=False, sm_scale=None,

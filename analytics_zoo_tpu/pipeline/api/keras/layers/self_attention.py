@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .....common.jax_compat import shard_map as _shard_map
 from .....ops.attention import flash_attention_blhd
 from .....ops.fused_dropout_ln import dropout_add_layer_norm
 from ..engine.base import KerasLayer, init_tensor
@@ -63,8 +64,8 @@ def _dp_dropout_add_ln(x, resid, gamma, beta, rng, p_drop, training):
     # single elementwise+rowwise op — gradient correctness of the wrap
     # (incl. the replicated gamma/beta psum on transpose) is pinned by
     # test_dp_wrap_grad_parity on the 8-device mesh
-    return jax.shard_map(body, mesh=dp, in_specs=(px, px, pv, pv, P()),
-                         out_specs=px, check_vma=False)(
+    return _shard_map(body, mesh=dp, in_specs=(px, px, pv, pv, P()),
+                      out_specs=px, check_vma=False)(
         x, resid, gamma, beta, rng)
 
 
@@ -292,9 +293,6 @@ class TransformerLayer(KerasLayer):
             p["qkv_b"].astype(x.dtype)
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
-        def heads(t):
-            return t.reshape(b, l, nh, d).transpose(0, 2, 1, 3)
-
         sp = self._seq_parallel()
         if sp > 1 and l % sp == 0:
             # sequence parallelism over the 'seq' mesh axis: ulysses
@@ -304,7 +302,8 @@ class TransformerLayer(KerasLayer):
             # memory (parallel/ulysses.py, parallel/ring_attention.py;
             # key-padding bias rides along either way)
             from .....common.nncontext import get_nncontext
-            from .....parallel.ring_attention import ring_attention_sharded
+            from .....parallel.ring_attention import \
+                ring_attention_blhd_sharded
             from .....parallel.ulysses import \
                 ulysses_attention_blhd_sharded
 
@@ -330,10 +329,13 @@ class TransformerLayer(KerasLayer):
                     v.reshape(b, l, nh, d), get_nncontext().mesh,
                     causal=not self.bidirectional, kbias=kb)
             else:
-                o = ring_attention_sharded(
-                    heads(q), heads(k), heads(v), get_nncontext().mesh,
+                # blhd twin: the ring folds chunks in the projection's
+                # native (B, L, H, d) layout, so neither entry nor exit
+                # needs the [B,H,L,d] relayout transpose pair
+                o = ring_attention_blhd_sharded(
+                    q.reshape(b, l, nh, d), k.reshape(b, l, nh, d),
+                    v.reshape(b, l, nh, d), get_nncontext().mesh,
                     causal=not self.bidirectional, kbias=kb)
-                o = o.transpose(0, 2, 1, 3)
         else:
             # blhd entry: the (B, L, H, d) reshape of the fused QKV
             # projection feeds the kernel directly — no [B,H,L,d]
@@ -363,7 +365,7 @@ class TransformerLayer(KerasLayer):
                 def body(q_, k_, v_, bias_=None):
                     return attn(q_, k_, v_, bias=bias_)
 
-                o = jax.shard_map(
+                o = _shard_map(
                     body, mesh=dp, in_specs=tuple(in_specs),
                     out_specs=p4, check_vma=False)(*operands)
         o = o.reshape(b, l, h)

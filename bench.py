@@ -72,6 +72,21 @@ def _sigterm(_sig, _frm):
     os._exit(0)
 
 
+# Hard bench gates: invariants a leg asserts about its own numbers (the
+# attention hot path carries zero copy/transpose ops, the stub int8 chain
+# beats stub f32, ...). Failures are recorded in the JSON
+# (bench_gates_failed) and shouted on stderr either way;
+# ZOO_BENCH_STRICT_GATES=1 additionally turns them into a nonzero exit.
+GATE_FAILURES = []
+
+
+def _gate(name, ok, detail=""):
+    if not ok:
+        GATE_FAILURES.append({"gate": name, "detail": str(detail)[:200]})
+        print(f"# BENCH GATE FAILED: {name}: {detail}", file=sys.stderr)
+    return bool(ok)
+
+
 def _windows_stats(fn, n=3):
     """Run ``fn`` (one timed measurement window -> value) n times; return
     (median, {min, median, max}) so run-to-run tunnel noise is visible
@@ -425,8 +440,41 @@ def _bench_bert_mfu_at(peak_flops, bert_batch, seq_len=BERT_SEQ):
     # the signature that determines layout viability excludes batch
     layouts = kernel_layouts_ok(h=BERT_HEADS, lq=seq_len,
                                 lk=seq_len, d=BERT_H // BERT_HEADS)
+    # HLO step-time accountant (docs/performance.md): bucket the compiled
+    # step's per-op bytes so the MFU row says WHERE the step time goes,
+    # and gate the blhd layout contract — the attention hot path must
+    # contribute zero copy/transpose ops (a relayout pair bracketing the
+    # kernel shows up here long before it shows up as lost MFU).
+    acct_keys = {}
+    try:
+        from analytics_zoo_tpu.utils.profiling import account_step
+        acct = account_step(multi, params, opt_state, net_state,
+                            stacked, 0)
+        zero_ok = (acct["hot_ops"] > 0 and
+                   acct["hot_copy_transpose_ops"] == 0)
+        acct_keys = {
+            "bert_hlo_decomposition": {kk: round(vv, 4) for kk, vv
+                                       in acct["fractions"].items()},
+            "bert_relayout_fraction": round(acct["relayout_fraction"], 4),
+            "bert_attn_hot_ops": acct["hot_ops"],
+            "bert_attn_hot_copy_transpose":
+                acct["hot_copy_transpose_ops"],
+            "bert_attn_zero_relayout_ok": zero_ok,
+        }
+        if acct["hot_copy_transpose_names"]:
+            acct_keys["bert_attn_hot_copy_transpose_names"] = \
+                acct["hot_copy_transpose_names"][:8]
+        _gate("attn_zero_relayout", zero_ok,
+              f"L={seq_len} hot_ops={acct['hot_ops']} "
+              f"copy/transpose={acct['hot_copy_transpose_ops']} "
+              f"{acct['hot_copy_transpose_names'][:4]}")
+    except Exception as e:  # noqa: BLE001 — accountant must not kill MFU
+        acct_keys = {"bert_hlo_accountant_error":
+                     (str(e).splitlines()[0][:200] if str(e)
+                      else repr(e)[:200])}
     return {
         "bert_batch": bert_batch,
+        **acct_keys,
         "bert_step_time_ms": round(dt * 1e3, 2),
         "bert_steps_per_sec_windows": stats,
         "bert_tokens_per_sec": round(bert_batch * seq_len / dt, 1),
@@ -541,8 +589,27 @@ def _bench_resnet_mfu_at(peak_flops, batch):
     dt = 1.0 / sps
 
     achieved = 3 * RESNET_FWD_FLOPS_PER_IMAGE * batch / dt
+    # same decomposition as the BERT rows (no attention hot path here —
+    # the interesting fraction is conv vs relayout: NCHW<->NHWC shuffles
+    # land in the relayout bucket)
+    acct_keys = {}
+    try:
+        from analytics_zoo_tpu.utils.profiling import account_step
+        acct = account_step(multi, params, opt_state, net_state,
+                            stacked, 0)
+        acct_keys = {
+            "resnet_hlo_decomposition": {kk: round(vv, 4) for kk, vv
+                                         in acct["fractions"].items()},
+            "resnet_relayout_fraction":
+                round(acct["relayout_fraction"], 4),
+        }
+    except Exception as e:  # noqa: BLE001
+        acct_keys = {"resnet_hlo_accountant_error":
+                     (str(e).splitlines()[0][:200] if str(e)
+                      else repr(e)[:200])}
     return {
         "resnet_batch": batch,
+        **acct_keys,
         "resnet_step_time_ms": round(dt * 1e3, 2),
         "resnet_steps_per_sec_windows": stats,
         "resnet_images_per_sec": round(batch / dt, 1),
@@ -848,6 +915,15 @@ def bench_quant(n_dispatch=40):
         out[f"quant_{key}_stub_f32_rec_per_s"] = round(shape[0] / t_f, 1)
         out[f"quant_{key}_stub_int8_rec_per_s"] = round(shape[0] / t_q, 1)
         out[f"quant_{key}_stub_int8_speedup"] = round(t_f / t_q, 2)
+        # r5 regression gate: the chained-int8 pipeline modeled on the
+        # device must never land BELOW f32 — int8 halves compute time
+        # and quarters weight traffic, so t_q > t_f means the chain is
+        # carrying f32 dequant boundaries again (the r5 shape where the
+        # pipelined int8 row regressed under the f32 one)
+        out[f"quant_{key}_stub_gate_ok"] = _gate(
+            f"quant_{key}_stub_int8_ge_f32", t_q <= t_f,
+            f"stub int8 {shape[0] / t_q:.1f} rec/s < "
+            f"f32 {shape[0] / t_f:.1f} rec/s")
     out["quant_hot_path_int8"] = hot
     import jax as _jax
     if _jax.default_backend() != "tpu":
@@ -855,6 +931,111 @@ def bench_quant(n_dispatch=40):
                              "XLA-CPU's widened int8 GEMM, not the "
                              "chain; the stub_* rows model the v5e "
                              "device-bound regime")
+    return out
+
+
+def bench_attention(seq_len=2048):
+    """O(L)-fallback attention leg (docs/performance.md) — CPU-provable.
+
+    (a) Step wall time of the scan-blockwise fallback vs the pre-r6
+    reference fallback it replaced, on a BERT-long-shaped grad step
+    (key-padding bias, bidirectional, L=2048). Both routes go through
+    ``flash_attention`` with the kernel disabled so the A/B is exactly
+    the two XLA fallbacks; the reference side runs under
+    ``ZOO_TPU_ATTN_REMAT=1`` because at L=2048 any real model crosses
+    the 512M saved-probs threshold and remats (the route's own
+    heuristic — see flash_attention's docstring). Gate: blockwise must
+    be >= 1.5x. Samples are interleaved A/B so host-load drift hits
+    both routes equally.
+
+    (b) blhd backward parity under a 2-device dp shard_map mesh, via the
+    attn-smoke subprocess (scripts/attn-smoke runs the same checks):
+    grads of the shard_map'd blhd route must match the reference oracle
+    to < 1e-4 under BOTH remat hatches, and the jaxpr probe must show no
+    (B, H, L, L) intermediate on the fallback. Gate: smoke rc == 0.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    out = {"attn_seq_len": seq_len}
+    ENV = ("ZOO_TPU_ATTN_FALLBACK", "ZOO_TPU_ATTN_REMAT",
+           "ZOO_TPU_DISABLE_PALLAS")
+    saved = {kk: os.environ.get(kk) for kk in ENV}
+    try:
+        os.environ["ZOO_TPU_DISABLE_PALLAS"] = "1"
+        from analytics_zoo_tpu.ops import attention as attn_mod
+
+        ks = jax.random.split(jax.random.PRNGKey(0), 4)
+        b, h, d = 1, 8, 32
+        q, k, v = (jax.random.normal(ks[i], (b, h, seq_len, d),
+                                     jnp.float32) for i in range(3))
+        kb = jnp.where(jax.random.uniform(ks[3], (1, 1, 1, seq_len))
+                       < 0.1, -1e9, 0.0).astype(jnp.float32)
+
+        def make(route, remat):
+            os.environ["ZOO_TPU_ATTN_FALLBACK"] = route
+            if remat is None:
+                os.environ.pop("ZOO_TPU_ATTN_REMAT", None)
+            else:
+                os.environ["ZOO_TPU_ATTN_REMAT"] = remat
+            g = jax.jit(jax.grad(
+                lambda q, k, v, bi: (attn_mod.flash_attention(
+                    q, k, v, bias=bi) ** 2).sum(), argnums=(0, 1, 2)))
+            for _ in range(2):          # compile + cold-cache warmup
+                jax.block_until_ready(g(q, k, v, kb))
+            return g
+
+        g_new = make("blockwise", None)
+        g_old = make("reference", "1")
+
+        def sample(g):
+            t0 = time.perf_counter()
+            for _ in range(2):
+                r = g(q, k, v, kb)
+            jax.block_until_ready(r)
+            return (time.perf_counter() - t0) / 2
+
+        t_new, t_old = [], []
+        for _ in range(5):
+            t_new.append(sample(g_new))
+            t_old.append(sample(g_old))
+        tn, to = min(t_new), min(t_old)
+        out["attn_blockwise_step_ms"] = round(tn * 1e3, 1)
+        out["attn_reference_step_ms"] = round(to * 1e3, 1)
+        out["attn_blockwise_speedup"] = round(to / tn, 2)
+        out["attn_shape"] = f"b{b} h{h} L{seq_len} d{d} keybias"
+        out["attn_speedup_gate_ok"] = _gate(
+            "attn_blockwise_speedup_1p5x", to / tn >= 1.5,
+            f"blockwise {tn * 1e3:.0f}ms vs reference(remat) "
+            f"{to * 1e3:.0f}ms = {to / tn:.2f}x < 1.5x")
+    finally:
+        for kk, vv in saved.items():
+            if vv is None:
+                os.environ.pop(kk, None)
+            else:
+                os.environ[kk] = vv
+
+    # dp shard_map parity + jaxpr probe in a pinned 2-device subprocess
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    for kk in ENV + ("ZOO_TPU_FLASH_REMAT", "ZOO_TPU_FLASH_BWD"):
+        env.pop(kk, None)
+    p = subprocess.run(
+        [sys.executable, "-m", "analytics_zoo_tpu.ops.attn_smoke",
+         "--json"], capture_output=True, text=True, env=env, timeout=900)
+    out["attn_smoke_rc"] = p.returncode
+    try:
+        payload = json.loads(p.stdout.strip().splitlines()[-1])
+        out["attn_dp_parity_max_err"] = payload.get("dp_parity_max_err")
+        out["attn_dp_parity_ok"] = payload.get("dp_parity_ok")
+        out["attn_jaxpr_no_lxl"] = payload.get("jaxpr_no_lxl")
+        out["attn_smoke_checks"] = payload.get("checks")
+    except Exception:  # noqa: BLE001 — keep stderr head for diagnosis
+        out["attn_smoke_parse_err"] = (p.stderr or p.stdout)[-300:]
+    _gate("attn_dp_shard_map_parity", p.returncode == 0,
+          f"attn_smoke rc={p.returncode}: "
+          f"{(p.stderr or p.stdout)[-160:]}")
     return out
 
 
@@ -1335,7 +1516,14 @@ def bench_infeed(n_images=480, batch_size=32):
     steady = waits[2:] if len(waits) > 4 else waits
     wait_ms = 1e3 * float(np.mean(steady)) if steady else 0.0
     fill_ms = 1e3 * float(max(waits[:2])) if waits else 0.0
+    # InputBoundFraction: share of the steady-state step cadence spent
+    # blocked on input (wait / (wait + step)) — the engine reports the
+    # same ratio per logging window via InfeedMonitor; ~0 means the
+    # transform pool kept pace with the model's consumption rate
+    mean_wait_s = float(np.mean(steady)) if steady else 0.0
+    input_bound = mean_wait_s / (mean_wait_s + step_s) if step_s else 0.0
     return {
+        "infeed_input_bound_fraction": round(input_bound, 4),
         "infeed_img_per_s": round(cap, 1),
         "infeed_img_per_s_per_core": round(per_core, 1),
         "infeed_cores_for_1300_img_s": round(1300.0 / per_core, 1),
@@ -1679,6 +1867,19 @@ def main():
                                          if str(e) else repr(e)[:500])
         emit()
 
+    # Attention-fallback leg: blockwise-vs-old-reference step wall time
+    # at L=2048 (>= 1.5x gate) + dp shard_map blhd parity via the
+    # attn-smoke subprocess (docs/performance.md). CPU-provable.
+    if time.time() - T_START < TOTAL_BUDGET_S * 0.85:
+        try:
+            RESULT.update(bench_attention())
+        except Exception as e:  # noqa: BLE001
+            import traceback
+            traceback.print_exc()
+            RESULT["attn_error"] = (str(e).splitlines()[0][:500]
+                                    if str(e) else repr(e)[:500])
+        emit()
+
     # Serving-latency leg (SURVEY §7 hard-part (e)): AOT predict p50/p99
     # f32 vs int8 (weight-only + calibrated) + in-process e2e round trip.
     if time.time() - T_START < TOTAL_BUDGET_S * 0.9:
@@ -1767,6 +1968,13 @@ def main():
         except Exception as e:  # noqa: BLE001
             RESULT["infeed_error"] = (str(e).splitlines()[0][:500]
                                       if str(e) else repr(e)[:500])
+        # on TPU rounds the input-bound fraction is load-bearing (it is
+        # the denominator the MFU targets assume) — its absence means
+        # the infeed leg silently lost the measurement, so gate hard
+        if info["platform"] == "tpu":
+            _gate("infeed_input_bound_fraction_reported",
+                  "infeed_input_bound_fraction" in RESULT,
+                  RESULT.get("infeed_error", "key missing"))
         emit()
 
     # Staged host pipeline leg — serial vs transform-pool/staging overlap
@@ -1803,8 +2011,11 @@ def main():
                                       if str(e) else repr(e)[:500])
         emit()
 
+    RESULT["bench_gates_failed"] = GATE_FAILURES
     emit()
     print(json.dumps(RESULT))
+    if GATE_FAILURES and os.environ.get("ZOO_BENCH_STRICT_GATES") == "1":
+        sys.exit(1)
 
 
 if __name__ == "__main__":

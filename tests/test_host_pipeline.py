@@ -452,3 +452,19 @@ class TestShutdownAllPipelines:
         next(it)
         assert shutdown_all_pipelines() >= 1
         assert shutdown_all_pipelines() == 0  # registry drained
+
+
+def test_resolve_transform_workers_auto_and_literal():
+    """transform_workers=-1 auto-sizes the transform pool to the host's
+    core count clamped to [2, 8]; literal values (including 0 = inline)
+    pass through untouched."""
+    import os
+
+    from analytics_zoo_tpu.feature.host_pipeline import (
+        resolve_transform_workers)
+
+    auto = resolve_transform_workers(-1)
+    assert auto == max(2, min(8, os.cpu_count() or 2))
+    assert 2 <= auto <= 8
+    assert resolve_transform_workers(0) == 0
+    assert resolve_transform_workers(5) == 5
