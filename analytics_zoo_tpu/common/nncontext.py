@@ -128,6 +128,13 @@ class ZooConfig:
     # off the training hot path). Multi-host formats stay synchronous —
     # they are barrier-sequenced.
     async_checkpoint: bool = False
+    # keep-last-k retention for the flat checkpoint store (ckpt-<step>/
+    # dirs under the checkpoint directory); <=0 disables pruning
+    keep_checkpoints: int = 3
+    # resume from the latest checkpoint in checkpoint_dir at the start of
+    # train() — set by zoo-launch's on_failure=restart attempts
+    # (ZOO_TPU_AUTO_RESUME); a plain fit() stays a fresh run by default
+    auto_resume: bool = False
     # NNFrames ingest: when the processed samples of a DataFrame would
     # exceed this many bytes, NNEstimator.fit spills them to sharded .npz
     # files and streams (ShardedFileFeatureSet) instead of holding the

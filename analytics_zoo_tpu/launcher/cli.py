@@ -34,11 +34,21 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--env", action="append", default=[], metavar="K=V",
                     help="extra env var for every worker (repeatable); "
                          "e.g. --env ZOO_TPU_DATA_PARALLEL=4")
-    ap.add_argument("--on-failure", choices=("kill-all", "report"),
+    ap.add_argument("--on-failure",
+                    choices=("kill-all", "report", "restart"),
                     default="kill-all",
                     help="kill-all: first nonzero exit terminates the "
                          "rest (default); report: let survivors finish "
-                         "and report at the end")
+                         "and report at the end; restart: tear down the "
+                         "gang and relaunch it (workers auto-resume from "
+                         "the latest checkpoint)")
+    ap.add_argument("--max-restarts", type=int, default=3, metavar="N",
+                    help="with --on-failure restart: give up after N "
+                         "gang relaunches (default: 3)")
+    ap.add_argument("--restart-backoff-s", type=float, default=1.0,
+                    metavar="S",
+                    help="with --on-failure restart: initial delay before "
+                         "relaunching, doubled each attempt (default: 1.0)")
     ap.add_argument("--coordinator-port", type=int, default=None,
                     help="fixed coordination-service port (default: an "
                          "OS-assigned free port)")
@@ -67,7 +77,9 @@ def main(argv=None) -> int:
                       num_hosts=args.hosts, hosts_file=args.hosts_file,
                       env=extra_env, on_failure=args.on_failure,
                       coordinator_port=args.coordinator_port,
-                      prefix=not args.no_prefix)
+                      prefix=not args.no_prefix,
+                      max_restarts=args.max_restarts,
+                      restart_backoff_s=args.restart_backoff_s)
     except LaunchError as e:
         print(f"zoo-launch: {e}", file=sys.stderr)
         return 2

@@ -19,9 +19,16 @@ Failure policy (``on_failure``):
   collective is dead anyway once one member is gone;
 - ``report``: let the surviving workers run to completion and report the
   failure at the end.
+- ``restart``: SPMD is all-or-nothing — any worker death tears down the
+  whole gang (as kill-all) and relaunches it, up to ``max_restarts``
+  times with exponential backoff starting at ``restart_backoff_s``.
+  Restarted gangs get ``ZOO_TPU_AUTO_RESUME=1`` so training resumes from
+  the ``latest`` checkpoint (see docs/fault-tolerance.md); each attempt
+  picks a fresh coordinator port (the dead gang's port may linger in
+  TIME_WAIT).
 
 Either way :func:`launch` returns the **first nonzero exit code** (0 when
-every worker succeeded).
+every worker succeeded, possibly after restarts).
 """
 
 from __future__ import annotations
@@ -122,13 +129,14 @@ def launch(script_argv: Sequence[str], num_hosts: Optional[int] = None,
            on_failure: str = "kill-all",
            coordinator_port: Optional[int] = None,
            grace_s: float = 10.0, stream=None, prefix: bool = True,
-           python: Optional[str] = None) -> int:
+           python: Optional[str] = None, max_restarts: int = 3,
+           restart_backoff_s: float = 1.0) -> int:
     """Run ``script_argv`` (a train script + its args) as a multi-process
     job. See module docstring for the env contract and failure policy.
     Returns the first nonzero worker exit code, or 0."""
-    if on_failure not in ("kill-all", "report"):
+    if on_failure not in ("kill-all", "report", "restart"):
         raise LaunchError(
-            f"on_failure must be 'kill-all' or 'report', got "
+            f"on_failure must be 'kill-all', 'report' or 'restart', got "
             f"{on_failure!r}")
     if not script_argv:
         raise LaunchError("no train script given")
@@ -152,15 +160,63 @@ def launch(script_argv: Sequence[str], num_hosts: Optional[int] = None,
         raise LaunchError(f"need >= 1 worker, got {world}")
     stream = stream if stream is not None else sys.stdout
     python = python or sys.executable
-    port = coordinator_port or _free_port()
-    coordinator = f"127.0.0.1:{port}"
     base_env = dict(os.environ)
 
     cmd_tail = [os.fspath(a) for a in script_argv]
-    logger.info("zoo-launch: %d worker(s), coordinator %s, on-failure=%s: "
-                "%s", world, coordinator, on_failure,
-                " ".join(shlex.quote(c) for c in cmd_tail))
     lock = threading.Lock()
+    attempt = 0
+    while True:
+        port = coordinator_port or _free_port()
+        coordinator = f"127.0.0.1:{port}"
+        extra_env = dict(env or {})
+        if on_failure == "restart":
+            # every attempt (the first included) resumes from `latest`
+            # when one exists: under the restart policy the launcher —
+            # not the script — owns the job's lifecycle, so a relaunch
+            # of the whole zoo-launch process must also pick up where
+            # the checkpoint left off. Explicit user env wins.
+            extra_env.setdefault("ZOO_TPU_AUTO_RESUME", "1")
+        logger.info("zoo-launch: %d worker(s), coordinator %s, "
+                    "on-failure=%s%s: %s", world, coordinator, on_failure,
+                    f" (attempt {attempt + 1})" if attempt else "",
+                    " ".join(shlex.quote(c) for c in cmd_tail))
+        first_rc, failed_pid = _run_gang(
+            cmd_tail, world, coordinator, base_env, extra_env, on_failure,
+            grace_s, stream, lock, prefix, python)
+        if first_rc == 0:
+            with lock:
+                stream.write(f"[zoo-launch] job complete: {world} "
+                             f"worker(s) exited 0\n")
+                stream.flush()
+            return 0
+        if on_failure != "restart" or attempt >= max_restarts:
+            if on_failure == "restart":
+                with lock:
+                    stream.write(
+                        f"[zoo-launch] restarts exhausted "
+                        f"({max_restarts}): giving up with rc="
+                        f"{first_rc}\n")
+                    stream.flush()
+            return first_rc
+        attempt += 1
+        delay = restart_backoff_s * (2 ** (attempt - 1))
+        with lock:
+            stream.write(
+                f"[zoo-launch] worker-{failed_pid} rc={first_rc}: "
+                f"restarting gang (attempt {attempt}/{max_restarts}) "
+                f"in {delay:.1f}s\n")
+            stream.flush()
+        time.sleep(delay)
+
+
+def _run_gang(cmd_tail: List[str], world: int, coordinator: str,
+              base_env: Dict[str, str], env: Optional[Dict[str, str]],
+              on_failure: str, grace_s: float, stream, lock, prefix: bool,
+              python: str):
+    """Spawn one gang of ``world`` workers and supervise it to completion.
+    Returns ``(first_rc, failed_pid)``. Under kill-all AND restart, the
+    first death terminates the survivors (SPMD: the collective is dead
+    once one member is gone)."""
     procs: List[subprocess.Popen] = []
     pumps: List[threading.Thread] = []
     try:
@@ -194,10 +250,11 @@ def launch(script_argv: Sequence[str], num_hosts: Optional[int] = None,
                     stream.flush()
                 if first_rc == 0:
                     first_rc, failed_pid = rc, pid
-                if on_failure == "kill-all" and not killed and pending:
+                if on_failure in ("kill-all", "restart") and not killed \
+                        and pending:
                     with lock:
                         stream.write(
-                            f"[zoo-launch] on-failure=kill-all: "
+                            f"[zoo-launch] on-failure={on_failure}: "
                             f"terminating {len(pending)} remaining "
                             f"worker(s)\n")
                         stream.flush()
@@ -214,12 +271,7 @@ def launch(script_argv: Sequence[str], num_hosts: Optional[int] = None,
                 f"[zoo-launch] job FAILED: first failure worker-"
                 f"{failed_pid} rc={first_rc}; exit codes {rcs}\n")
             stream.flush()
-    else:
-        with lock:
-            stream.write(
-                f"[zoo-launch] job complete: {world} worker(s) exited 0\n")
-            stream.flush()
-    return first_rc
+    return first_rc, failed_pid
 
 
 def _terminate_all(procs: Sequence[subprocess.Popen], grace_s: float):

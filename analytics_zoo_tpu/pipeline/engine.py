@@ -15,11 +15,14 @@ triggers, checkpointing, summaries, and the failure-retry policy
 
 from __future__ import annotations
 
+import json
 import logging
 import os
+import threading
 import time
+import weakref
 from functools import partial
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -34,11 +37,48 @@ from ..feature.feature_set import (ArrayFeatureSet, FeatureSet, MiniBatch,
                                    minibatch_len, pad_minibatch)
 from ..feature.host_pipeline import (DeviceStagingIterator,
                                      build_host_pipeline)
-from ..utils import file_io, serialization, sharded_checkpoint
+from ..utils import faults, file_io, serialization, sharded_checkpoint
+from ..utils.crc32c import crc32c
 from ..utils.profiling import (InfeedMonitor, ProfilerHook, inference_window,
                                peak_flops)
+from ..utils.sharded_checkpoint import ChecksumError
 
 logger = logging.getLogger("analytics_zoo_tpu.engine")
+
+
+class TrainingPreempted(RuntimeError):
+    """Raised out of ``train()`` after a preemption notice (SIGTERM): the
+    loop drained the in-flight dispatch and saved a final checkpoint.
+    Deliberately NOT retried by the failure-retry policy — the process is
+    being evicted; the gang supervisor relaunches and auto-resumes."""
+
+
+# preemption drain: a SIGTERM handler (launcher.worker) flips this event;
+# every live training loop checkpoints at the next step boundary and
+# raises TrainingPreempted within the grace budget
+_PREEMPTION = threading.Event()
+_ACTIVE_TRAINERS: "weakref.WeakSet[SPMDTrainer]" = weakref.WeakSet()
+
+
+def request_preemption() -> None:
+    """Ask every live training loop to drain, checkpoint, and exit
+    (called from the worker's SIGTERM handler; signal-safe: just an
+    Event set)."""
+    _PREEMPTION.set()
+
+
+def preemption_requested() -> bool:
+    return _PREEMPTION.is_set()
+
+
+def clear_preemption() -> None:
+    _PREEMPTION.clear()
+
+
+def active_trainer_count() -> int:
+    """How many trainers are inside ``train()`` right now (the worker's
+    SIGTERM handler uses this to pick drain vs immediate teardown)."""
+    return sum(1 for _ in _ACTIVE_TRAINERS)
 
 
 def _cast_tree(tree, dtype):
@@ -163,6 +203,11 @@ class SPMDTrainer:
         self.opt_state = None
         self.step = 0
         self.epoch = 0
+        # dataset cursor: batches consumed of the CURRENT epoch. Saved in
+        # checkpoint meta; on restore _run_epoch skips this many batches of
+        # the (deterministically seeded) epoch shuffle, so a mid-epoch
+        # resume replays the exact remaining data order.
+        self.epoch_batches = 0
         # summary-log cursor; lives on the trainer so short epochs still
         # accumulate toward log_every_n_steps instead of resetting
         self._last_log_step = 0
@@ -669,37 +714,68 @@ class SPMDTrainer:
                 "set_checkpoint(path) first (parity: setCheckpoint)")
         validation_trigger = validation_trigger or (
             EveryEpoch() if validation_set is not None else None)
+        self._maybe_auto_resume()
         step_fn = self.build_train_step()
         record = TrainRecord(epoch=self.epoch, iteration=self.step)
         retries = 0
         max_retries = self.ctx.config.failure_retry_times
-        while not end_trigger(record):
-            try:
-                self._run_epoch(train_set, batch_size, step_fn, record,
-                                checkpoint_trigger, validation_set,
-                                validation_trigger, end_trigger)
-            except (jax.errors.JaxRuntimeError, RuntimeError) as e:
-                retries += 1
-                # an in-flight async write may be the checkpoint we need:
-                # land it before deciding whether retry is possible
+        _ACTIVE_TRAINERS.add(self)
+        try:
+            while not end_trigger(record):
                 try:
+                    self._run_epoch(train_set, batch_size, step_fn, record,
+                                    checkpoint_trigger, validation_set,
+                                    validation_trigger, end_trigger)
+                except TrainingPreempted:
+                    # deliberate exit, final checkpoint already saved —
+                    # never burn failure retries on an eviction notice
                     self.wait_for_checkpoint()
-                except Exception:  # noqa: BLE001 - the write itself failed
-                    logger.warning("pending checkpoint write failed",
-                                   exc_info=True)
-                has_ckpt = self.checkpoint_dir is not None and \
-                    self.has_checkpoint(self.checkpoint_dir)
-                if retries > max_retries or not has_ckpt:
                     raise
-                logger.warning("step failed (%s); restoring latest "
-                               "checkpoint (retry %d/%d)", e, retries,
-                               max_retries)
-                self.load_checkpoint(self.checkpoint_dir)
-                record.epoch, record.iteration = self.epoch, self.step
+                except (jax.errors.JaxRuntimeError, RuntimeError) as e:
+                    retries += 1
+                    # an in-flight async write may be the checkpoint we
+                    # need: land it before deciding whether retry is
+                    # possible
+                    try:
+                        self.wait_for_checkpoint()
+                    except Exception:  # noqa: BLE001 - write itself failed
+                        logger.warning("pending checkpoint write failed",
+                                       exc_info=True)
+                    has_ckpt = self.checkpoint_dir is not None and \
+                        self.has_checkpoint(self.checkpoint_dir)
+                    if retries > max_retries or not has_ckpt:
+                        raise
+                    logger.warning("step failed (%s); restoring latest "
+                                   "checkpoint (retry %d/%d)", e, retries,
+                                   max_retries)
+                    self.load_checkpoint(self.checkpoint_dir)
+                    record.epoch, record.iteration = self.epoch, self.step
+        finally:
+            _ACTIVE_TRAINERS.discard(self)
         # an async checkpoint still in flight must be durable before
         # train() reports completion
         self.wait_for_checkpoint()
         return record
+
+    def _maybe_auto_resume(self):
+        """Resume from the latest checkpoint when the supervisor asks for
+        it (``ZOO_TPU_AUTO_RESUME=1``, set by ``zoo-launch`` restart
+        attempts, or ``ZooConfig.auto_resume``). Off by default: a plain
+        ``fit()`` into a dir holding old checkpoints must stay a fresh
+        run."""
+        wants = getattr(self.ctx.config, "auto_resume", False) or \
+            os.environ.get("ZOO_TPU_AUTO_RESUME", "0").lower() in (
+                "1", "true", "yes", "on")
+        if not wants or self.checkpoint_dir is None or self.step != 0:
+            return
+        if not self.has_checkpoint(self.checkpoint_dir):
+            logger.info("auto-resume: no checkpoint in %s yet, fresh start",
+                        self.checkpoint_dir)
+            return
+        self.load_checkpoint(self.checkpoint_dir)
+        logger.info("auto-resume: restored step %d epoch %d (+%d batches) "
+                    "from %s", self.step, self.epoch, self.epoch_batches,
+                    self.checkpoint_dir)
 
     def _run_epoch(self, train_set, batch_size, step_fn, record,
                    checkpoint_trigger, validation_set, validation_trigger,
@@ -710,6 +786,17 @@ class SPMDTrainer:
             train_set, batch_size, shuffle=True, drop_remainder=True,
             seed=epoch_seed, transform_workers=cfg.transform_workers,
             prefetch_depth=cfg.prefetch_depth)
+        # mid-epoch resume: the epoch order is a pure function of
+        # (seed, epoch), so skipping the batches the checkpoint already
+        # consumed replays the exact remaining order (bit-exact parity
+        # with the uninterrupted run)
+        if self.epoch_batches > 0:
+            logger.info("resuming epoch %d mid-stream: skipping %d "
+                        "consumed batch(es)", record.epoch,
+                        self.epoch_batches)
+            for _ in range(self.epoch_batches):
+                if next(it, None) is None:
+                    break
         staging = DeviceStagingIterator(
             it, self._put_batch, self._put_stacked,
             depth=cfg.device_ahead, monitor=InfeedMonitor())
@@ -780,6 +867,14 @@ class SPMDTrainer:
             if cfg.profile_dir else None
 
         while True:
+            if preemption_requested():
+                if self.checkpoint_dir is not None:
+                    self.save_checkpoint(self.checkpoint_dir)
+                    self.wait_for_checkpoint()
+                raise TrainingPreempted(
+                    f"preemption notice honoured at step {self.step}"
+                    + ("" if self.checkpoint_dir is None
+                       else f": checkpoint saved to {self.checkpoint_dir}"))
             k = min(self._steps_per_dispatch_target(),
                     _iteration_granularity_all(
                         record, end_trigger, checkpoint_trigger,
@@ -815,10 +910,14 @@ class SPMDTrainer:
                                      self.step + done)
                     done += 1
             self.step += done
+            self.epoch_batches += done
             n_batches += done
             window_steps += done
             record.iteration = self.step
             record.epoch_finished = False
+            # chaos harness: an armed step:kill@N fault fires here (at or
+            # after N — multi-step dispatch cannot jump over it)
+            faults.check("step", step=self.step)
             last_loss = logs["loss"]
             if profiler is not None:
                 profiler.step(self.step)
@@ -872,6 +971,7 @@ class SPMDTrainer:
         if last_loss is not None:
             record.loss = float(last_loss)
         self.epoch += 1
+        self.epoch_batches = 0
         record.epoch = self.epoch
         record.epoch_finished = True
         dur = time.time() - t0
@@ -1094,6 +1194,7 @@ class SPMDTrainer:
         # previous commit pointing at its own complete, mutually-consistent
         # params/state/optim/meta set — never a new-params/old-optim mix
         tag = f"s{self.step}"
+        faults.begin_save()
         for name, leaves in groups.items():
             sharded_checkpoint.save_shards(directory, name, leaves,
                                            tag=tag)
@@ -1105,8 +1206,7 @@ class SPMDTrainer:
                                                   tag=tag)
             serialization.save_pytree(
                 os.path.join(directory, f"meta.{tag}.npz"),
-                {"step": np.asarray(self.step),
-                 "epoch": np.asarray(self.epoch)})
+                self._train_position_meta())
             sharded_checkpoint.write_commit(directory, tag)
             # post-commit cleanup: earlier tags and any stale flat
             # checkpoint that would shadow this one on load (file_io:
@@ -1169,9 +1269,7 @@ class SPMDTrainer:
                 tag=tag))
         meta_name = "meta.npz" if tag is None else f"meta.{tag}.npz"
         meta = serialization.load_pytree(os.path.join(directory, meta_name))
-        self.step = int(meta["step"])
-        self.epoch = int(meta["epoch"])
-        self._last_log_step = self.step
+        self._restore_position(meta)
 
     @staticmethod
     def _sharded_available(directory: str) -> bool:
@@ -1179,36 +1277,155 @@ class SPMDTrainer:
         return sharded_checkpoint.exists(directory, "params", tag)
 
     def has_checkpoint(self, directory: str) -> bool:
-        return file_io.exists(os.path.join(directory, "model.npz")) or \
+        return bool(self._store_candidates(directory)) or \
+            file_io.exists(os.path.join(directory, "model.npz")) or \
             self._sharded_available(directory)
+
+    # -- flat checkpoint store v2: ckpt-<step>/ + manifest + latest -----
+    #
+    # Layout under <directory>/:
+    #   ckpt-<step>/model.npz[.treedef], optim.npz, meta.npz[.treedef]
+    #   ckpt-<step>/manifest.json   (crc32c+size of every file; written
+    #                                LAST, atomically — a dir without one
+    #                                is an aborted write, invisible)
+    #   latest                      (atomically-swapped pointer)
+    # Retention keeps the newest ZooConfig.keep_checkpoints valid dirs.
+    # meta carries the full training position: step, epoch, the dataset
+    # cursor (epoch_batches), seed, and the host RNG state.
+    CKPT_PREFIX = "ckpt-"
+    LATEST_FILE = "latest"
+
+    @staticmethod
+    def _store_candidates(directory: str) -> List[Tuple[str, Dict]]:
+        """Valid (manifest-bearing) v2 checkpoint dirs, newest-first.
+        Aborted writes (no manifest) are naturally excluded."""
+        try:
+            entries = file_io.listdir(directory)
+        except OSError:
+            return []
+        out = []
+        for name in entries:
+            if not name.startswith(SPMDTrainer.CKPT_PREFIX):
+                continue
+            mpath = os.path.join(directory, name, "manifest.json")
+            try:
+                manifest = json.loads(file_io.read_bytes(mpath).decode())
+            except (OSError, ValueError):
+                continue
+            out.append((name, manifest))
+        out.sort(key=lambda t: -int(t[1].get("step", -1)))
+        return out
 
     @staticmethod
     def _write_flat_checkpoint(directory, params_np, state_np, opt_leaves,
-                               step, epoch):
-        """Serialize + atomically publish one flat checkpoint from HOST
-        snapshots (no trainer state touched — safe on a writer thread)."""
-        file_io.makedirs(directory)
-        # write to temp names + atomic rename so a reader (retry path
-        # on another process) can never observe a half-written file.
-        # Temp names keep the .npz suffix (save_leaves appends it
-        # otherwise) and the .treedef sidecars rename along.
-        for fname, writer, sidecars in (
-                ("model.npz", lambda p: serialization.save_pytree(
-                    p, {"params": params_np, "state": state_np}),
-                 (".treedef",)),
-                ("optim.npz", lambda p: serialization.save_leaves(
-                    p, opt_leaves), ()),
-                ("meta.npz", lambda p: serialization.save_pytree(
-                    p, {"step": np.asarray(step),
-                        "epoch": np.asarray(epoch)}),
-                 (".treedef",))):
-            tmp = os.path.join(directory, fname + ".tmp.npz")
-            writer(tmp)
-            final = os.path.join(directory, fname)
-            for suffix in sidecars:
-                file_io.rename(tmp + suffix, final + suffix)
-            file_io.rename(tmp, final)
-        logger.info("checkpoint saved to %s @step %d", directory, step)
+                               meta, keep=3):
+        """Serialize + atomically publish one full-state checkpoint from
+        HOST snapshots (no trainer state touched — safe on a writer
+        thread). Files land in ckpt-<step>/; the manifest (checksums) is
+        written last via tmp+rename, then the ``latest`` pointer swaps —
+        a crash at any earlier point leaves this save invisible and the
+        previous checkpoint authoritative."""
+        step = int(meta["step"])
+        sub = f"{SPMDTrainer.CKPT_PREFIX}{step}"
+        base = os.path.join(directory, sub)
+        file_io.makedirs(base)
+        model_data, model_tdef = serialization.pytree_bytes(
+            {"params": params_np, "state": state_np})
+        optim_data = serialization.leaves_bytes(opt_leaves)
+        meta_data, meta_tdef = serialization.pytree_bytes(meta)
+        files = (("model.npz", model_data),
+                 ("optim.npz", optim_data),
+                 ("meta.npz", meta_data),
+                 ("model.npz.treedef", model_tdef),
+                 ("meta.npz.treedef", meta_tdef))
+        sums = {}
+        for fname, data in files:
+            faults.checked_write(os.path.join(base, fname), data,
+                                 file_io.write_bytes)
+            sums[fname] = {"crc32c": crc32c(data), "size": len(data)}
+        manifest = {"format": "flat-v2", "step": step,
+                    "epoch": int(meta["epoch"]), "files": sums}
+        file_io.write_bytes_atomic(os.path.join(base, "manifest.json"),
+                                   json.dumps(manifest).encode())
+        file_io.write_bytes_atomic(
+            os.path.join(directory, SPMDTrainer.LATEST_FILE), sub.encode())
+        SPMDTrainer._prune_checkpoints(directory, keep)
+        logger.info("checkpoint saved to %s @step %d", base, step)
+
+    @staticmethod
+    def _prune_checkpoints(directory: str, keep: int):
+        """Keep-last-k retention: drop valid checkpoints beyond the newest
+        ``keep``, plus aborted (manifest-less) dirs strictly older than the
+        newest valid step — never a dir a concurrent writer could still be
+        filling (any live writer is writing a NEWER step)."""
+        if keep <= 0:
+            return
+        valid = SPMDTrainer._store_candidates(directory)
+        if not valid:
+            return
+        newest_step = int(valid[0][1].get("step", -1))
+        doomed = [name for name, _ in valid[keep:]]
+        valid_names = {name for name, _ in valid}
+        try:
+            entries = file_io.listdir(directory)
+        except OSError:
+            entries = []
+        for name in entries:
+            if not name.startswith(SPMDTrainer.CKPT_PREFIX) \
+                    or name in valid_names:
+                continue
+            try:
+                step = int(name[len(SPMDTrainer.CKPT_PREFIX):])
+            except ValueError:
+                continue
+            if step < newest_step:
+                doomed.append(name)
+        for name in doomed:
+            try:
+                file_io.remove_tree(os.path.join(directory, name))
+            except OSError:
+                logger.debug("retention prune of %s failed", name,
+                             exc_info=True)
+
+    @staticmethod
+    def _host_rng_capture() -> Dict[str, np.ndarray]:
+        """The numpy global RNG drives host-side augmentation; capture it
+        so resumed data transforms continue the same stream."""
+        alg, keys, pos, has_gauss, cached = np.random.get_state(
+            legacy=True)
+        return {"rng_alg": np.asarray(alg),
+                "rng_keys": np.asarray(keys),
+                "rng_pos": np.asarray(pos),
+                "rng_has_gauss": np.asarray(has_gauss),
+                "rng_cached": np.asarray(cached)}
+
+    @staticmethod
+    def _host_rng_restore(meta) -> None:
+        if "rng_keys" not in meta:
+            return  # pre-v2 checkpoint
+        np.random.set_state((str(meta["rng_alg"]),
+                             np.asarray(meta["rng_keys"]),
+                             int(meta["rng_pos"]),
+                             int(meta["rng_has_gauss"]),
+                             float(meta["rng_cached"])))
+
+    def _train_position_meta(self) -> Dict[str, np.ndarray]:
+        meta = {"step": np.asarray(self.step),
+                "epoch": np.asarray(self.epoch),
+                "epoch_batches": np.asarray(self.epoch_batches),
+                "seed": np.asarray(self.seed)}
+        meta.update(self._host_rng_capture())
+        return meta
+
+    def _restore_position(self, meta) -> None:
+        self.step = int(meta["step"])
+        self.epoch = int(meta["epoch"])
+        self.epoch_batches = int(meta.get("epoch_batches", 0))
+        self._host_rng_restore(meta)
+        # a warm resume jumps self.step far past the cursor; without this
+        # the first step after load fires an immediate summary/log burst
+        # (ADVICE r3 #4)
+        self._last_log_step = self.step
 
     def _flat_snapshot(self, copy: bool):
         """Host snapshot of the trainer state. ``copy=True`` forces owned
@@ -1241,7 +1458,7 @@ class SPMDTrainer:
         return (jax.tree.map(snap, self.params),
                 jax.tree.map(snap, self.net_state),
                 jax.tree.map(snap, self.opt_state),
-                self.step, self.epoch)
+                self._train_position_meta())
 
     def wait_for_checkpoint(self):
         """Join a pending async checkpoint write; re-raises its error."""
@@ -1268,6 +1485,8 @@ class SPMDTrainer:
             self._save_checkpoint_sharded(directory)
             return
         if jax.process_index() == 0:
+            faults.begin_save()
+            keep = int(getattr(self.ctx.config, "keep_checkpoints", 3))
             use_async = self._async_ckpt_eligible()
             snapshot = self._flat_snapshot(copy=use_async)
             if use_async:
@@ -1277,9 +1496,10 @@ class SPMDTrainer:
                 # serialization + file IO — the stall the hot loop cares
                 # about — moves off-thread
                 self._ckpt_future = _checkpoint_writer_pool().submit(
-                    self._write_flat_checkpoint, directory, *snapshot)
+                    self._write_flat_checkpoint, directory, *snapshot,
+                    keep)
             else:
-                self._write_flat_checkpoint(directory, *snapshot)
+                self._write_flat_checkpoint(directory, *snapshot, keep)
         self._barrier("zoo_ckpt_save")
 
     def load_checkpoint(self, directory: str):
@@ -1287,6 +1507,26 @@ class SPMDTrainer:
         self.wait_for_checkpoint()
         # writer (process 0) must have finished before anyone reads
         self._barrier("zoo_ckpt_load")
+        candidates = self._store_candidates(directory)
+        if candidates:
+            skipped = []
+            for name, manifest in candidates:
+                try:
+                    self._load_flat_from(directory, name, manifest)
+                except (ChecksumError, OSError, ValueError) as e:
+                    logger.warning("checkpoint %s unusable (%s); falling "
+                                   "back to previous", name, e)
+                    skipped.append(name)
+                    continue
+                if skipped:
+                    logger.warning("restored %s after skipping corrupt "
+                                   "checkpoint(s): %s", name,
+                                   ", ".join(skipped))
+                return
+            raise ChecksumError(
+                f"all {len(candidates)} checkpoint(s) in {directory} "
+                f"failed validation: {', '.join(n for n, _ in candidates)}")
+        # legacy layouts (pre-v2): sharded tag+commit, then flat-in-root
         if self._sharded_available(directory) and \
                 not file_io.exists(os.path.join(directory, "model.npz")):
             self._load_checkpoint_sharded(directory)
@@ -1299,9 +1539,30 @@ class SPMDTrainer:
             self.opt_state = self._place_opt_state(
                 serialization.load_leaves(opt_path, template))
         meta = serialization.load_pytree(os.path.join(directory, "meta.npz"))
-        self.step = int(meta["step"])
-        self.epoch = int(meta["epoch"])
-        # a warm resume jumps self.step far past the cursor; without this
-        # the first step after load fires an immediate summary/log burst
-        # (ADVICE r3 #4)
-        self._last_log_step = self.step
+        self._restore_position(meta)
+
+    def _load_flat_from(self, directory: str, name: str,
+                        manifest: Dict) -> None:
+        """Restore from one v2 checkpoint dir, verifying every file's
+        bytes against the manifest checksums BEFORE touching trainer
+        state — a corrupt file must not leave a half-restored trainer."""
+        base = os.path.join(directory, name)
+        blobs = {}
+        for fname, info in manifest["files"].items():
+            data = file_io.read_bytes(os.path.join(base, fname))
+            if len(data) != int(info["size"]) \
+                    or crc32c(data) != int(info["crc32c"]):
+                raise ChecksumError(
+                    f"{name}/{fname}: crc32c/size mismatch "
+                    f"(expected {info['crc32c']}/{info['size']}, got "
+                    f"{crc32c(data)}/{len(data)})")
+            blobs[fname] = data
+        blob = serialization.pytree_from_bytes(
+            blobs["model.npz"], blobs["model.npz.treedef"])
+        meta = serialization.pytree_from_bytes(
+            blobs["meta.npz"], blobs["meta.npz.treedef"])
+        self.set_params(blob["params"], blob.get("state") or {})
+        template = self.tx.init(self.params)
+        self.opt_state = self._place_opt_state(
+            serialization.leaves_from_bytes(blobs["optim.npz"], template))
+        self._restore_position(meta)

@@ -227,8 +227,16 @@ class Estimator(AbstractEstimator):
         if set(got) == set(expected):
             return
         if len(got) != len(expected):
-            raise ValueError("checkpoint/model param-group count mismatch: "
-                             f"{len(got)} vs {len(expected)}")
+            def shapes(groups):
+                return {name: [tuple(getattr(l, "shape", ()))
+                               for l in jax.tree_util.tree_leaves(g)]
+                        for name, g in groups.items()}
+            raise ValueError(
+                "checkpoint/model param-group count mismatch: checkpoint "
+                f"has {len(got)} group(s) {shapes(got)}, model expects "
+                f"{len(expected)} group(s) {shapes(expected)}; only in "
+                f"checkpoint: {sorted(set(got) - set(expected))}, only in "
+                f"model: {sorted(set(expected) - set(got))}")
         remapped = {new: got[old]
                     for new, old in zip(expected, got)}
         state = trainer.net_state or {}

@@ -296,10 +296,20 @@ def _print_fleet(workdir: str) -> bool:
     from .fleet import fleet_status
 
     rows = fleet_status(workdir)
+    now = time.time()
     for r in rows:
-        state = "up" if r["alive"] else "DOWN"
+        if r.get("crash_looped"):
+            state = "CRASH-LOOP"
+        elif not r["alive"] and r.get("backoff_until", 0) > now:
+            state = f"backoff({r['backoff_until'] - now:.1f}s)"
+        elif r["alive"]:
+            state = "up"
+        else:
+            state = "DOWN"
+        age = (f"{r['health_age_s']:.1f}s"
+               if r.get("health_age_s") is not None else "-")
         print(f"  worker {r['worker_id']}: pid={r['pid']} {state:4s} "
-              f"health_age={r['health_age_s']:.1f}s "
+              f"health_age={age} "
               f"served={r['records_served']} shed={r['shed']} "
               f"restarts={r['restarts']}")
     return bool(rows)

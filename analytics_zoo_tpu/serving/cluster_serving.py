@@ -186,6 +186,12 @@ class ClusterServingHelper:
         self.workers = int(params.get("workers") or 1)
         self.health_interval = float(params.get("health_interval") or 1.0)
         self.health_timeout = float(params.get("health_timeout") or 10.0)
+        # fleet crash-loop protection (docs/fault-tolerance.md): cap on
+        # consecutive restarts per worker, and the initial backoff the
+        # supervise loop doubles per restart
+        self.max_restarts = int(params.get("max_restarts") or 10)
+        self.restart_backoff_s = float(
+            params.get("restart_backoff_s") or 0.5)
         # -- model registry (docs/model-registry.md) --------------------
         reg = config.get("registry") or {}
         self.registry_root = reg.get("root")

@@ -43,10 +43,26 @@ from ..utils import file_io
 logger = logging.getLogger("analytics_zoo_tpu.serving.fleet")
 
 HEALTH_DIR = "health"
+SUPERVISOR_FILE = "supervisor.json"
+BACKOFF_CAP_S = 30.0
 
 
 def health_path(workdir: str, worker_id: int) -> str:
     return os.path.join(workdir, HEALTH_DIR, f"worker-{worker_id}.json")
+
+
+def supervisor_path(workdir: str) -> str:
+    return os.path.join(workdir, HEALTH_DIR, SUPERVISOR_FILE)
+
+
+def read_supervisor_state(workdir: str) -> Dict[str, dict]:
+    """Per-worker restart bookkeeping the supervise loop persists
+    (restarts, backoff_until, crash_looped) — keyed by worker id string."""
+    try:
+        with open(supervisor_path(workdir)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
 
 
 def write_health(workdir: str, worker_id: int, payload: dict):
@@ -75,7 +91,9 @@ def fleet_status(workdir: str) -> List[dict]:
                        if n.startswith("worker-") and n.endswith(".json"))
     except FileNotFoundError:
         return rows
+    sup = read_supervisor_state(workdir)
     now = time.time()
+    seen = set()
     for name in names:
         try:
             with open(os.path.join(hdir, name)) as f:
@@ -90,15 +108,33 @@ def fleet_status(workdir: str) -> List[dict]:
                 alive = True
             except (OSError, ValueError):
                 alive = False
+        wid = h.get("worker_id")
+        seen.add(str(wid))
+        s = sup.get(str(wid), {})
         rows.append({
-            "worker_id": h.get("worker_id"),
+            "worker_id": wid,
             "pid": pid,
             "alive": alive,
             "health_age_s": round(now - h.get("ts", 0.0), 2),
             "records_served": h.get("records_served", 0),
             "shed": h.get("shed", 0),
-            "restarts": h.get("restarts", 0),
+            "restarts": s.get("restarts", h.get("restarts", 0)),
+            "backoff_until": s.get("backoff_until", 0.0),
+            "crash_looped": s.get("crash_looped", False),
         })
+    # workers the supervisor is tracking that never (re)wrote a
+    # heartbeat — dead in backoff, or crash-looped before first beat
+    for wid, s in sorted(sup.items(), key=lambda kv: kv[0]):
+        if wid in seen:
+            continue
+        rows.append({
+            "worker_id": int(wid), "pid": None, "alive": False,
+            "health_age_s": None, "records_served": 0, "shed": 0,
+            "restarts": s.get("restarts", 0),
+            "backoff_until": s.get("backoff_until", 0.0),
+            "crash_looped": s.get("crash_looped", False),
+        })
+    rows.sort(key=lambda r: (r["worker_id"] is None, r["worker_id"]))
     return rows
 
 
@@ -116,6 +152,9 @@ class ServingFleet:
                  health_interval: Optional[float] = None,
                  health_timeout: Optional[float] = None,
                  grace_s: float = 5.0, startup_grace_s: float = 60.0,
+                 max_restarts: Optional[int] = None,
+                 restart_backoff_s: Optional[float] = None,
+                 healthy_reset_s: float = 60.0,
                  stream=None, env: Optional[Dict[str, str]] = None,
                  python: Optional[str] = None):
         from .cluster_serving import ClusterServingHelper
@@ -135,6 +174,17 @@ class ServingFleet:
             else helper.health_timeout)
         self.grace_s = float(grace_s)
         self.startup_grace_s = float(startup_grace_s)
+        # crash-loop protection: give up on a worker after max_restarts
+        # consecutive restarts (counter resets after healthy_reset_s of
+        # uptime); each restart waits restart_backoff_s * 2^(n-1), capped
+        # at BACKOFF_CAP_S, so a fast-dying worker cannot spin the host
+        self.max_restarts = int(
+            max_restarts if max_restarts is not None
+            else helper.max_restarts)
+        self.restart_backoff_s = float(
+            restart_backoff_s if restart_backoff_s is not None
+            else helper.restart_backoff_s)
+        self.healthy_reset_s = float(healthy_reset_s)
         self.stream = stream if stream is not None else sys.stdout
         self.env = dict(env or {})
         self.python = python or sys.executable
@@ -142,6 +192,8 @@ class ServingFleet:
         self._procs: Dict[int, SupervisedProc] = {}
         self._spawned_at: Dict[int, float] = {}
         self.restarts: Dict[int, int] = {}
+        self.backoff_until: Dict[int, float] = {}
+        self.crash_looped: set = set()
         self._stop = threading.Event()
         os.makedirs(os.path.join(self.workdir, HEALTH_DIR), exist_ok=True)
 
@@ -179,11 +231,34 @@ class ServingFleet:
             self._spawn(wid)
         return self
 
+    def _write_supervisor_state(self):
+        state = {}
+        for wid in set(self.restarts) | set(self.backoff_until) | \
+                self.crash_looped:
+            state[str(wid)] = {
+                "restarts": self.restarts.get(wid, 0),
+                "backoff_until": self.backoff_until.get(wid, 0.0),
+                "crash_looped": wid in self.crash_looped,
+            }
+        file_io.write_bytes_atomic(supervisor_path(self.workdir),
+                                   json.dumps(state).encode())
+
     def poll_once(self) -> List[int]:
         """One supervision pass: restart workers whose process exited or
-        whose heartbeat is stale.  Returns the worker ids restarted."""
+        whose heartbeat is stale — with per-worker exponential backoff
+        and a crash-loop cap.  Returns the worker ids respawned."""
         restarted = []
         now = time.time()
+        # phase 2 of a restart: respawn workers whose backoff elapsed
+        for wid, until in list(self.backoff_until.items()):
+            if self._stop.is_set() or wid in self._procs:
+                continue
+            if now >= until:
+                del self.backoff_until[wid]
+                self._spawn(wid)
+                restarted.append(wid)
+        if restarted:
+            self._write_supervisor_state()
         for wid, sp in list(self._procs.items()):
             rc = sp.proc.poll()
             stale = False
@@ -199,16 +274,32 @@ class ServingFleet:
                 continue
             reason = (f"exited rc={rc}" if rc is not None
                       else "heartbeat stale")
+            if now - self._spawned_at.get(wid, now) >= self.healthy_reset_s:
+                # a long-healthy worker dying is not a crash loop
+                self.restarts[wid] = 0
             self.restarts[wid] = self.restarts.get(wid, 0) + 1
+            if rc is None:
+                terminate_all([sp.proc], self.grace_s)
+            del self._procs[wid]
+            if self.restarts[wid] > self.max_restarts:
+                self.crash_looped.add(wid)
+                with self._lock:
+                    self.stream.write(
+                        f"[fleet] worker-{wid} {reason}; crash loop "
+                        f"(> {self.max_restarts} restarts), giving up\n")
+                    self.stream.flush()
+                self._write_supervisor_state()
+                continue
+            delay = min(BACKOFF_CAP_S,
+                        self.restart_backoff_s *
+                        (2 ** (self.restarts[wid] - 1)))
+            self.backoff_until[wid] = now + delay
             with self._lock:
                 self.stream.write(
                     f"[fleet] worker-{wid} {reason}; restarting "
-                    f"(restart #{self.restarts[wid]})\n")
+                    f"(restart #{self.restarts[wid]}) in {delay:.1f}s\n")
                 self.stream.flush()
-            if rc is None:
-                terminate_all([sp.proc], self.grace_s)
-            self._spawn(wid)
-            restarted.append(wid)
+            self._write_supervisor_state()
         return restarted
 
     def supervise(self, poll_s: float = 0.25):

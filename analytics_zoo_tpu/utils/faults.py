@@ -1,0 +1,184 @@
+"""Fault injection for the chaos harness.
+
+Faults are armed from the environment so the *production* code path is
+exercised unmodified — the hooks below are permanent, tiny, and inert
+unless ``ZOO_TPU_FAULT`` is set:
+
+    ZOO_TPU_FAULT=<site>:<action>@<arg>[;<site>:<action>@<arg>...]
+
+Sites and the specs they accept:
+
+``step:kill@N``
+    SIGKILL this process the first time the training step counter
+    reaches ``N`` (fires at-or-after ``N`` so multi-step dispatch
+    cannot jump over it). One-shot.
+``ckpt-write:kill@K`` / ``ckpt-write:raise@K``
+    During the ``K``-th checkpoint save of this job, crash *mid-file*:
+    a truncated prefix of the second file is written, then the process
+    is SIGKILLed (``kill``) or an :class:`FaultInjected` OSError is
+    raised (``raise``). Proves partial writes are never visible to
+    restore. One-shot.
+``file-io:transient@N``
+    The first ``N`` ``file_io`` byte reads/writes raise
+    :class:`TransientFault` (an ``OSError``), exercising the bounded
+    retry in :mod:`utils.file_io`.
+
+One-shot faults must not re-fire after a gang restart (the relaunched
+worker reaches step ``N`` again and would die forever). Point
+``ZOO_TPU_FAULT_STATE`` at a directory shared across restarts: a fault
+that fires drops a marker file there and later processes skip it.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+ENV_SPEC = "ZOO_TPU_FAULT"
+ENV_STATE = "ZOO_TPU_FAULT_STATE"
+
+
+class FaultInjected(OSError):
+    """Raised by an armed ``raise``-action fault (deliberate failure)."""
+
+
+class TransientFault(OSError):
+    """A retryable injected IO error (``file-io:transient@N``)."""
+
+
+@dataclass
+class _Spec:
+    site: str
+    action: str
+    arg: int
+    raw: str
+    fired: bool = False
+    io_count: int = 0
+    save_index: int = 0
+    writes_in_save: int = 0
+
+
+_LOCK = threading.Lock()
+_CACHE: Dict[str, object] = {"env": None, "specs": []}
+
+
+def _parse(env: str) -> List[_Spec]:
+    specs = []
+    for part in env.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            site, rest = part.split(":", 1)
+            action, arg = rest.split("@", 1)
+            specs.append(_Spec(site=site.strip(), action=action.strip(),
+                               arg=int(arg), raw=part))
+        except ValueError:
+            raise ValueError(
+                f"bad {ENV_SPEC} spec {part!r}: expected "
+                "<site>:<action>@<int> (e.g. step:kill@5)")
+    return specs
+
+
+def _specs() -> List[_Spec]:
+    env = os.environ.get(ENV_SPEC, "")
+    with _LOCK:
+        if env != _CACHE["env"]:
+            _CACHE["env"] = env
+            _CACHE["specs"] = _parse(env) if env else []
+        return list(_CACHE["specs"])  # type: ignore[arg-type]
+
+
+def reset() -> None:
+    """Drop parsed-spec state (tests re-arm via monkeypatched env)."""
+    with _LOCK:
+        _CACHE["env"] = None
+        _CACHE["specs"] = []
+
+
+def _marker_path(spec: _Spec) -> Optional[str]:
+    state = os.environ.get(ENV_STATE)
+    if not state:
+        return None
+    safe = spec.raw.replace(":", "_").replace("@", "_").replace("/", "_")
+    return os.path.join(state, f"fired.{safe}")
+
+
+def _already_fired(spec: _Spec) -> bool:
+    if spec.fired:
+        return True
+    marker = _marker_path(spec)
+    return marker is not None and os.path.exists(marker)
+
+
+def _record_fired(spec: _Spec) -> None:
+    spec.fired = True
+    marker = _marker_path(spec)
+    if marker is not None:
+        os.makedirs(os.path.dirname(marker), exist_ok=True)
+        with open(marker, "w") as f:
+            f.write("1")
+
+
+def _die(spec: _Spec, detail: str) -> None:
+    # SIGKILL: no handlers, no atexit, no flush — the honest crash.
+    sys.stderr.write(f"[faults] firing {spec.raw}: {detail}\n")
+    sys.stderr.flush()
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def check(site: str, step: Optional[int] = None) -> None:
+    """Hook for point sites (``step``, ``file-io``). Cheap when unarmed."""
+    for spec in _specs():
+        if spec.site != site:
+            continue
+        if site == "step":
+            if step is not None and step >= spec.arg \
+                    and not _already_fired(spec):
+                _record_fired(spec)
+                if spec.action == "kill":
+                    _die(spec, f"step {step} >= {spec.arg}")
+                raise FaultInjected(f"injected failure at step {step} "
+                                    f"({spec.raw})")
+        elif site == "file-io":
+            if spec.action == "transient":
+                with _LOCK:
+                    spec.io_count += 1
+                    n = spec.io_count
+                if n <= spec.arg:
+                    raise TransientFault(
+                        f"injected transient IO error {n}/{spec.arg} "
+                        f"({spec.raw})")
+
+
+def begin_save() -> None:
+    """Mark the start of a checkpoint save (counts ``ckpt-write`` args)."""
+    for spec in _specs():
+        if spec.site == "ckpt-write":
+            spec.save_index += 1
+            spec.writes_in_save = 0
+
+
+def checked_write(path: str, data: bytes,
+                  writer: Callable[[str, bytes], None]) -> None:
+    """Write one checkpoint file, honouring an armed ``ckpt-write`` fault:
+    on fire, a truncated prefix is written in place of the file, then the
+    process dies (``kill``) or :class:`FaultInjected` is raised."""
+    for spec in _specs():
+        if spec.site != "ckpt-write" or _already_fired(spec):
+            continue
+        spec.writes_in_save += 1
+        # crash on the 2nd file of the target save: mid-checkpoint, with
+        # at least one complete-looking file already on disk
+        if spec.save_index == spec.arg and spec.writes_in_save == 2:
+            _record_fired(spec)
+            writer(path, data[: max(1, len(data) // 2)])
+            if spec.action == "kill":
+                _die(spec, f"mid-write of {path}")
+            raise FaultInjected(
+                f"injected crash mid-write of {path} ({spec.raw})")
+    writer(path, data)

@@ -15,10 +15,64 @@ from __future__ import annotations
 
 import glob as _glob
 import os
+import random
+import time
 import uuid
 from typing import Callable, Dict, List, Tuple
 
+from . import faults
+
 _SCHEMES: Dict[str, "FileSystem"] = {}
+
+
+class FileIORetryExhausted(OSError):
+    """A transient-looking IO error persisted through every retry attempt.
+
+    Carries the terminal cause as ``__cause__``; ``attempts`` records how
+    many tries were made."""
+
+    def __init__(self, msg: str, attempts: int):
+        super().__init__(msg)
+        self.attempts = attempts
+
+
+# Errors that retrying cannot fix: wrong path, wrong permissions, wrong
+# kind of node. Everything else OSError-shaped (remote-scheme timeouts,
+# connection resets, injected TransientFault) is considered transient.
+_PERMANENT_ERRORS = (FileNotFoundError, PermissionError, IsADirectoryError,
+                     NotADirectoryError, FileExistsError)
+
+
+def _retry_attempts() -> int:
+    return max(1, int(os.environ.get("ZOO_TPU_FILE_RETRIES", "4")))
+
+
+def _retry_backoff_s() -> float:
+    return float(os.environ.get("ZOO_TPU_FILE_RETRY_BACKOFF_S", "0.05"))
+
+
+def _with_retries(op: Callable[[], "object"], what: str):
+    """Run ``op`` with bounded retries + jittered exponential backoff on
+    transient IO errors (remote schemes hiccup; local disks mostly don't,
+    but the policy is uniform). Permanent errors propagate immediately;
+    persistent transients surface as :class:`FileIORetryExhausted`."""
+    attempts = _retry_attempts()
+    base = _retry_backoff_s()
+    last: Exception = None  # type: ignore[assignment]
+    for attempt in range(1, attempts + 1):
+        try:
+            return op()
+        except _PERMANENT_ERRORS:
+            raise
+        except OSError as exc:
+            last = exc
+            if attempt == attempts:
+                break
+            delay = min(2.0, base * (2 ** (attempt - 1)))
+            time.sleep(delay * random.uniform(0.5, 1.0))
+    raise FileIORetryExhausted(
+        f"{what} still failing after {attempts} attempt(s): {last}",
+        attempts) from last
 
 
 class FileSystem:
@@ -48,6 +102,12 @@ class FileSystem:
     def size(self, path: str) -> int:
         raise NotImplementedError
 
+    def remove_tree(self, path: str):
+        """Remove a directory and its contents (one level by default —
+        deep stores override)."""
+        for name in self.listdir(path):
+            self.remove(path.rstrip("/") + "/" + name)
+
 
 class LocalFileSystem(FileSystem):
     def open(self, path: str, mode: str = "rb"):
@@ -76,6 +136,10 @@ class LocalFileSystem(FileSystem):
 
     def size(self, path: str) -> int:
         return os.path.getsize(path)
+
+    def remove_tree(self, path: str):
+        import shutil
+        shutil.rmtree(path)
 
 
 def register_filesystem(scheme: str, fs: FileSystem):
@@ -146,6 +210,12 @@ def listdir(uri: str) -> List[str]:
     return fs.listdir(path)
 
 
+def remove_tree(uri: str):
+    """Remove a directory subtree (checkpoint retention pruning)."""
+    fs, path = get_filesystem(uri)
+    fs.remove_tree(path)
+
+
 def file_size(uri: str) -> int:
     """Size in bytes (shard-balance hint for dataset ingestion)."""
     fs, path = get_filesystem(uri)
@@ -153,24 +223,45 @@ def file_size(uri: str) -> int:
 
 
 def read_bytes(uri: str) -> bytes:
-    with open_file(uri, "rb") as f:
-        return f.read()
+    def _op() -> bytes:
+        faults.check("file-io")
+        with open_file(uri, "rb") as f:
+            return f.read()
+
+    return _with_retries(_op, f"read {uri}")
 
 
 def write_bytes(uri: str, data: bytes):
-    with open_file(uri, "wb") as f:
-        f.write(data)
+    def _op():
+        faults.check("file-io")
+        with open_file(uri, "wb") as f:
+            f.write(data)
+
+    _with_retries(_op, f"write {uri}")
 
 
 def write_bytes_atomic(uri: str, data: bytes):
     """Write to a same-directory temp file, then rename into place —
     readers never observe a partial file (the serving model-registry
-    manifest and stats snapshots depend on this)."""
+    manifest, checkpoint manifests, and the ``latest`` pointer depend
+    on this)."""
     fs, path = get_filesystem(uri)
-    tmp = f"{path}.tmp-{uuid.uuid4().hex[:8]}"
-    with fs.open(tmp, "wb") as f:
-        f.write(data)
-    fs.rename(tmp, path)
+
+    def _op():
+        faults.check("file-io")
+        tmp = f"{path}.tmp-{uuid.uuid4().hex[:8]}"
+        try:
+            with fs.open(tmp, "wb") as f:
+                f.write(data)
+            fs.rename(tmp, path)
+        except OSError:
+            try:
+                fs.remove(tmp)
+            except OSError:
+                pass
+            raise
+
+    _with_retries(_op, f"atomic write {uri}")
 
 
 register_filesystem("file", LocalFileSystem())

@@ -50,18 +50,31 @@ def _path_str(entry):
     return str(entry)
 
 
+def pytree_bytes(tree) -> Tuple[bytes, bytes]:
+    """Serialize to ``(npz_bytes, treedef_bytes)`` without touching disk —
+    callers that need checksums or atomic multi-file commits (the engine's
+    checkpoint store) compose these with their own write protocol."""
+    flat = _flatten(tree)
+    buf = io.BytesIO()
+    np.savez(buf, **{f"arr::{k}": v for k, v in flat.items()})
+    return buf.getvalue(), _treedef_repr(None, tree).encode()
+
+
+def pytree_from_bytes(data: bytes, treedef: bytes):
+    with np.load(io.BytesIO(data), allow_pickle=False) as npz:
+        flat = {k[len("arr::"):]: npz[k] for k in npz.files}
+    skel = json.loads(treedef.decode())
+    return _unflatten(skel, flat, prefix=[])
+
+
 def save_pytree(path: str, tree) -> None:
     from . import file_io
 
-    flat = _flatten(tree)
-    treedef = jax.tree_util.tree_structure(tree)
-    buf = io.BytesIO()
-    np.savez(buf, **{f"arr::{k}": v for k, v in flat.items()})
+    data, treedef = pytree_bytes(tree)
     # file_io routing: checkpoints work on any registered scheme
     # (hdfs://, gs:// via utils.arrow_fs); write-mode open creates parents
-    file_io.write_bytes(path, buf.getvalue())
-    file_io.write_bytes(path + ".treedef",
-                        _treedef_repr(treedef, tree).encode())
+    file_io.write_bytes(path, data)
+    file_io.write_bytes(path + ".treedef", treedef)
 
 
 def _treedef_repr(treedef, tree) -> str:
@@ -80,11 +93,8 @@ def _treedef_repr(treedef, tree) -> str:
 def load_pytree(path: str):
     from . import file_io
 
-    with np.load(io.BytesIO(file_io.read_bytes(path)),
-                 allow_pickle=False) as data:
-        flat = {k[len("arr::"):]: data[k] for k in data.files}
-    skel = json.loads(file_io.read_bytes(path + ".treedef").decode())
-    return _unflatten(skel, flat, prefix=[])
+    return pytree_from_bytes(file_io.read_bytes(path),
+                             file_io.read_bytes(path + ".treedef"))
 
 
 def _unflatten(skel, flat, prefix):
@@ -105,25 +115,17 @@ def tree_to_numpy(tree):
     return jax.tree.map(lambda x: np.asarray(x), tree)
 
 
-def save_leaves(path: str, tree) -> None:
-    """Save a pytree by leaf order only (for structures with custom nodes,
-    e.g. optax states); restore with :func:`load_leaves` and a template."""
-    from . import file_io
-
+def leaves_bytes(tree) -> bytes:
     leaves = jax.tree_util.tree_leaves(tree)
     buf = io.BytesIO()
     np.savez(buf, **{f"leaf{i}": _to_host_array(l)
                      for i, l in enumerate(leaves)})
-    file_io.write_bytes(path if path.endswith(".npz") else path + ".npz",
-                        buf.getvalue())
+    return buf.getvalue()
 
 
-def load_leaves(path: str, template):
-    from . import file_io
-
-    with np.load(io.BytesIO(file_io.read_bytes(path)),
-                 allow_pickle=False) as data:
-        leaves = [data[f"leaf{i}"] for i in range(len(data.files))]
+def leaves_from_bytes(data: bytes, template):
+    with np.load(io.BytesIO(data), allow_pickle=False) as npz:
+        leaves = [npz[f"leaf{i}"] for i in range(len(npz.files))]
     treedef = jax.tree_util.tree_structure(template)
     t_leaves = jax.tree_util.tree_leaves(template)
     if len(t_leaves) != len(leaves):
@@ -134,3 +136,18 @@ def load_leaves(path: str, template):
     leaves = [np.asarray(l, dtype=np.asarray(t).dtype)
               for l, t in zip(leaves, t_leaves)]
     return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def save_leaves(path: str, tree) -> None:
+    """Save a pytree by leaf order only (for structures with custom nodes,
+    e.g. optax states); restore with :func:`load_leaves` and a template."""
+    from . import file_io
+
+    file_io.write_bytes(path if path.endswith(".npz") else path + ".npz",
+                        leaves_bytes(tree))
+
+
+def load_leaves(path: str, template):
+    from . import file_io
+
+    return leaves_from_bytes(file_io.read_bytes(path), template)

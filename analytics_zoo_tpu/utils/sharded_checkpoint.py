@@ -39,9 +39,15 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
-from . import file_io
+from . import faults, file_io
+from .crc32c import crc32c
 
 MANIFEST_SUFFIX = ".manifest.json"
+CHECKSUM_SUFFIX = ".crc32c"
+
+
+class ChecksumError(RuntimeError):
+    """A checkpoint file's bytes do not match its recorded crc32c/size."""
 
 
 def _join(directory: str, fname: str) -> str:
@@ -133,13 +139,15 @@ def gc_stale(directory: str, names: Sequence[str],
                     or (keep_tag is None and
                         f.startswith(f"{name}.shard")))
     for fname in entries:
+        base = fname[:-len(CHECKSUM_SUFFIX)] \
+            if fname.endswith(CHECKSUM_SUFFIX) else fname
         stale_shard = any(
-            fname.startswith(f"{name}.") and ".shard" in fname and
-            fname.endswith(".npz") for name in names)
+            base.startswith(f"{name}.") and ".shard" in base and
+            base.endswith(".npz") for name in names)
         stale_manifest = any(
-            fname.startswith(f"{name}.") and
-            fname.endswith(MANIFEST_SUFFIX) for name in names)
-        if (stale_shard or stale_manifest) and fname not in keep:
+            base.startswith(f"{name}.") and
+            base.endswith(MANIFEST_SUFFIX) for name in names)
+        if (stale_shard or stale_manifest) and base not in keep:
             try:
                 file_io.remove(_join(directory, fname))
             except OSError:
@@ -165,12 +173,19 @@ def save_shards(directory: str, name: str, leaves: Sequence[Any],
     buf = io.BytesIO()
     np.savez(buf, __meta__=np.frombuffer(
         json.dumps(meta).encode(), dtype=np.uint8), **arrays)
+    data = buf.getvalue()
     fname = _shard_fname(name, tag, proc)
     tmp = _join(directory, fname + ".tmp")
     file_io.makedirs(directory)
-    with file_io.open_file(tmp, "wb") as f:
-        f.write(buf.getvalue())
+    faults.checked_write(tmp, data, file_io.write_bytes)
     file_io.rename(tmp, _join(directory, fname))
+    # per-shard checksum sidecar: each writer records its own file's
+    # crc32c so process 0's manifest can embed checksums for ALL shard
+    # files (it never sees the other processes' bytes) — the barrier
+    # before write_manifest guarantees sidecars exist by then
+    file_io.write_bytes_atomic(
+        _join(directory, fname + CHECKSUM_SUFFIX),
+        json.dumps({"crc32c": crc32c(data), "size": len(data)}).encode())
 
 
 def write_manifest(directory: str, name: str, leaves: Sequence[Any],
@@ -185,6 +200,13 @@ def write_manifest(directory: str, name: str, leaves: Sequence[Any],
     n_files = n_shard_files if n_shard_files is not None \
         else jax.process_count()
     shard_files = [_shard_fname(name, tag, p) for p in range(n_files)]
+    checksums = {}
+    for fname in shard_files:
+        sidecar = _join(directory, fname + CHECKSUM_SUFFIX)
+        try:
+            checksums[fname] = json.loads(file_io.read_bytes(sidecar))
+        except (OSError, ValueError):
+            pass  # pre-checksum writer or lost sidecar: loads unvalidated
     manifest = {
         "n_leaves": len(leaves),
         "leaves": [{"shape": list(np.shape(leaf)),
@@ -192,6 +214,7 @@ def write_manifest(directory: str, name: str, leaves: Sequence[Any],
                         getattr(leaf, "dtype", np.float32)).name}
                    for leaf in leaves],
         "shard_files": shard_files,
+        "checksums": checksums,
     }
     fname = _manifest_name(name, tag)
     tmp = _join(directory, fname + ".tmp")
@@ -204,6 +227,19 @@ def exists(directory: str, name: str, tag: Optional[str] = None) -> bool:
     return file_io.exists(_join(directory, _manifest_name(name, tag)))
 
 
+def _validate_bytes(uri: str, data: bytes,
+                    expected: Optional[Dict[str, Any]]) -> None:
+    if expected is None:
+        return
+    if len(data) != int(expected.get("size", len(data))) \
+            or crc32c(data) != int(expected["crc32c"]):
+        raise ChecksumError(
+            f"checksum mismatch for {uri}: file is corrupt "
+            f"(expected crc32c={expected['crc32c']} "
+            f"size={expected.get('size')}, got crc32c={crc32c(data)} "
+            f"size={len(data)})")
+
+
 class _PieceCatalog:
     """Lazy view over all shard files: which saved regions cover each leaf,
     reading piece data on demand (NpzFile reads members lazily)."""
@@ -211,29 +247,48 @@ class _PieceCatalog:
     def __init__(self, directory: str, manifest: Dict[str, Any]):
         self.manifest = manifest
         self.by_leaf: Dict[int, List[Tuple[List[Tuple[int, int]],
-                                           Any, str]]] = {}
+                                           Dict[str, Any], str]]] = {}
         self._files = []
+        checksums = manifest.get("checksums", {})
         for fname in manifest["shard_files"]:
             uri = _join(directory, fname)
             if not file_io.exists(uri):
                 raise FileNotFoundError(
                     f"sharded checkpoint incomplete: missing {uri}")
             scheme, local = file_io.split_scheme(uri)
+            expected = checksums.get(fname)
             if scheme == "file":
                 # NpzFile reads zip members lazily: each process touches
                 # only the bytes of the pieces overlapping ITS regions,
-                # not the whole checkpoint
+                # not the whole checkpoint — checksum validation is
+                # deferred to the first piece actually read from the file
                 npz = np.load(local, allow_pickle=False)
+                validated = expected is None
             else:
-                # non-seekable remote streams: buffer through memory
-                with file_io.open_file(uri, "rb") as f:
-                    npz = np.load(io.BytesIO(f.read()), allow_pickle=False)
-            self._files.append(npz)
+                # non-seekable remote streams: buffer through memory —
+                # the bytes are in hand, so validate eagerly
+                raw = file_io.read_bytes(uri)
+                _validate_bytes(uri, raw, expected)
+                npz = np.load(io.BytesIO(raw), allow_pickle=False)
+                validated = True
+            entry = {"npz": npz, "uri": uri, "expected": expected,
+                     "validated": validated}
+            self._files.append(entry)
             meta = json.loads(bytes(npz["__meta__"]).decode())
             for key, info in meta.items():
                 self.by_leaf.setdefault(info["leaf"], []).append(
                     ([(int(a), int(b)) for a, b in info["region"]],
-                     npz, key))
+                     entry, key))
+
+    @staticmethod
+    def _checked(entry: Dict[str, Any]):
+        """First touch of a lazily-opened shard file: verify its bytes
+        against the manifest checksum before trusting any member."""
+        if not entry["validated"]:
+            _validate_bytes(entry["uri"], file_io.read_bytes(entry["uri"]),
+                            entry["expected"])
+            entry["validated"] = True
+        return entry["npz"]
 
     def read_region(self, leaf_i: int, index, shape, dtype) -> np.ndarray:
         """Assemble the requested region of leaf ``leaf_i`` from whatever
@@ -242,12 +297,12 @@ class _PieceCatalog:
         out_shape = [stop - start for start, stop in region]
         out = np.empty(out_shape, dtype)
         covered = 0
-        for piece_region, npz, key in self.by_leaf.get(leaf_i, ()):
+        for piece_region, entry, key in self.by_leaf.get(leaf_i, ()):
             inter = [(max(a0, b0), min(a1, b1)) for (a0, a1), (b0, b1)
                      in zip(region, piece_region)]
             if any(start >= stop for start, stop in inter):
                 continue
-            data = npz[key]
+            data = self._checked(entry)[key]
             src = tuple(slice(start - p0, stop - p0) for (start, stop),
                         (p0, _) in zip(inter, piece_region))
             dst = tuple(slice(start - r0, stop - r0) for (start, stop),
@@ -258,7 +313,8 @@ class _PieceCatalog:
             pieces = self.by_leaf.get(leaf_i, ())
             if not pieces:
                 raise ValueError(f"leaf {leaf_i}: no saved pieces")
-            return np.asarray(pieces[0][1][pieces[0][2]], dtype)
+            return np.asarray(self._checked(pieces[0][1])[pieces[0][2]],
+                              dtype)
         if covered != int(np.prod(out_shape)):
             raise ValueError(
                 f"leaf {leaf_i}: saved pieces cover {covered} of "

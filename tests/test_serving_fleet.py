@@ -180,3 +180,133 @@ def test_fleet_smoke_end_to_end():
     assert "FLEET_SMOKE_OK workers=2 records=64" in proc.stdout
     assert "restarted=worker-1" in proc.stdout
     assert "shed_code=shed_" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# restart caps, backoff, crash-loop state (docs/fault-tolerance.md)
+# ---------------------------------------------------------------------------
+
+_FLEET_CFG = """\
+model:
+  stub_ms_per_batch: 1
+
+data:
+  src: file:{d}
+  image_shape: 3, 4, 4
+
+params:
+  workers: 1
+"""
+
+
+class _FakeProc:
+    def __init__(self, rc):
+        self.returncode = rc
+        self.pid = 4242
+
+    def poll(self):
+        return self.returncode
+
+
+class _FakeSP:
+    def __init__(self, rc):
+        self.proc = _FakeProc(rc)
+        self.pump = None
+
+
+def _mini_fleet(tmp_path, **kw):
+    from analytics_zoo_tpu.serving.fleet import ServingFleet
+
+    cfg = tmp_path / "config.yaml"
+    cfg.write_text(_FLEET_CFG.format(d=tmp_path / "stream"))
+    fleet = ServingFleet(str(cfg), str(tmp_path), workers=1,
+                         stream=io.StringIO(), **kw)
+    spawns = []
+
+    def fake_spawn(wid):
+        # every (re)spawned worker dies instantly with rc=1
+        spawns.append(wid)
+        fleet._procs[wid] = _FakeSP(rc=1)
+        fleet._spawned_at[wid] = time.time()
+
+    fleet._spawn = fake_spawn
+    return fleet, spawns
+
+
+def test_fleet_restart_backoff_then_crash_loop(tmp_path):
+    from analytics_zoo_tpu.serving.fleet import read_supervisor_state
+
+    fleet, spawns = _mini_fleet(tmp_path, max_restarts=2,
+                                restart_backoff_s=0.05)
+    fleet._spawn(0)
+    # death #1: restart deferred behind the backoff, not immediate
+    assert fleet.poll_once() == []
+    assert fleet.restarts[0] == 1
+    assert 0 in fleet.backoff_until and 0 not in fleet._procs
+    time.sleep(0.06)
+    # backoff elapsed: respawned (then it dies again -> backoff doubles)
+    assert fleet.poll_once() == [0]
+    assert fleet.restarts[0] == 2
+    until = fleet.backoff_until[0]
+    assert until - time.time() > 0.05   # 0.05 * 2^1
+    time.sleep(max(0.0, until - time.time()) + 0.02)
+    # third death exceeds max_restarts=2: crash loop, no more respawns
+    assert fleet.poll_once() == [0]
+    assert 0 in fleet.crash_looped
+    assert fleet.poll_once() == []
+    assert spawns == [0, 0, 0]
+    # persisted for `zoo-serving status` (worker never wrote a heartbeat)
+    state = read_supervisor_state(str(tmp_path))
+    assert state["0"]["crash_looped"] is True
+    assert state["0"]["restarts"] == 3
+    rows = fleet_status(str(tmp_path))
+    row = [r for r in rows if r["worker_id"] == 0][0]
+    assert row["crash_looped"] is True and row["restarts"] == 3
+    assert row["alive"] is False
+
+
+def test_fleet_healthy_uptime_resets_counter(tmp_path):
+    fleet, _ = _mini_fleet(tmp_path, max_restarts=2,
+                           restart_backoff_s=0.01, healthy_reset_s=1.0)
+    fleet._spawn(0)
+    fleet.restarts[0] = 2
+    fleet._spawned_at[0] = time.time() - 5.0   # healthy for 5s > 1s
+    fleet.poll_once()
+    assert fleet.restarts[0] == 1              # reset, then this death
+    assert 0 not in fleet.crash_looped
+
+
+def test_helper_restart_knobs(tmp_path):
+    from analytics_zoo_tpu.serving.cluster_serving import \
+        ClusterServingHelper
+
+    cfg = tmp_path / "config.yaml"
+    cfg.write_text(_FLEET_CFG.format(d=tmp_path / "stream") +
+                   "  max_restarts: 4\n  restart_backoff_s: 2.5\n")
+    h = ClusterServingHelper(config_path=str(cfg))
+    assert h.max_restarts == 4
+    assert h.restart_backoff_s == 2.5
+    cfg2 = tmp_path / "config2.yaml"
+    cfg2.write_text(_FLEET_CFG.format(d=tmp_path / "stream"))
+    h2 = ClusterServingHelper(config_path=str(cfg2))
+    assert h2.max_restarts == 10
+    assert h2.restart_backoff_s == 0.5
+
+
+def test_status_cli_renders_backoff_and_crash_loop(tmp_path, capsys):
+    from analytics_zoo_tpu.serving.cli import cmd_status
+    from analytics_zoo_tpu.serving.fleet import supervisor_path
+    from analytics_zoo_tpu.utils import file_io
+
+    wd = str(tmp_path)
+    write_health(wd, 0, {"pid": 999999999, "records_served": 3, "shed": 0})
+    file_io.write_bytes_atomic(supervisor_path(wd), json.dumps({
+        "0": {"restarts": 2, "backoff_until": time.time() + 9.0,
+              "crash_looped": False},
+        "1": {"restarts": 5, "backoff_until": 0.0, "crash_looped": True},
+    }).encode())
+    rc = cmd_status(wd)
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "worker 0:" in out and "backoff(" in out and "restarts=2" in out
+    assert "worker 1:" in out and "CRASH-LOOP" in out and "restarts=5" in out
