@@ -71,6 +71,13 @@ class ZooConfig:
     # consumption rate (feature.host_pipeline.resolve_transform_workers)
     # instead of bottlenecking the step on one prefetch thread.
     transform_workers: int = -1
+    # infeed transform backend: "thread" | "process" | "auto" (env:
+    # ZOO_TPU_INFEED_BACKEND). "process" ships the Preprocessing chain to
+    # a spawn pool returning batches through shared-memory rings (GIL-free
+    # decode); "auto" picks process only for chains declaring
+    # cpu_bound=True on a multi-core host
+    # (feature.host_pipeline.resolve_infeed_backend).
+    infeed_backend: str = "auto"
     # flash-attention backward remat policy (ops/attention.py
     # _flash_remat_policy): "" = default ("save-lse-recompute-probs" —
     # keep only q/k/v/lse/o and recompute probabilities blockwise in the

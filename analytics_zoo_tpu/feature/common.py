@@ -29,6 +29,13 @@ class Preprocessing:
     ``apply(x)`` (one element). ``__call__`` on an iterator maps lazily.
     """
 
+    #: Declares the chain dominated by GIL-holding Python compute (pure-
+    #: Python loops, PIL decode, ...) rather than GIL-releasing numpy
+    #: kernels. The ``auto`` infeed backend moves such chains out of
+    #: process (host_pipeline.resolve_infeed_backend); numpy-dominated
+    #: chains stay on threads, where the hand-off is cheaper.
+    cpu_bound = False
+
     def apply(self, x):
         raise NotImplementedError(type(self).__name__)
 
@@ -59,6 +66,10 @@ class ChainedPreprocessing(Preprocessing):
                 flat.append(t)
         self.transformers = flat
 
+    @property
+    def cpu_bound(self):  # type: ignore[override]
+        return any(getattr(t, "cpu_bound", False) for t in self.transformers)
+
     def apply(self, x):
         for t in self.transformers:
             x = t.apply(x)
@@ -66,8 +77,9 @@ class ChainedPreprocessing(Preprocessing):
 
 
 class LambdaPreprocessing(Preprocessing):
-    def __init__(self, fn: Callable):
+    def __init__(self, fn: Callable, cpu_bound: bool = False):
         self.fn = fn
+        self.cpu_bound = cpu_bound
 
     def apply(self, x):
         return self.fn(x)

@@ -6,13 +6,27 @@ deliberately slow Preprocessing chain (simulating decode/augment cost that
 releases the GIL, as cv2/BLAS do), streams one epoch through (a) the serial
 in-line path and (b) the full staged pipeline (transform pool + prefetch +
 device staging with identity puts), and checks bit-identical batch content
-and ordering plus a second DRAM-cached epoch.  Exit 0 on success, 1 on any
-mismatch, printing one JSON line of pipeline stats either way.
+and ordering plus a second DRAM-cached epoch.  Three further legs cover the
+process-based infeed (docs/data-pipeline.md):
+
+- ``process``: the same epoch through ``ProcessTransformPool`` (spawned
+  workers + shared-memory rings) on a GIL-holding pure-Python chain,
+  bit-identical to the serial reference;
+- ``direct``: an arena-backed cache with a DRAM budget smaller than the
+  epoch — the spill tail replays from the disk arena with zero
+  re-transforms, and a second *process* (``--arena-reader``) replays the
+  whole epoch from the arena without transforming anything;
+- ``chaos``: ``ZOO_TPU_FAULT=infeed-worker:kill@N`` kills one worker
+  mid-epoch; the pool respawns it and the epoch must still be complete,
+  duplicate-free and bit-identical.
+
+Exit 0 on success, 1 on any mismatch, printing one JSON line of pipeline
+stats either way.
 
 Usage::
 
     python -m analytics_zoo_tpu.feature.data_smoke [--batches 24]
-        [--batch 32] [--transform-ms 4] [--workers 2]
+        [--batch 32] [--transform-ms 4] [--workers 2] [--skip-process]
 """
 
 from __future__ import annotations
@@ -20,8 +34,80 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
+import tempfile
 import time
+
+
+def cpu_bound_transform(batch):
+    """Deterministic, picklable, GIL-*holding* transform: a pure-Python
+    loop standing in for PIL-style decode work. Declared module-level so
+    spawned infeed workers can import it by reference."""
+    from .feature_set import MiniBatch
+
+    acc = 0
+    for i in range(200):
+        acc += i * i
+    scale = 2.0 if acc else 0.0  # the loop is real but the output fixed
+    return MiniBatch(tuple(x * scale for x in batch.inputs),
+                     batch.targets, batch.weights)
+
+
+def _build_base(args):
+    import numpy as np
+
+    from .feature_set import FeatureSet
+
+    n = args.batches * args.batch
+    feats = np.arange(n * 4, dtype=np.float32).reshape(n, 4)
+    labels = np.arange(n, dtype=np.float32)
+    return FeatureSet.array(feats, labels)
+
+
+def _build_direct(args, arena):
+    from .common import LambdaPreprocessing
+
+    base = _build_base(args)
+    tfs = base.transform(
+        LambdaPreprocessing(cpu_bound_transform, cpu_bound=True))
+    # DRAM budget ~25% of the epoch: the tail must spill to the arena
+    epoch_bytes = args.batches * args.batch * 4 * 6
+    tfs.cache(max(1, epoch_bytes // 4), arena_path=arena)
+    return tfs
+
+
+def _batches_equal(ref, got, errors, tag):
+    import numpy as np
+
+    if len(got) != len(ref):
+        errors.append(f"{tag}: batch count {len(got)} != {len(ref)}")
+        return
+    for i, (a, b) in enumerate(zip(ref, got)):
+        for xa, xb in zip(a.inputs, b.inputs):
+            if not np.array_equal(xa, xb):
+                errors.append(f"{tag}: batch {i} inputs differ")
+                return
+        if not np.array_equal(a.targets, b.targets):
+            errors.append(f"{tag}: batch {i} targets differ")
+            return
+
+
+def _arena_reader_main(args) -> int:
+    """Second process of the ``direct`` leg: replay the epoch purely from
+    the shared arena — zero transforms allowed."""
+    tfs = _build_direct(args, args.arena_reader)
+    got = list(tfs.batches(args.batch, shuffle=False))
+    s = tfs.stats().as_dict()
+    errors = []
+    if len(got) != args.batches:
+        errors.append(f"reader: {len(got)} batches != {args.batches}")
+    if s["batches_transformed"] != 0:
+        errors.append(f"reader re-transformed: {s}")
+    if s["arena_hits"] != args.batches:
+        errors.append(f"reader arena_hits {s['arena_hits']}")
+    print(json.dumps({"arena_reader": s, "errors": errors}))
+    return 1 if errors else 0
 
 
 def main(argv=None) -> int:
@@ -31,20 +117,24 @@ def main(argv=None) -> int:
     ap.add_argument("--transform-ms", type=float, default=4.0,
                     help="simulated per-batch transform cost")
     ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--skip-process", action="store_true",
+                    help="thread + DRAM legs only (no spawned pools)")
+    ap.add_argument("--arena-reader", metavar="PATH",
+                    help=argparse.SUPPRESS)  # internal: direct-leg proc 2
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
-    import numpy as np
+    if args.arena_reader:
+        return _arena_reader_main(args)
+
+    import numpy as np  # noqa: F401  (used via helpers)
 
     from .common import LambdaPreprocessing
     from .feature_set import FeatureSet, MiniBatch
     from .host_pipeline import DeviceStagingIterator, build_host_pipeline
 
-    n = args.batches * args.batch
-    feats = np.arange(n * 4, dtype=np.float32).reshape(n, 4)
-    labels = np.arange(n, dtype=np.float32)
-    base = FeatureSet.array(feats, labels)
+    base = _build_base(args)
 
     def slow_transform(batch: MiniBatch) -> MiniBatch:
         # GIL-releasing stand-in for decode/augment (sleep, like cv2's
@@ -58,11 +148,12 @@ def main(argv=None) -> int:
         out = list(fs.batches(args.batch, shuffle=True, seed=7))
         return out, time.perf_counter() - t0
 
-    def one_epoch_staged(fs):
+    def one_epoch_staged(fs, backend=None):
         t0 = time.perf_counter()
         it = build_host_pipeline(
             fs, args.batch, shuffle=True, drop_remainder=True, seed=7,
-            transform_workers=args.workers, prefetch_depth=2)
+            transform_workers=args.workers, prefetch_depth=2,
+            infeed_backend=backend)
         staging = DeviceStagingIterator(
             it, lambda b: b, lambda bs: list(bs), depth=2)
         out = [host for _dev, host in staging]
@@ -79,14 +170,8 @@ def main(argv=None) -> int:
     got, staged_s = one_epoch_staged(staged_fs)
     cached, cached_s = one_epoch_staged(staged_fs)  # epoch 2: DRAM replay
 
-    errors = []
-    if len(got) != len(ref):
-        errors.append(f"batch count {len(got)} != {len(ref)}")
-    for i, (a, b) in enumerate(zip(ref, got)):
-        for xa, xb in zip(a.inputs, b.inputs):
-            if not np.array_equal(xa, xb):
-                errors.append(f"batch {i}: inputs differ")
-                break
+    errors: list = []
+    _batches_equal(ref, got, errors, "staged")
     if len(cached) != len(ref):
         errors.append(f"cached epoch count {len(cached)} != {len(ref)}")
     stats = staged_fs.stats().as_dict()
@@ -103,6 +188,71 @@ def main(argv=None) -> int:
         "transform_stats": stats,
         "errors": errors,
     }
+
+    if not args.skip_process:
+        # --- process leg: spawned pool, shared-memory rings ------------
+        chain = LambdaPreprocessing(cpu_bound_transform, cpu_bound=True)
+        proc_ref = list(base.transform(chain)
+                        .batches(args.batch, shuffle=True, seed=7))
+        proc_fs = base.transform(chain)
+        proc_out, proc_s = one_epoch_staged(proc_fs, backend="process")
+        _batches_equal(proc_ref, proc_out, errors, "process")
+        pstats = proc_fs.stats().as_dict()
+        if not pstats["worker_items"]:
+            errors.append(f"process leg recorded no worker items: {pstats}")
+        out["process_s"] = round(proc_s, 4)
+        out["process_stats"] = pstats
+
+        # --- direct leg: DRAM prefix + disk arena tail -----------------
+        with tempfile.TemporaryDirectory() as d:
+            arena = os.path.join(d, "smoke.arena")
+            dfs = _build_direct(args, arena)
+            d_ref = list(dfs.batches(args.batch, shuffle=False))
+            replay = list(dfs.batches(args.batch, shuffle=False))
+            _batches_equal(d_ref, replay, errors, "direct-replay")
+            dstats = dfs.stats().as_dict()
+            if dstats["batches_transformed"] != args.batches:
+                errors.append(
+                    f"direct leg re-transformed on replay: {dstats}")
+            if dstats["arena_hits"] == 0:
+                errors.append(f"direct leg never hit the arena: {dstats}")
+            out["direct_stats"] = dstats
+            # second process replays the same arena concurrently with
+            # this one still holding mappings open
+            r = subprocess.run(
+                [sys.executable, "-m",
+                 "analytics_zoo_tpu.feature.data_smoke",
+                 "--arena-reader", arena,
+                 "--batches", str(args.batches),
+                 "--batch", str(args.batch)],
+                capture_output=True, text=True, timeout=300)
+            out["arena_reader"] = (r.stdout or "").strip()[-500:]
+            if r.returncode != 0:
+                errors.append(
+                    f"arena reader failed: {(r.stderr or '')[-500:]}")
+
+        # --- chaos leg: kill a worker mid-epoch ------------------------
+        with tempfile.TemporaryDirectory() as d:
+            env_before = {k: os.environ.get(k)
+                          for k in ("ZOO_TPU_FAULT", "ZOO_TPU_FAULT_STATE")}
+            os.environ["ZOO_TPU_FAULT"] = "infeed-worker:kill@2"
+            os.environ["ZOO_TPU_FAULT_STATE"] = d
+            try:
+                cfs = base.transform(chain)
+                chaos_out, _ = one_epoch_staged(cfs, backend="process")
+            finally:
+                for k, v in env_before.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
+            _batches_equal(proc_ref, chaos_out, errors, "chaos")
+            if not os.path.exists(
+                    os.path.join(d, "fired.infeed-worker_kill_2")):
+                errors.append("chaos leg: fault never fired")
+        out["chaos_batches"] = len(chaos_out)
+
+    out["errors"] = errors
     print(json.dumps(out))
     return 1 if errors else 0
 

@@ -11,9 +11,14 @@ the reference's BlockManager fetch phase.
 
 from __future__ import annotations
 
+import hashlib
+import json
 import logging
 import math
+import mmap as mmap_mod
+import os
 import queue
+import tempfile
 import threading
 import time
 import weakref
@@ -51,6 +56,11 @@ class MiniBatch(tuple):
 
     def __new__(cls, inputs, targets=None, weights=None):
         return super().__new__(cls, (tuple(inputs), targets, weights))
+
+    def __getnewargs__(self):
+        # without this, pickle rebuilds via MiniBatch.__new__(cls, self)
+        # which re-nests the whole triple under ``inputs`` — silently
+        return (self[0], self[1], self[2])
 
     @property
     def inputs(self):
@@ -128,11 +138,28 @@ class FeatureSet:
             fs = data
         else:
             fs = FeatureSet.samples(list(data))
-        if mt in ("PMEM", "DIRECT") and isinstance(fs, ArrayFeatureSet):
-            try:
-                return DirectFeatureSet(fs.features, fs.labels, fs.weights)
-            except (ImportError, MemoryError):
-                return fs  # native arena unavailable/full: stay in DRAM
+        if mt in ("PMEM", "DIRECT"):
+            if isinstance(fs, TransformedFeatureSet):
+                # DIRECT tier for transformed pipelines = disk-backed
+                # mmap'd arena beneath the DRAM prefix: batches past
+                # cache_bytes spill to one file every process on the
+                # host shares (docs/data-pipeline.md)
+                fs.cache(
+                    int(kw.get("cache_bytes", DEFAULT_DRAM_CACHE_BYTES)),
+                    arena_path=kw.get("arena_path") or default_arena_path(),
+                    arena_bytes=kw.get("arena_bytes"))
+                return fs
+            if isinstance(fs, ArrayFeatureSet):
+                try:
+                    return DirectFeatureSet(fs.features, fs.labels,
+                                            fs.weights)
+                except (ImportError, MemoryError):
+                    # native arena unavailable/full: stage the arrays
+                    # through disk-backed mmaps instead of silently
+                    # staying in the GC'd DRAM heap
+                    return MmapFeatureSet(fs.features, fs.labels,
+                                          fs.weights,
+                                          dir=kw.get("arena_path"))
         if mt == "DRAM" and isinstance(fs, TransformedFeatureSet):
             # DRAM tier = memoize the transformed batches (reference keeps
             # the post-transform MiniBatches resident; raw tiers already
@@ -250,6 +277,37 @@ class DirectFeatureSet(ArrayFeatureSet):
     memory_type = "DIRECT"
 
 
+class MmapFeatureSet(ArrayFeatureSet):
+    """DIRECT-tier fallback when the native arena can't load: arrays are
+    staged to ``.npy`` files and reopened ``mmap_mode="r"``, so sample
+    bytes live in the page cache (off the GC'd Python heap, shared
+    across processes mapping the same staging dir) instead of silently
+    staying DRAM-resident."""
+
+    def __init__(self, features, labels=None, weights=None,
+                 dir: Optional[str] = None):
+        self.staging_dir = dir or tempfile.mkdtemp(prefix="zoo_mmap_")
+        os.makedirs(self.staging_dir, exist_ok=True)
+
+        def stage(tag, a):
+            a = np.asarray(a)
+            p = os.path.join(self.staging_dir, f"{tag}.npy")
+            np.save(p, a)
+            return np.load(p, mmap_mode="r")
+
+        feats = [np.asarray(f) for f in (
+            features if isinstance(features, (list, tuple)) else [features])]
+        staged_feats = [stage(f"x{i}", a) for i, a in enumerate(feats)]
+        staged_labs = None
+        if labels is not None:
+            labs = [np.asarray(l) for l in (
+                labels if isinstance(labels, (list, tuple)) else [labels])]
+            staged_labs = [stage(f"y{i}", a) for i, a in enumerate(labs)]
+        super().__init__(staged_feats, staged_labs, weights)
+
+    memory_type = "DIRECT"
+
+
 class DiskFeatureSet(FeatureSet):
     """Sliced-epoch dataset over ``.npz`` shards.
 
@@ -274,6 +332,24 @@ class DiskFeatureSet(FeatureSet):
 
         with np.load(_io.BytesIO(file_io.read_bytes(path))) as z:
             return {k: z[k] for k in z.files}
+
+    def _load_group(self, group) -> List[Dict[str, np.ndarray]]:
+        """Load one resident slice's shards, concurrently when the shared
+        worker resolver says the host has headroom (shard reads are
+        IO-bound, so threads overlap them even under the GIL); order is
+        preserved."""
+        paths = [self.paths[int(pi)] for pi in group]
+        if len(paths) <= 1:
+            return [self._load_shard(p) for p in paths]
+        from .host_pipeline import resolve_transform_workers
+        from concurrent.futures import ThreadPoolExecutor
+
+        workers = max(1, min(len(paths), resolve_transform_workers(None)))
+        if workers == 1:
+            return [self._load_shard(p) for p in paths]
+        with ThreadPoolExecutor(max_workers=workers,
+                                thread_name_prefix="zoo-shard") as pool:
+            return list(pool.map(self._load_shard, paths))
 
     @property
     def _sizes(self) -> List[int]:
@@ -311,8 +387,7 @@ class DiskFeatureSet(FeatureSet):
         sizes_seen: Dict[int, int] = {}
         for gi, group in enumerate(groups):
             feats_acc: Dict[str, List[np.ndarray]] = {}
-            for pi in group:
-                shard = self._load_shard(self.paths[pi])
+            for pi, shard in zip(group, self._load_group(group)):
                 sizes_seen[int(pi)] = int(shard["x0"].shape[0])
                 for k, v in shard.items():
                     feats_acc.setdefault(k, []).append(v)
@@ -454,6 +529,9 @@ class TransformStats:
         self.batches = 0
         self.seconds = 0.0
         self.cache_hits = 0
+        self.arena_hits = 0
+        self.worker_busy: Dict[int, float] = {}
+        self.worker_items: Dict[int, int] = {}
 
     def record(self, seconds: float, batches: int = 1):
         with self._lock:
@@ -464,11 +542,31 @@ class TransformStats:
         with self._lock:
             self.cache_hits += batches
 
+    def record_arena_hit(self, batches: int = 1):
+        with self._lock:
+            self.arena_hits += batches
+            self.cache_hits += batches
+
+    def record_worker(self, wid: int, seconds: float, items: int = 1):
+        """Per-worker busy time (process backend reports it from the
+        worker side, so queue/hand-off overhead is excluded)."""
+        with self._lock:
+            self.worker_busy[wid] = self.worker_busy.get(wid, 0.0) + seconds
+            self.worker_items[wid] = self.worker_items.get(wid, 0) + items
+
+    def worker_busy_snapshot(self) -> Dict[int, float]:
+        """Cumulative busy seconds per worker; the InfeedMonitor diffs
+        snapshots across a logging window for utilization telemetry."""
+        with self._lock:
+            return dict(self.worker_busy)
+
     def as_dict(self) -> Dict[str, Any]:
         with self._lock:
             return {"batches_transformed": self.batches,
                     "transform_seconds": round(self.seconds, 6),
-                    "cache_hits": self.cache_hits}
+                    "cache_hits": self.cache_hits,
+                    "arena_hits": self.arena_hits,
+                    "worker_items": dict(self.worker_items)}
 
 
 def minibatch_nbytes(batch: MiniBatch) -> int:
@@ -482,6 +580,279 @@ def minibatch_nbytes(batch: MiniBatch) -> int:
         return np.asarray(x).nbytes
 
     return add(tuple(batch))
+
+
+def default_arena_path() -> str:
+    """Where the DIRECT arena lives when the caller doesn't say:
+    ``ZOO_TPU_DIRECT_ARENA`` if set, else a per-user file in the temp
+    dir — stable across processes of the same user, so pool workers and
+    serving workers share one cache by default."""
+    p = os.environ.get("ZOO_TPU_DIRECT_ARENA")
+    if p:
+        return p
+    uid = os.getuid() if hasattr(os, "getuid") else 0
+    return os.path.join(tempfile.gettempdir(), f"zoo_tpu_{uid}.arena")
+
+
+class DirectArena:
+    """Disk-backed memory-mapped cache arena — the real DIRECT tier.
+
+    The DRAM tier memoizes transformed batches in the Python heap; this
+    arena is the next rung of the reference's memory-tier ladder
+    (FeatureSet.scala DIRECT/PMEM): batches past ``cache_bytes`` spill
+    to one append-only file that every process on the host can mmap, so
+    N infeed/serving workers share ONE transformed copy of the dataset
+    instead of N.
+
+    On-disk format (all host-endian, numpy dtype strings):
+
+    - ``<path>`` — array bytes back-to-back, each 64-byte aligned, in
+      epoch order. Append-only; never rewritten in place.
+    - ``<path>.index.json`` — the only source of truth for what's
+      readable: per-signature batch metas (absolute offset, shape,
+      dtype per array + the MiniBatch structure template) plus an LRU
+      list. Committed atomically (tmp + rename) *after* the data file
+      is flushed, so concurrent readers see complete epochs or nothing.
+    - ``<path>.lock`` — single-writer lockfile (O_EXCL, pid inside;
+      stale locks from dead writers are stolen). Readers never lock.
+
+    Same signature machinery as the DRAM tier: a signature is the batch
+    geometry ``(batch_size, drop_remainder, pad_remainder)`` plus a
+    dataset fingerprint; LRU eviction applies when the byte budget is
+    exceeded (logical: the entry leaves the index; file space is
+    reclaimed when the arena empties and is truncated).
+    """
+
+    def __init__(self, path: str, budget_bytes: Optional[int] = None):
+        self.path = path
+        self.index_path = path + ".index.json"
+        self.lock_path = path + ".lock"
+        self.budget = int(budget_bytes) if budget_bytes else None
+        self._mm: Optional[mmap_mod.mmap] = None
+        self._mm_size = 0
+        self._index_mtime: Optional[float] = None
+        self._index: Dict[str, Any] = {"version": 1, "next_offset": 0,
+                                       "signatures": {}, "lru": []}
+        self._load_index(force=True)
+
+    # ---- index ------------------------------------------------------
+    def _load_index(self, force: bool = False):
+        try:
+            st = os.stat(self.index_path)
+        except OSError:
+            return
+        if not force and st.st_mtime_ns == self._index_mtime:
+            return
+        try:
+            with open(self.index_path) as f:
+                self._index = json.load(f)
+            self._index_mtime = st.st_mtime_ns
+        except (OSError, ValueError):
+            pass  # mid-rename race: keep the previous view
+
+    def _store_index(self):
+        tmp = self.index_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._index, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.index_path)
+        try:
+            self._index_mtime = os.stat(self.index_path).st_mtime_ns
+        except OSError:
+            pass
+
+    # ---- read path --------------------------------------------------
+    def has(self, sig_key: str, fingerprint: str) -> bool:
+        self._load_index()
+        entry = self._index["signatures"].get(sig_key)
+        return entry is not None and entry["fp"] == fingerprint
+
+    def batch_metas(self, sig_key: str) -> List[Dict[str, Any]]:
+        entry = self._index["signatures"][sig_key]
+        if sig_key in self._index["lru"]:
+            self._index["lru"].remove(sig_key)
+            self._index["lru"].append(sig_key)
+        return entry["batches"]
+
+    def _mapping(self) -> mmap_mod.mmap:
+        need = int(self._index["next_offset"])
+        if self._mm is None or self._mm_size < need:
+            # the old mapping (if any) stays alive under existing views;
+            # new reads go through the re-mmap covering the grown file
+            with open(self.path, "rb") as f:
+                self._mm = mmap_mod.mmap(f.fileno(), need,
+                                         access=mmap_mod.ACCESS_READ)
+            self._mm_size = need
+        return self._mm
+
+    def read_batch(self, meta: Dict[str, Any]) -> MiniBatch:
+        """Rebuild one batch as zero-copy views into the arena mapping
+        (read-only; the page cache is the shared cross-process copy)."""
+        from .infeed_worker import rebuild_batch
+
+        mm = self._mapping()
+        arrays = []
+        for off, shape, dt in meta["a"]:
+            shape = tuple(shape)
+            count = int(np.prod(shape)) if shape else 1
+            arrays.append(np.frombuffer(
+                mm, dtype=np.dtype(dt), count=count,
+                offset=int(off)).reshape(shape))
+        return rebuild_batch(meta["t"], arrays)
+
+    # ---- write path -------------------------------------------------
+    def try_writer(self, sig_key: str,
+                   fingerprint: str) -> Optional["_ArenaWriter"]:
+        """Acquire the single-writer role, or None (another live process
+        is writing — the caller streams uncached; its epoch commits)."""
+        try:
+            fd = os.open(self.lock_path,
+                         os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            try:
+                with open(self.lock_path) as f:
+                    pid = int(f.read().strip() or 0)
+                os.kill(pid, 0)  # raises when the writer is gone
+                return None
+            except (OSError, ValueError):
+                try:  # stale lock from a dead writer: steal it
+                    os.unlink(self.lock_path)
+                except OSError:
+                    pass
+                try:
+                    fd = os.open(self.lock_path,
+                                 os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                except FileExistsError:
+                    return None
+        with os.fdopen(fd, "w") as f:
+            f.write(str(os.getpid()))
+        self._load_index()
+        return _ArenaWriter(self, sig_key, fingerprint)
+
+    def _release_lock(self):
+        try:
+            os.unlink(self.lock_path)
+        except OSError:
+            pass
+
+    def _evict_over_budget(self, incoming: int,
+                           allow_truncate: bool = True):
+        if self.budget is None:
+            return
+        sigs = self._index["signatures"]
+
+        def live() -> int:
+            return sum(e["bytes"] for e in sigs.values())
+
+        while self._index["lru"] and live() + incoming > self.budget:
+            victim = self._index["lru"].pop(0)
+            e = sigs.pop(victim, None)
+            if e is not None:
+                logger.info(
+                    "DIRECT arena: evicted signature %s (%.1f MiB)",
+                    victim, e["bytes"] / 2**20)
+        if not sigs and allow_truncate:
+            # the arena emptied: reclaim the file space for real (never
+            # mid-commit — the incoming epoch's bytes sit at the tail)
+            self._index["next_offset"] = 0
+            try:
+                with open(self.path, "r+b") as f:
+                    f.truncate(0)
+            except OSError:
+                pass
+
+
+class _ArenaWriter:
+    """One epoch's append session against the arena (lock held)."""
+
+    def __init__(self, arena: DirectArena, sig_key: str, fingerprint: str):
+        self.arena = arena
+        self.sig_key = sig_key
+        self.fingerprint = fingerprint
+        self.start_offset = int(arena._index["next_offset"])
+        self.offset = self.start_offset
+        self.metas: List[Dict[str, Any]] = []
+        self.nbytes = 0
+        self.ok = True
+        self._done = False
+        self._f = open(arena.path, "ab")
+        if self._f.tell() > self.offset:
+            # uncommitted garbage from an aborted writer: overwrite it
+            self._f.close()
+            self._f = open(arena.path, "r+b")
+            self._f.truncate(self.offset)
+            self._f = open(arena.path, "ab")
+
+    def append(self, batch: MiniBatch):
+        """Spill one transformed batch; a batch the flattener can't take
+        (non-ndarray leaves) voids the whole session — a partial epoch
+        in the index would replay as the whole dataset."""
+        from .infeed_worker import flatten_batch, slot_nbytes
+
+        if not self.ok:
+            return
+        arrays, template = flatten_batch(batch)
+        if arrays is None:
+            self.ok = False
+            logger.warning("DIRECT arena: batch not arena-cacheable; "
+                           "signature %s will not spill", self.sig_key)
+            return
+        metas = []
+        for a in arrays:
+            pad = -self._f.tell() % 64
+            if pad:
+                self._f.write(b"\0" * pad)
+            off = self._f.tell()
+            self._f.write(a.tobytes())
+            metas.append([off, list(a.shape), a.dtype.str])
+        self.metas.append({"t": template, "a": metas})
+        self.nbytes += slot_nbytes(arrays)
+
+    def commit(self) -> Optional[List[Dict[str, Any]]]:
+        """Flush data, then atomically publish the signature. Returns
+        the batch metas (readable immediately), or None if voided."""
+        if self._done:
+            return None
+        self._done = True
+        try:
+            if not self.ok:
+                self._f.close()
+                return None
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            end = self._f.tell()
+            self._f.close()
+            idx = self.arena._index
+            idx["signatures"].pop(self.sig_key, None)
+            if self.sig_key in idx["lru"]:
+                idx["lru"].remove(self.sig_key)
+            self.arena._evict_over_budget(self.nbytes,
+                                          allow_truncate=False)
+            idx["signatures"][self.sig_key] = {
+                "fp": self.fingerprint, "bytes": self.nbytes,
+                "batches": self.metas}
+            idx["lru"].append(self.sig_key)
+            idx["next_offset"] = max(int(idx["next_offset"]), end)
+            self.arena._store_index()
+            return self.metas
+        finally:
+            self.arena._release_lock()
+
+    def abort(self):
+        """Interrupted epoch: drop the appended bytes (truncate back) and
+        publish nothing."""
+        if self._done:
+            return
+        self._done = True
+        try:
+            self._f.close()
+            with open(self.arena.path, "r+b") as f:
+                f.truncate(self.start_offset)
+        except OSError:
+            pass
+        finally:
+            self.arena._release_lock()
 
 
 class TransformedFeatureSet(FeatureSet):
@@ -506,6 +877,9 @@ class TransformedFeatureSet(FeatureSet):
         self._cache: "OrderedDict[tuple, Tuple[list, int]]" = OrderedDict()
         self._cache_used = 0
         self._cache_disabled: set = set()  # signatures over budget alone
+        self._arena: Optional[DirectArena] = None
+        self._arena_metas: Dict[tuple, List[Dict[str, Any]]] = {}
+        self._fp: Optional[str] = None
 
     def size(self):
         return self.base.size()
@@ -513,11 +887,44 @@ class TransformedFeatureSet(FeatureSet):
     def stats(self) -> TransformStats:
         return self._stats
 
-    def cache(self, max_bytes: int = DEFAULT_DRAM_CACHE_BYTES
+    def cache(self, max_bytes: int = DEFAULT_DRAM_CACHE_BYTES,
+              arena_path: Optional[str] = None,
+              arena_bytes: Optional[int] = None
               ) -> "TransformedFeatureSet":
-        """Enable the DRAM cache tier under ``max_bytes`` of host RAM."""
+        """Enable the cache-tier ladder: transformed batches memoize in
+        host RAM up to ``max_bytes`` (the DRAM tier). With
+        ``arena_path`` the DIRECT tier opens beneath it: *every* batch
+        of a cached signature also lands in the disk arena — the
+        cross-process source of truth — and replay serves the hot
+        prefix from RAM with the spill tail mmap'd from the arena, so
+        datasets past ``max_bytes`` still replay with zero
+        re-transforms (and other processes on the host read the same
+        arena instead of re-transforming their own copy)."""
         self._cache_budget = int(max_bytes)
+        if arena_path:
+            self._arena = DirectArena(arena_path, budget_bytes=arena_bytes)
         return self
+
+    def _fingerprint(self) -> str:
+        """Cheap dataset identity for cross-process arena hits: dataset
+        type/size/geometry + the Preprocessing chain's type. Two
+        processes building the same pipeline agree; a changed dataset
+        or chain misses and re-transforms."""
+        if self._fp is not None:
+            return self._fp
+        parts = [type(self.base).__name__, str(self.base.size()),
+                 type(self.preprocessing).__name__]
+        base = self.base
+        if isinstance(base, ArrayFeatureSet):
+            for a in base.features:
+                parts.append(f"x{a.shape}{a.dtype}")
+            for a in (base.labels or []):
+                parts.append(f"y{a.shape}{a.dtype}")
+        if isinstance(base, DiskFeatureSet):
+            parts.extend(os.path.basename(p) for p in base.paths)
+        self._fp = hashlib.sha1(
+            "|".join(parts).encode()).hexdigest()[:16]
+        return self._fp
 
     def _apply_timed(self, batch: MiniBatch) -> MiniBatch:
         t0 = time.perf_counter()
@@ -535,53 +942,109 @@ class TransformedFeatureSet(FeatureSet):
                 "%.1f MiB", sig, nbytes / 2**20, incoming_bytes / 2**20)
 
     def batches(self, batch_size, shuffle=False, drop_remainder=True,
-                pad_remainder=False, seed=0, num_workers=None):
+                pad_remainder=False, seed=0, num_workers=None,
+                backend=None):
         sig = (batch_size, bool(drop_remainder), bool(pad_remainder))
-        if self._cache_budget and sig in self._cache:
+        sig_key = f"{batch_size}:{int(sig[1])}:{int(sig[2])}"
+        caching = bool(self._cache_budget) or self._arena is not None
+        if caching and sig in self._cache:
+            # replay: DRAM hot prefix, arena-mmap'd spill tail
             cached, _ = self._cache[sig]
             self._cache.move_to_end(sig)  # LRU touch
-            order = np.arange(len(cached))
+            metas = self._arena_metas.get(sig, [])
+            order = np.arange(max(len(metas), len(cached)))
             if shuffle:
                 # sample-level shuffle happened before the transform was
                 # memoized; replay epochs reshuffle at batch granularity
                 # with the fresh epoch seed (documented tradeoff)
                 np.random.default_rng(seed).shuffle(order)
             for i in order:
-                self._stats.record_hit()
-                yield cached[i]
+                if i < len(cached):
+                    self._stats.record_hit()
+                    yield cached[i]
+                else:
+                    self._stats.record_arena_hit()
+                    yield self._arena.read_batch(metas[i])
+            return
+        if caching and self._arena is not None \
+                and sig not in self._cache_disabled \
+                and self._arena.has(sig_key, self._fingerprint()):
+            # replay from the arena alone: another process (or an
+            # earlier incarnation of this one) transformed this
+            # signature — zero re-transforms, shared page-cache bytes
+            metas = self._arena.batch_metas(sig_key)
+            order = np.arange(len(metas))
+            if shuffle:
+                np.random.default_rng(seed).shuffle(order)
+            for i in order:
+                self._stats.record_arena_hit()
+                yield self._arena.read_batch(metas[i])
             return
         base_it = self.base.batches(
             batch_size, shuffle=shuffle, drop_remainder=drop_remainder,
             pad_remainder=pad_remainder, seed=seed)
         workers = self.num_workers if num_workers is None else num_workers
+        if workers and workers < 0:
+            from .host_pipeline import resolve_transform_workers
+            workers = resolve_transform_workers(workers)
         if workers and workers > 0:
-            from .host_pipeline import ParallelTransformIterator
-            it: Iterator[MiniBatch] = ParallelTransformIterator(
-                base_it, self._apply_timed, num_workers=workers)
+            from .host_pipeline import (ParallelTransformIterator,
+                                        ProcessTransformPool,
+                                        resolve_infeed_backend)
+            if resolve_infeed_backend(backend, self.preprocessing) \
+                    == "process":
+                # the chain itself is pickled to the workers, not
+                # _apply_timed (TransformStats holds a threading.Lock);
+                # workers report their transform seconds back instead
+                it: Iterator[MiniBatch] = ProcessTransformPool(
+                    base_it, self.preprocessing, num_workers=workers,
+                    stats=self._stats)
+            else:
+                it = ParallelTransformIterator(
+                    base_it, self._apply_timed, num_workers=workers)
         else:
             it = (self._apply_timed(b) for b in base_it)
-        if not self._cache_budget or sig in self._cache_disabled:
+        if not caching or sig in self._cache_disabled:
             yield from it
             return
+        writer = None
+        if self._arena is not None:
+            writer = self._arena.try_writer(sig_key, self._fingerprint())
         acc: Optional[List[MiniBatch]] = []
         acc_bytes = 0
+        dram_full = False
         complete = False
         try:
             for out in it:
-                if acc is not None:
-                    acc_bytes += minibatch_nbytes(out)
-                    if acc_bytes > self._cache_budget:
-                        logger.info(
-                            "DRAM cache: signature %s exceeds budget "
-                            "(%.1f MiB > %.1f MiB); caching disabled for "
-                            "it", sig, acc_bytes / 2**20,
-                            self._cache_budget / 2**20)
-                        self._cache_disabled.add(sig)
-                        acc = None
+                if writer is not None:
+                    # every batch of the signature goes to the arena —
+                    # disk is the cross-process truth; DRAM memoizes
+                    # only the hot prefix under the byte budget
+                    writer.append(out)
+                if acc is not None and not dram_full:
+                    nb = minibatch_nbytes(out)
+                    if acc_bytes + nb > self._cache_budget:
+                        if writer is not None and writer.ok:
+                            dram_full = True  # tail spills to the arena
+                        elif self._arena is not None:
+                            # transient: the arena writer was busy (or
+                            # this batch isn't arena-cacheable); retry
+                            # the spill on the next epoch
+                            acc = None
+                        else:
+                            logger.info(
+                                "DRAM cache: signature %s exceeds budget "
+                                "(%.1f MiB > %.1f MiB); caching disabled "
+                                "for it", sig, (acc_bytes + nb) / 2**20,
+                                self._cache_budget / 2**20)
+                            self._cache_disabled.add(sig)
+                            acc = None
                     else:
+                        acc_bytes += nb
                         acc.append(out)
                 yield out
-            complete = acc is not None
+            complete = acc is not None or \
+                (writer is not None and writer.ok)
         finally:
             close = getattr(it, "close", None)
             if close is not None:
@@ -589,9 +1052,18 @@ class TransformedFeatureSet(FeatureSet):
             if complete:
                 # only full epochs commit: an early break or error must
                 # not memoize a truncated epoch as the whole dataset
-                self._evict_for(acc_bytes)
-                self._cache[sig] = (acc, acc_bytes)
-                self._cache_used += acc_bytes
+                metas = writer.commit() if writer is not None else None
+                if metas is not None:
+                    self._arena_metas[sig] = metas
+                if acc is not None and (metas is not None
+                                        or not dram_full):
+                    # a DRAM prefix whose arena tail failed to commit
+                    # must not memoize: it would replay as the dataset
+                    self._evict_for(acc_bytes)
+                    self._cache[sig] = (acc, acc_bytes)
+                    self._cache_used += acc_bytes
+            elif writer is not None:
+                writer.abort()
 
 
 class ShardedFileFeatureSet(DiskFeatureSet):

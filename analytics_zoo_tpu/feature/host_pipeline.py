@@ -22,10 +22,15 @@ can emit input-wait and input-bound-fraction telemetry per logging window.
 from __future__ import annotations
 
 import logging
+import multiprocessing as mp
 import os
+import pickle
+import queue as queue_mod
+import threading
+import weakref
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Callable, Iterator, List, Optional, Tuple
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 import time
 
@@ -34,6 +39,7 @@ import numpy as np
 from .feature_set import (FeatureSet, MiniBatch, PrefetchIterator,
                           TransformedFeatureSet, minibatch_len,
                           register_pipeline)
+from .infeed_worker import rebuild_batch, worker_main
 
 logger = logging.getLogger("analytics_zoo_tpu.feature")
 
@@ -103,6 +109,395 @@ class ParallelTransformIterator:
         base_close = getattr(self._base, "close", None)
         if base_close is not None:
             base_close()
+
+
+DEFAULT_SLOT_BYTES = 8 << 20    # ZOO_TPU_INFEED_SLOT_BYTES
+DEFAULT_SLOTS_PER_WORKER = 4    # ZOO_TPU_INFEED_SLOTS
+
+
+class _RingSegment:
+    """Lifecycle of one worker's shared-memory ring.
+
+    numpy does not pin the buffer export of the ``SharedMemory``
+    memoryview, so ``shm.close()`` really unmaps even while zero-copy
+    views are alive — touching them afterwards is a segfault, not an
+    exception. The segment therefore refcounts outstanding batch leases:
+    ``retire()`` (pool close) unlinks the name immediately — no /dev/shm
+    entry survives the pool — but the unmap is deferred until the last
+    consumer-held view is garbage collected.
+    """
+
+    __slots__ = ("shm", "_active", "_retired", "_lock")
+
+    def __init__(self, shm):
+        self.shm = shm
+        self._active = 0
+        self._retired = False
+        self._lock = threading.Lock()
+
+    def lease(self):
+        with self._lock:
+            self._active += 1
+
+    def unlease(self):
+        with self._lock:
+            self._active -= 1
+            last = self._retired and self._active == 0
+        if last:
+            self._unmap()
+
+    def retire(self):
+        with self._lock:
+            if self._retired:
+                return
+            self._retired = True
+            drained = self._active == 0
+        try:
+            self.shm.unlink()
+        except Exception:  # noqa: BLE001 - already unlinked
+            pass
+        if drained:
+            self._unmap()
+
+    def _unmap(self):
+        try:
+            self.shm.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class _SlotLease:
+    """One leased ring slot: returned to the worker's free queue (and
+    unleased from the segment) when the last zero-copy view wrapped from
+    it is garbage collected."""
+
+    __slots__ = ("free_q", "segment", "slot", "count", "lock")
+
+    def __init__(self, free_q, segment: "_RingSegment", slot: int,
+                 count: int):
+        self.free_q = free_q
+        self.segment = segment
+        self.slot = slot
+        self.count = count
+        self.lock = threading.Lock()
+        segment.lease()
+
+    def release_one(self):
+        with self.lock:
+            self.count -= 1
+            if self.count > 0:
+                return
+        try:
+            self.free_q.put_nowait(self.slot)
+        except Exception:  # noqa: BLE001 - pool torn down; segment gone
+            pass
+        self.segment.unlease()
+
+
+class _Worker:
+    """Parent-side record of one spawned transform worker. Queues and the
+    ring segment outlive the process: a respawned replacement reattaches
+    to the same ones, so unclaimed tasks and free slots carry over."""
+
+    __slots__ = ("wid", "proc", "task_q", "free_q", "segment", "assigned")
+
+    def __init__(self, wid, task_q, free_q, segment):
+        self.wid = wid
+        self.proc = None
+        self.task_q = task_q
+        self.free_q = free_q
+        self.segment = segment
+        self.assigned: set = set()
+
+
+class _RemoteError:
+    """Marks a ready-slot as a worker failure to re-raise in order."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+def _reap_pool(procs, segments):
+    """close()/GC backstop: put down workers and retire every ring
+    segment (unlink now, unmap when the last consumer view drops).
+    Module-level — weakref.finalize must not resurrect the pool."""
+    for p in procs:
+        try:
+            if p.is_alive():
+                p.terminate()
+        except Exception:  # noqa: BLE001
+            pass
+    for p in procs:
+        try:
+            p.join(timeout=1.0)
+            if p.is_alive():
+                p.kill()
+        except Exception:  # noqa: BLE001
+            pass
+    for seg in segments:
+        seg.retire()
+
+
+class ProcessTransformPool:
+    """Ordered multi-process transform pool with shared-memory hand-off.
+
+    The iterator contract is :class:`ParallelTransformIterator`'s
+    exactly — results in submission order, bounded in-flight, a worker
+    failure re-raised at the failed batch's position on the very next
+    ``__next__``, idempotent mid-stream ``close()`` — but the transform
+    runs in N spawned processes, so GIL-holding Python chains scale with
+    cores instead of serializing. Each worker returns batches through
+    its own ``multiprocessing.shared_memory`` ring: the parent wraps the
+    slot bytes in numpy views (zero copies on the hot path) and the slot
+    recycles when the consumer drops the batch (weakref lease). Batches
+    that don't fit a slot — or arrive while the consumer retains every
+    lease, e.g. a caching tier — fall back to pickling through the
+    result queue: slower, never wrong, never deadlocked.
+
+    Respawn-on-death rides the launcher supervision seam
+    (:class:`~analytics_zoo_tpu.launcher.supervisor.Respawner`): a
+    worker killed mid-batch is restarted on the same queues + ring, its
+    unacknowledged batches are resubmitted, and late duplicates are
+    dropped by sequence number — the stream stays complete,
+    duplicate-free and ordered. Ring segments are unlinked in
+    ``close()``'s finally (plus a GC finalizer backstop): no /dev/shm
+    leak survives the pool.
+    """
+
+    def __init__(self, base_it: Iterator, preprocessing,
+                 num_workers: int = 2, max_in_flight: Optional[int] = None,
+                 stats=None, slot_bytes: Optional[int] = None,
+                 slots_per_worker: Optional[int] = None, respawner=None):
+        from multiprocessing import shared_memory
+
+        from ..launcher.supervisor import Respawner
+
+        self._base = iter(base_it)
+        self.num_workers = max(1, int(num_workers))
+        self._max_in_flight = max_in_flight or self.num_workers + 2
+        self._stats = stats
+        try:
+            self._payload = pickle.dumps(preprocessing, -1)
+        except Exception as e:
+            raise ValueError(
+                "infeed backend 'process' needs a picklable Preprocessing "
+                "chain (module-level functions; no lambdas or closures): "
+                f"{e}") from e
+        self._slot_bytes = int(slot_bytes or os.environ.get(
+            "ZOO_TPU_INFEED_SLOT_BYTES", DEFAULT_SLOT_BYTES))
+        self._slots = int(slots_per_worker or os.environ.get(
+            "ZOO_TPU_INFEED_SLOTS", DEFAULT_SLOTS_PER_WORKER))
+        self._respawner = respawner or Respawner(max_per_child=3)
+        self._ctx = mp.get_context("spawn")  # fork after jax is unsafe
+        self._result_q = self._ctx.Queue()
+        self._tasks: Dict[int, Any] = {}    # seq -> raw batch (requeue)
+        self._ready: Dict[int, Any] = {}    # seq -> batch | _RemoteError
+        self._seq_submit = 0
+        self._seq_emit = 0
+        self._rr = 0
+        self._exhausted = False
+        self._closed = False
+        self._fatal: Optional[BaseException] = None
+        self._close_lock = threading.Lock()
+        self.shm_batches = 0
+        self.pickled_batches = 0
+        self._all_procs: List = []
+        self._workers: Dict[int, _Worker] = {}
+        for wid in range(self.num_workers):
+            shm = shared_memory.SharedMemory(
+                create=True, size=self._slot_bytes * self._slots)
+            w = _Worker(wid, self._ctx.Queue(), self._ctx.Queue(),
+                        _RingSegment(shm))
+            for s in range(self._slots):
+                w.free_q.put(s)
+            self._workers[wid] = w
+        self._finalizer = weakref.finalize(
+            self, _reap_pool, self._all_procs,
+            [w.segment for w in self._workers.values()])
+        for w in self._workers.values():
+            self._start_proc(w)
+        register_pipeline(self)
+        self._fill()
+
+    @property
+    def respawns(self) -> int:
+        return self._respawner.total_respawns
+
+    def pool_stats(self) -> Dict[str, int]:
+        return {"shm_batches": self.shm_batches,
+                "pickled_batches": self.pickled_batches,
+                "respawns": self.respawns}
+
+    def _start_proc(self, w: _Worker):
+        p = self._ctx.Process(
+            target=worker_main,
+            args=(w.wid, w.segment.shm.name, self._slot_bytes,
+                  self._payload, w.task_q, self._result_q, w.free_q),
+            daemon=True, name=f"zoo-infeed-{w.wid}")
+        p.start()
+        w.proc = p
+        self._all_procs.append(p)
+
+    def _fill(self):
+        while not self._exhausted and \
+                len(self._tasks) + len(self._ready) < self._max_in_flight:
+            try:
+                item = next(self._base)
+            except StopIteration:
+                self._exhausted = True
+                break
+            seq = self._seq_submit
+            self._seq_submit += 1
+            w = self._workers[self._rr % self.num_workers]
+            self._rr += 1
+            self._tasks[seq] = item
+            w.assigned.add(seq)
+            w.task_q.put((seq, item))
+
+    def _note_time(self, wid: int, elapsed: float):
+        if self._stats is not None:
+            self._stats.record(elapsed)
+            self._stats.record_worker(wid, elapsed)
+
+    def _wrap(self, w: _Worker, slot: int, metas, template) -> MiniBatch:
+        """Wrap one ring slot's bytes in numpy views — the zero-copy hot
+        path. Each view carries a finalizer on the shared lease; the
+        slot returns to the worker only after every view is gone."""
+        if not metas:
+            try:
+                w.free_q.put_nowait(slot)
+            except Exception:  # noqa: BLE001
+                pass
+            return rebuild_batch(template, [])
+        lease = _SlotLease(w.free_q, w.segment, slot, len(metas))
+        base = slot * self._slot_bytes
+        arrays = []
+        for off, shape, dt in metas:
+            arr = np.ndarray(shape, np.dtype(dt), buffer=w.segment.shm.buf,
+                             offset=base + off)
+            weakref.finalize(arr, lease.release_one)
+            arrays.append(arr)
+        return rebuild_batch(template, arrays)
+
+    def _handle(self, msg):
+        kind, wid, seq = msg[0], msg[1], msg[2]
+        if kind == "fatal":
+            # the worker can't run at all (chain failed to unpickle in
+            # the spawned interpreter): surface on the next __next__
+            self._fatal = pickle.loads(msg[3])
+            return
+        w = self._workers[wid]
+        if seq not in self._tasks:
+            # late duplicate after a respawn resubmission: drop it, but
+            # hand its slot straight back so the ring doesn't shrink
+            if kind == "shm":
+                try:
+                    w.free_q.put_nowait(msg[3])
+                except Exception:  # noqa: BLE001
+                    pass
+            return
+        del self._tasks[seq]
+        w.assigned.discard(seq)
+        if kind == "shm":
+            _, _, _, slot, metas, template, elapsed = msg
+            self._ready[seq] = self._wrap(w, slot, metas, template)
+            self.shm_batches += 1
+            self._note_time(wid, elapsed)
+        elif kind == "pkl":
+            self._ready[seq] = pickle.loads(msg[3])
+            self.pickled_batches += 1
+            self._note_time(wid, msg[4])
+        else:  # "err"
+            self._ready[seq] = _RemoteError(pickle.loads(msg[3]))
+
+    def _check_workers(self):
+        """Respawn dead workers on their existing queues + ring and
+        resubmit their unacknowledged batches. Raises RuntimeError (via
+        the Respawner budget) when deaths look structural."""
+        for wid, w in list(self._workers.items()):
+            if self._closed or w.proc is None or w.proc.is_alive():
+                continue
+            self._respawner.note_death(
+                f"infeed-{wid}", f"exit code {w.proc.exitcode}")
+            logger.warning(
+                "infeed worker %d died (exit %s); respawning and "
+                "resubmitting %d batch(es)", wid, w.proc.exitcode,
+                len(w.assigned))
+            self._start_proc(w)
+            for seq in sorted(w.assigned):
+                w.task_q.put((seq, self._tasks[seq]))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._closed:
+            raise StopIteration
+        if self._seq_emit not in self._ready and not self._tasks \
+                and self._exhausted:
+            self.close()
+            raise StopIteration
+        while self._seq_emit not in self._ready:
+            if self._fatal is not None:
+                err, self._fatal = self._fatal, None
+                self.close()
+                raise err
+            try:
+                msg = self._result_q.get(timeout=0.2)
+            except queue_mod.Empty:
+                try:
+                    self._check_workers()
+                except BaseException:
+                    self.close()
+                    raise
+                continue
+            self._handle(msg)
+        out = self._ready.pop(self._seq_emit)
+        if isinstance(out, _RemoteError):
+            self.close()
+            raise out.exc
+        self._seq_emit += 1
+        self._fill()
+        return out
+
+    def close(self):
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
+        try:
+            for w in self._workers.values():
+                try:
+                    w.task_q.put_nowait(None)
+                except Exception:  # noqa: BLE001
+                    pass
+            for w in self._workers.values():
+                if w.proc is not None:
+                    w.proc.join(timeout=1.0)
+            self._tasks.clear()
+            self._ready.clear()
+            base_close = getattr(self._base, "close", None)
+            if base_close is not None:
+                base_close()
+        finally:
+            # segments must not outlive the pool no matter how teardown
+            # went: _reap_pool terminates stragglers and unlinks every
+            # ring (idempotent with the GC backstop)
+            self._finalizer()
+            for w in self._workers.values():
+                for q in (w.task_q, w.free_q):
+                    try:
+                        q.close()
+                        q.cancel_join_thread()
+                    except Exception:  # noqa: BLE001
+                        pass
+            try:
+                self._result_q.close()
+                self._result_q.cancel_join_thread()
+            except Exception:  # noqa: BLE001
+                pass
 
 
 class StagedChunk:
@@ -248,24 +643,73 @@ class DeviceStagingIterator:
             host_close()
 
 
-def resolve_transform_workers(transform_workers: int) -> int:
-    """Resolve the transform-pool size: >= 0 is taken literally (0 =
-    serial in the prefetch thread); negative means auto — size the
-    decode/transform pool from the host core count so the host half can
+def resolve_transform_workers(
+        transform_workers: Optional[int] = None) -> int:
+    """Resolve the transform/decode worker count — THE resolver, consulted
+    by every pool in the package (thread and process infeed backends,
+    image-pipeline decoders, sharded-dataset readers) so
+    ``ZOO_TPU_TRANSFORM_WORKERS`` means one thing everywhere.
+
+    ``None`` reads ``ZOO_TPU_TRANSFORM_WORKERS`` (default auto); >= 0 is
+    taken literally (0 = serial in the prefetch thread); negative means
+    auto — size the pool from the host core count so the host half can
     keep pace with the model's consumption rate. The auto pool is
     clamped to [2, 8]: below 2 a single worker cannot hide per-batch
     transform latency behind the device step, above 8 the ordered
     hand-off queue is the bottleneck, not the pool."""
+    if transform_workers is None:
+        transform_workers = int(
+            os.environ.get("ZOO_TPU_TRANSFORM_WORKERS") or -1)
     if transform_workers >= 0:
         return int(transform_workers)
     return max(2, min(8, os.cpu_count() or 2))
 
 
+INFEED_BACKENDS = ("auto", "thread", "process")
+
+
+def resolve_infeed_backend(backend: Optional[str] = None,
+                           preprocessing=None) -> str:
+    """Pick the transform-pool backend: ``thread`` or ``process``.
+
+    Explicit wins: ``backend`` argument, else ``ZOO_TPU_INFEED_BACKEND``,
+    else ``auto``. Auto chooses ``process`` only when it can actually
+    pay off: the Preprocessing chain declares itself CPU-bound Python
+    (``cpu_bound=True`` — GIL-holding work that threads serialize), the
+    chain survives pickling (spawned workers must reconstruct it), and
+    the host has more than one core. Everything else stays on threads,
+    where numpy's GIL-releasing kernels already scale and the hand-off
+    is cheaper.
+    """
+    b = (backend or os.environ.get("ZOO_TPU_INFEED_BACKEND") or
+         "auto").strip().lower()
+    if b not in INFEED_BACKENDS:
+        raise ValueError(
+            f"ZOO_TPU_INFEED_BACKEND={b!r}: expected one of "
+            f"{INFEED_BACKENDS}")
+    if b != "auto":
+        return b
+    if preprocessing is None or \
+            not getattr(preprocessing, "cpu_bound", False):
+        return "thread"
+    if (os.cpu_count() or 1) < 2:
+        return "thread"
+    try:
+        pickle.dumps(preprocessing)
+    except Exception:  # noqa: BLE001 - closures/lambdas in the chain
+        logger.info("infeed auto backend: cpu_bound chain is not "
+                    "picklable; staying on threads")
+        return "thread"
+    return "process"
+
+
 def build_host_pipeline(fs: FeatureSet, batch_size: int, *,
                         shuffle: bool = False, drop_remainder: bool = True,
                         pad_remainder: bool = False, seed: int = 0,
-                        transform_workers: int = -1,
-                        prefetch_depth: int = 2) -> PrefetchIterator:
+                        transform_workers: Optional[int] = -1,
+                        prefetch_depth: int = 2,
+                        infeed_backend: Optional[str] = None
+                        ) -> PrefetchIterator:
     """Host half of the staged pipeline: (parallel) transform + prefetch.
 
     Returns a closeable iterator of host MiniBatches; wrap it in a
@@ -273,13 +717,16 @@ def build_host_pipeline(fs: FeatureSet, batch_size: int, *,
     only applies when ``fs`` carries a Preprocessing chain
     (TransformedFeatureSet); raw array slicing is already cheap. The
     default (-1) auto-sizes the pool from the host core count
-    (:func:`resolve_transform_workers`).
+    (:func:`resolve_transform_workers`); ``infeed_backend`` selects
+    thread vs process transform workers
+    (:func:`resolve_infeed_backend`).
     """
     transform_workers = resolve_transform_workers(transform_workers)
     kw = dict(shuffle=shuffle, drop_remainder=drop_remainder,
               pad_remainder=pad_remainder, seed=seed)
     if transform_workers > 0 and isinstance(fs, TransformedFeatureSet):
-        it = fs.batches(batch_size, num_workers=transform_workers, **kw)
+        it = fs.batches(batch_size, num_workers=transform_workers,
+                        backend=infeed_backend, **kw)
     else:
         it = fs.batches(batch_size, **kw)
     return PrefetchIterator(it, depth=prefetch_depth)

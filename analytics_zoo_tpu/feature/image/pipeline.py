@@ -160,13 +160,11 @@ class ImagePipelineFeatureSet(FeatureSet):
         self.augment = augment
         self.to_chw = data_format in ("th", "NCHW", "nchw")
         self.mean, self.std = mean, std
-        if num_workers is None:
-            # same knob as the engine's transform pool so one env var
-            # sizes the whole host pipeline
-            env = os.environ.get("ZOO_TPU_TRANSFORM_WORKERS")
-            if env:
-                num_workers = int(env)
-        self.num_workers = int(num_workers or min(8, os.cpu_count() or 1))
+        # same knob as the engine's transform pool so one env var sizes
+        # the whole host pipeline (the shared resolver reads
+        # ZOO_TPU_TRANSFORM_WORKERS and auto-sizes from the core count)
+        from ..host_pipeline import resolve_transform_workers
+        self.num_workers = max(1, resolve_transform_workers(num_workers))
         self.backend = backend
         self.in_flight = int(in_flight or 2 * self.num_workers)
         self.stats = PipelineStats()

@@ -76,6 +76,55 @@ def spawn_supervised(cmd: Sequence[str], env: Dict[str, str], tag: str,
     return SupervisedProc(p, t, tag)
 
 
+class Respawner:
+    """Bounded respawn-on-death policy.
+
+    The supervision loops that restart dead children (the serving fleet,
+    and the process-based infeed pool in
+    :mod:`~analytics_zoo_tpu.feature.host_pipeline`) all need the same
+    decision: *is one more restart of this child allowed, or has it died
+    often enough that the failure is structural and should surface?*
+    This class is only that decision — it spawns nothing itself, so it
+    works for ``subprocess.Popen`` fleets and ``multiprocessing``
+    workers alike.
+
+    A restart budget is per-child (``tag``), with an optional global
+    cap across all children. Exceeding either raises ``RuntimeError``
+    with the death history, which is exactly the prompt-error-surfacing
+    contract the infeed iterators follow.
+    """
+
+    def __init__(self, max_per_child: int = 3,
+                 max_total: Optional[int] = None):
+        self.max_per_child = max_per_child
+        self.max_total = max_total
+        self._per_child: Dict[str, int] = {}
+        self._total = 0
+        self._lock = threading.Lock()
+
+    @property
+    def total_respawns(self) -> int:
+        return self._total
+
+    def note_death(self, tag: str, detail: str = "") -> None:
+        """Record a child death and authorise one respawn of it, or
+        raise ``RuntimeError`` when the budget is exhausted."""
+        with self._lock:
+            n = self._per_child.get(tag, 0) + 1
+            self._per_child[tag] = n
+            self._total += 1
+            if n > self.max_per_child:
+                raise RuntimeError(
+                    f"worker {tag!r} died {n} times "
+                    f"(> {self.max_per_child} respawns allowed)"
+                    + (f": {detail}" if detail else ""))
+            if self.max_total is not None and self._total > self.max_total:
+                raise RuntimeError(
+                    f"{self._total} worker deaths across the pool "
+                    f"(> {self.max_total} total respawns allowed)"
+                    + (f": {detail}" if detail else ""))
+
+
 def terminate_all(procs: Sequence[subprocess.Popen], grace_s: float):
     """SIGTERM everything still alive (workers run their teardown
     handlers), escalate to SIGKILL after ``grace_s``."""
@@ -98,4 +147,4 @@ def terminate_all(procs: Sequence[subprocess.Popen], grace_s: float):
 
 
 __all__: List[str] = ["inject_pythonpath", "pump_lines", "spawn_supervised",
-                      "SupervisedProc", "terminate_all"]
+                      "SupervisedProc", "Respawner", "terminate_all"]

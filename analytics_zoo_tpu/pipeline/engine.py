@@ -785,7 +785,8 @@ class SPMDTrainer:
         it = build_host_pipeline(
             train_set, batch_size, shuffle=True, drop_remainder=True,
             seed=epoch_seed, transform_workers=cfg.transform_workers,
-            prefetch_depth=cfg.prefetch_depth)
+            prefetch_depth=cfg.prefetch_depth,
+            infeed_backend=getattr(cfg, "infeed_backend", None))
         # mid-epoch resume: the epoch order is a pure function of
         # (seed, epoch), so skipping the batches the checkpoint already
         # consumed replays the exact remaining order (bit-exact parity
@@ -797,9 +798,13 @@ class SPMDTrainer:
             for _ in range(self.epoch_batches):
                 if next(it, None) is None:
                     break
+        stats_fn = getattr(train_set, "stats", None)
+        worker_provider = stats_fn().worker_busy_snapshot \
+            if callable(stats_fn) else None
         staging = DeviceStagingIterator(
             it, self._put_batch, self._put_stacked,
-            depth=cfg.device_ahead, monitor=InfeedMonitor())
+            depth=cfg.device_ahead,
+            monitor=InfeedMonitor(worker_provider=worker_provider))
         try:
             self._epoch_loop(staging, step_fn, record, batch_size,
                              time.time(), checkpoint_trigger, validation_set,
@@ -948,6 +953,13 @@ class SPMDTrainer:
                     self.train_summary.add_scalar(
                         "InputBoundFraction",
                         infeed["input_bound_fraction"], self.step)
+                    if "infeed_workers" in infeed:
+                        self.train_summary.add_scalar(
+                            "InfeedWorkers", infeed["infeed_workers"],
+                            self.step)
+                        self.train_summary.add_scalar(
+                            "InfeedWorkerUtilization",
+                            infeed["infeed_worker_utilization"], self.step)
                     if self.flops_per_step:
                         peak = peak_flops(
                             getattr(self.ctx.devices[0], "device_kind", ""))

@@ -22,6 +22,14 @@ Sites and the specs they accept:
     The first ``N`` ``file_io`` byte reads/writes raise
     :class:`TransientFault` (an ``OSError``), exercising the bounded
     retry in :mod:`utils.file_io`.
+``infeed-worker:kill@N``
+    SIGKILL an infeed transform worker (ProcessTransformPool) the first
+    time its per-process item counter reaches ``N`` — mid-epoch, after
+    some batches have already shipped. The pool's workers race for a
+    single exclusive marker so exactly one worker dies, and the
+    respawned replacement never re-fires. Requires
+    ``ZOO_TPU_FAULT_STATE`` (the workers are separate processes; the
+    marker is the only shared state).
 
 One-shot faults must not re-fire after a gang restart (the relaunched
 worker reaches step ``N`` again and would die forever). Point
@@ -124,6 +132,32 @@ def _record_fired(spec: _Spec) -> None:
             f.write("1")
 
 
+def _claim_exclusive(spec: _Spec) -> bool:
+    """Atomically claim a one-shot fault across *processes*.
+
+    Returns True for exactly one caller (exclusive marker create); every
+    other process — including respawned replacements of the victim —
+    loses the race and skips the fault. Without ``ZOO_TPU_FAULT_STATE``
+    there is no cross-process state, so the claim degrades to
+    per-process one-shot (a respawned worker would fire again).
+    """
+    marker = _marker_path(spec)
+    if marker is None:
+        if spec.fired:
+            return False
+        spec.fired = True
+        return True
+    os.makedirs(os.path.dirname(marker), exist_ok=True)
+    try:
+        fd = os.open(marker, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    except FileExistsError:
+        return False
+    with os.fdopen(fd, "w") as f:
+        f.write(str(os.getpid()))
+    spec.fired = True
+    return True
+
+
 def _die(spec: _Spec, detail: str) -> None:
     # SIGKILL: no handlers, no atexit, no flush — the honest crash.
     sys.stderr.write(f"[faults] firing {spec.raw}: {detail}\n")
@@ -144,6 +178,13 @@ def check(site: str, step: Optional[int] = None) -> None:
                     _die(spec, f"step {step} >= {spec.arg}")
                 raise FaultInjected(f"injected failure at step {step} "
                                     f"({spec.raw})")
+        elif site == "infeed-worker":
+            if step is not None and step >= spec.arg \
+                    and not _already_fired(spec) and _claim_exclusive(spec):
+                if spec.action == "kill":
+                    _die(spec, f"infeed item {step} >= {spec.arg}")
+                raise FaultInjected(f"injected infeed failure at item "
+                                    f"{step} ({spec.raw})")
         elif site == "file-io":
             if spec.action == "transient":
                 with _LOCK:

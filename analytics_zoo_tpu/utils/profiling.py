@@ -102,12 +102,22 @@ class InfeedMonitor:
     step loop spent waiting on input — near 0 means compute-bound, near 1
     means the accelerator is starved and more transform workers / a cache
     tier / a wider prefetch would pay off.
+
+    ``worker_provider`` (optional) is a zero-arg callable returning
+    cumulative busy seconds per transform worker (the process infeed
+    pool's ``TransformStats.worker_busy_snapshot``); :meth:`window`
+    diffs consecutive snapshots so the scalars also say *how hard the
+    decode pool itself is working* — a starved step loop with idle
+    workers means the bottleneck is upstream (disk, hand-off), while
+    saturated workers mean the pool needs more processes.
     """
 
-    def __init__(self):
+    def __init__(self, worker_provider=None):
         self._lock = threading.Lock()
         self._wait = 0.0
         self.total_wait = 0.0
+        self._worker_provider = worker_provider
+        self._worker_prev: dict = {}
 
     def input_wait(self, seconds: float):
         with self._lock:
@@ -121,11 +131,24 @@ class InfeedMonitor:
             wait, self._wait = self._wait, 0.0
         steps = max(int(steps), 1)
         wall_s = max(wall_s, 1e-9)
-        return {
+        out = {
             "input_wait_ms_per_step": wait / steps * 1e3,
             "step_time_ms": wall_s / steps * 1e3,
             "input_bound_fraction": min(1.0, wait / wall_s),
         }
+        if self._worker_provider is not None:
+            try:
+                snap = dict(self._worker_provider())
+            except Exception:  # noqa: BLE001 - telemetry must not kill train
+                snap = {}
+            if snap:
+                busy = [max(0.0, snap[w] - self._worker_prev.get(w, 0.0))
+                        for w in snap]
+                self._worker_prev = snap
+                out["infeed_workers"] = float(len(snap))
+                out["infeed_worker_utilization"] = min(
+                    1.0, sum(busy) / (len(busy) * wall_s))
+        return out
 
 
 def inference_window(monitor: "InfeedMonitor", n_batches: int,

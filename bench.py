@@ -1522,8 +1522,46 @@ def bench_infeed(n_images=480, batch_size=32):
     # transform pool kept pace with the model's consumption rate
     mean_wait_s = float(np.mean(steady)) if steady else 0.0
     input_bound = mean_wait_s / (mean_wait_s + step_s) if step_s else 0.0
+
+    # worker-count sweep: double the pool until the aggregate decode rate
+    # feeds the MEASURED ResNet-50 consumption (2,539 img/s at batch 256,
+    # r5) or adding workers stops paying (the host ran out of cores) —
+    # then record where saturation happened and the per-worker scaling
+    # curve, so capacity planning reads straight off the bench row.
+    target = 2539.0
+    curve = {}
+    best_rate, saturation_w, prev_rate = 0.0, workers, None
+    w = 1
+    max_w = max(workers, 4 * (os.cpu_count() or 1))
+    while w <= max_w:
+        sfs = ImagePipelineFeatureSet(all_paths, labels, height=224,
+                                      width=224, num_workers=w)
+        t0 = time.perf_counter()
+        n_done = sum(b.inputs[0].shape[0]
+                     for b in sfs.batches(batch_size))
+        rate = n_done / max(time.perf_counter() - t0, 1e-9)
+        curve[str(w)] = round(rate, 1)
+        if rate > best_rate:
+            best_rate, saturation_w = rate, w
+        if rate >= target:
+            break
+        if prev_rate is not None and rate < prev_rate * 1.15:
+            break  # scaling plateaued: out of cores, not out of workers
+        prev_rate = rate
+        w *= 2
+
+    # the hard gate the tentpole promises: with the pool sized by the
+    # sweep, the simulated trainer must spend <= 10% of its step cadence
+    # blocked on input
+    _gate("infeed_input_bound_fraction", input_bound <= 0.1,
+          f"{input_bound:.4f} > 0.1 (workers={workers})")
     return {
         "infeed_input_bound_fraction": round(input_bound, 4),
+        "infeed_aggregate_img_per_s": round(best_rate, 1),
+        "infeed_saturation_workers": saturation_w,
+        "infeed_worker_curve": curve,
+        "infeed_target_img_per_s": target,
+        "infeed_target_met": best_rate >= target,
         "infeed_img_per_s": round(cap, 1),
         "infeed_img_per_s_per_core": round(per_core, 1),
         "infeed_cores_for_1300_img_s": round(1300.0 / per_core, 1),
@@ -1537,6 +1575,71 @@ def bench_infeed(n_images=480, batch_size=32):
         "infeed_workers": workers,
         "infeed_real_jpegs": bool(_glob.glob(
             os.path.join(CAT_DOG, "*", "*.jpg"))),
+    }
+
+
+def _gil_bound_transform(batch):
+    """Pure-Python per-batch work (~ms, GIL held throughout) — the decode
+    profile threads cannot parallelize. Module-level so the spawned
+    process-backend workers can unpickle it by reference."""
+    from analytics_zoo_tpu.feature.feature_set import MiniBatch
+
+    acc = 0
+    for i in range(120_000):
+        acc += i & 7
+    scale = 2.0 if acc else 0.0
+    return MiniBatch(tuple(x * scale for x in batch.inputs),
+                     batch.targets, batch.weights)
+
+
+def bench_infeed_backend(n_batches=48, batch_size=32):
+    """Thread vs process infeed backend A/B (docs/data-pipeline.md).
+
+    The same GIL-*holding* Preprocessing chain (pure-Python loop, the
+    PIL-decode profile) at EQUAL worker counts: the thread pool
+    serializes on the GIL while ``ProcessTransformPool`` runs the chain
+    in spawned workers and returns batches through shared-memory rings.
+    Rates are steady-state (first yield to last — pool spin-up excluded).
+    On a multi-core host the process backend must win by >= 2x (gated);
+    a single-core host cannot show the win, so the gate is skipped and
+    the measured ratio is recorded for the curve instead.
+    """
+    from analytics_zoo_tpu.feature.common import LambdaPreprocessing
+    from analytics_zoo_tpu.feature.feature_set import FeatureSet
+
+    n = n_batches * batch_size
+    base = FeatureSet.array(
+        np.arange(n * 4, dtype=np.float32).reshape(n, 4),
+        np.zeros(n, np.float32))
+    workers = max(2, min(4, os.cpu_count() or 1))
+
+    def rate(backend):
+        fs = base.transform(
+            LambdaPreprocessing(_gil_bound_transform, cpu_bound=True))
+        it = fs.batches(batch_size, num_workers=workers, backend=backend)
+        t_first, got = None, 0
+        for _b in it:
+            got += 1
+            if t_first is None:
+                t_first = time.perf_counter()
+        wall = max(time.perf_counter() - t_first, 1e-9)
+        assert got == n_batches, (backend, got, n_batches)
+        return (got - 1) / wall
+
+    thread_rate = rate("thread")
+    process_rate = rate("process")
+    speedup = process_rate / max(thread_rate, 1e-9)
+    multi_core = (os.cpu_count() or 1) >= 2
+    if multi_core:
+        _gate("infeed_process_speedup", speedup >= 2.0,
+              f"process {process_rate:.1f} vs thread {thread_rate:.1f} "
+              f"batches/s at {workers} workers = {speedup:.2f}x < 2x")
+    return {
+        "infeed_thread_batches_per_s": round(thread_rate, 2),
+        "infeed_process_batches_per_s": round(process_rate, 2),
+        "infeed_process_speedup": round(speedup, 2),
+        "infeed_backend_workers": workers,
+        "infeed_backend_gated": multi_core,
     }
 
 
@@ -1968,13 +2071,29 @@ def main():
         except Exception as e:  # noqa: BLE001
             RESULT["infeed_error"] = (str(e).splitlines()[0][:500]
                                       if str(e) else repr(e)[:500])
-        # on TPU rounds the input-bound fraction is load-bearing (it is
-        # the denominator the MFU targets assume) — its absence means
+        # the input-bound fraction is load-bearing on every platform (it
+        # is the denominator the MFU targets assume) — its absence means
         # the infeed leg silently lost the measurement, so gate hard
-        if info["platform"] == "tpu":
-            _gate("infeed_input_bound_fraction_reported",
-                  "infeed_input_bound_fraction" in RESULT,
-                  RESULT.get("infeed_error", "key missing"))
+        # instead of letting the swallowed exception read as a pass
+        _gate("infeed_input_bound_fraction_reported",
+              "infeed_input_bound_fraction" in RESULT,
+              RESULT.get("infeed_error", "key missing"))
+        emit()
+
+    # Infeed backend A/B — thread vs process transform pool on a
+    # GIL-holding chain at equal workers; the process pool's shared-memory
+    # hand-off must win >= 2x on a multi-core host
+    # (docs/data-pipeline.md).
+    if time.time() - T_START < TOTAL_BUDGET_S * 0.91:
+        try:
+            RESULT.update(bench_infeed_backend())
+        except Exception as e:  # noqa: BLE001
+            import traceback
+            traceback.print_exc()
+            RESULT["infeed_backend_error"] = (str(e).splitlines()[0][:500]
+                                              if str(e) else repr(e)[:500])
+            _gate("infeed_backend_measured", False,
+                  RESULT["infeed_backend_error"])
         emit()
 
     # Staged host pipeline leg — serial vs transform-pool/staging overlap

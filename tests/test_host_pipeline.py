@@ -1,7 +1,12 @@
 """Staged host input pipeline (PR 3): parallel transform pool, device-ahead
-staging, DRAM cache tier, PrefetchIterator fixes, input-bound telemetry."""
+staging, DRAM cache tier, PrefetchIterator fixes, input-bound telemetry.
+PR 10 adds the process infeed backend (spawned workers + shared-memory
+rings) and the disk-backed DIRECT cache arena."""
 
 import logging
+import os
+import subprocess
+import sys
 import threading
 import time
 
@@ -14,6 +19,7 @@ from analytics_zoo_tpu.feature.feature_set import (FeatureSet, MiniBatch,
                                                    TransformedFeatureSet)
 from analytics_zoo_tpu.feature.host_pipeline import (DeviceStagingIterator,
                                                      ParallelTransformIterator,
+                                                     ProcessTransformPool,
                                                      build_host_pipeline)
 
 
@@ -23,9 +29,17 @@ def _array_fs(n=64, dim=4):
     return FeatureSet.array(x, y)
 
 
+# module-level (not nested) so the spawned process-backend workers can
+# unpickle them by reference
 def _double(batch):
     return MiniBatch(tuple(x * 2.0 for x in batch.inputs),
                      batch.targets, batch.weights)
+
+
+def _boom_at_24(batch):
+    if float(np.asarray(batch.targets)[0]) == 24.0:  # 4th batch of 8
+        raise ValueError("boom at 24")
+    return _double(batch)
 
 
 # ---------------------------------------------------------------------------
@@ -458,8 +472,6 @@ def test_resolve_transform_workers_auto_and_literal():
     """transform_workers=-1 auto-sizes the transform pool to the host's
     core count clamped to [2, 8]; literal values (including 0 = inline)
     pass through untouched."""
-    import os
-
     from analytics_zoo_tpu.feature.host_pipeline import (
         resolve_transform_workers)
 
@@ -468,3 +480,254 @@ def test_resolve_transform_workers_auto_and_literal():
     assert 2 <= auto <= 8
     assert resolve_transform_workers(0) == 0
     assert resolve_transform_workers(5) == 5
+
+
+def test_resolve_transform_workers_env(monkeypatch):
+    """ZOO_TPU_TRANSFORM_WORKERS is THE sizing knob: None reads it; a
+    literal argument still wins over the env."""
+    from analytics_zoo_tpu.feature.host_pipeline import (
+        resolve_transform_workers)
+
+    monkeypatch.setenv("ZOO_TPU_TRANSFORM_WORKERS", "5")
+    assert resolve_transform_workers(None) == 5
+    assert resolve_transform_workers(3) == 3
+    monkeypatch.setenv("ZOO_TPU_TRANSFORM_WORKERS", "-1")
+    assert resolve_transform_workers(None) == \
+        max(2, min(8, os.cpu_count() or 2))
+    monkeypatch.delenv("ZOO_TPU_TRANSFORM_WORKERS")
+    assert resolve_transform_workers(None) >= 2  # auto default
+
+
+def test_resolve_infeed_backend(monkeypatch):
+    from analytics_zoo_tpu.feature.host_pipeline import (
+        resolve_infeed_backend)
+
+    monkeypatch.delenv("ZOO_TPU_INFEED_BACKEND", raising=False)
+    # auto: numpy-ish chain stays on threads
+    assert resolve_infeed_backend(None, LambdaPreprocessing(_double)) \
+        == "thread"
+    # auto: cpu-bound picklable chain goes to processes iff > 1 core
+    chain = LambdaPreprocessing(_double, cpu_bound=True)
+    expect = "process" if (os.cpu_count() or 1) >= 2 else "thread"
+    assert resolve_infeed_backend(None, chain) == expect
+    # auto: cpu-bound but unpicklable stays on threads
+    lam = LambdaPreprocessing(lambda b: b, cpu_bound=True)
+    assert resolve_infeed_backend(None, lam) == "thread"
+    # explicit argument and env both override auto; argument wins
+    assert resolve_infeed_backend("process", LambdaPreprocessing(_double)) \
+        == "process"
+    monkeypatch.setenv("ZOO_TPU_INFEED_BACKEND", "process")
+    assert resolve_infeed_backend(None, LambdaPreprocessing(_double)) \
+        == "process"
+    assert resolve_infeed_backend("thread", chain) == "thread"
+    monkeypatch.setenv("ZOO_TPU_INFEED_BACKEND", "bogus")
+    with pytest.raises(ValueError, match="bogus"):
+        resolve_infeed_backend(None, chain)
+
+
+# ---------------------------------------------------------------------------
+# ProcessTransformPool: spawned workers + shared-memory rings (PR 10)
+# ---------------------------------------------------------------------------
+class TestProcessTransformPool:
+    def _pool(self, fs=None, n=64, workers=2, fn=_double):
+        fs = fs or _array_fs(n=n)
+        return ProcessTransformPool(fs.batches(8), LambdaPreprocessing(fn),
+                                    num_workers=workers)
+
+    def test_order_and_values_match_thread_backend(self):
+        base = _array_fs()
+        ref = list(ParallelTransformIterator(
+            base.batches(8), LambdaPreprocessing(_double), num_workers=2))
+        pool = self._pool()
+        got = list(pool)
+        assert len(got) == len(ref) == 8
+        for a, b in zip(ref, got):
+            np.testing.assert_array_equal(a.inputs[0], b.inputs[0])
+            np.testing.assert_array_equal(a.targets, b.targets)
+            np.testing.assert_array_equal(a.weights, b.weights)
+
+    def test_worker_error_reraised_at_position(self):
+        pool = self._pool(fn=_boom_at_24)
+        out = [next(pool) for _ in range(3)]
+        assert [float(b.targets[0]) for b in out] == [0.0, 8.0, 16.0]
+        with pytest.raises(ValueError, match="boom at 24"):
+            next(pool)
+        with pytest.raises(StopIteration):
+            next(pool)  # closed after the error
+
+    def test_close_unlinks_ring_segments(self):
+        from multiprocessing import shared_memory
+
+        pool = self._pool()
+        names = [w.segment.shm.name for w in pool._workers.values()]
+        next(pool)
+        pool.close()
+        pool.close()  # idempotent
+        for name in names:
+            with pytest.raises(FileNotFoundError):
+                shared_memory.SharedMemory(name=name)
+
+    def test_unpicklable_chain_rejected_upfront(self):
+        with pytest.raises(ValueError, match="picklable"):
+            ProcessTransformPool(_array_fs().batches(8),
+                                 LambdaPreprocessing(lambda b: b),
+                                 num_workers=2)
+
+    def test_per_worker_stats_recorded(self):
+        from analytics_zoo_tpu.feature.feature_set import TransformStats
+
+        stats = TransformStats()
+        fs = _array_fs()
+        pool = ProcessTransformPool(fs.batches(8),
+                                    LambdaPreprocessing(_double),
+                                    num_workers=2, stats=stats)
+        list(pool)
+        s = stats.as_dict()
+        assert s["batches_transformed"] == 8
+        assert sum(s["worker_items"].values()) == 8
+        assert set(s["worker_items"]) == {0, 1}  # both workers pulled
+
+
+@pytest.mark.parametrize("backend", ["thread", "process"])
+def test_backend_parity_over_parquet(tmp_path, backend):
+    """Thread and process backends must produce bit-identical epochs over
+    a real parquet fixture, including the DRAM->DIRECT spill boundary and
+    a second (cached) epoch."""
+    pd = pytest.importorskip("pandas")
+    pytest.importorskip("pyarrow")
+
+    rng = np.random.default_rng(5)
+    paths = []
+    for i in range(3):
+        df = pd.DataFrame({"a": rng.standard_normal(16),
+                           "b": rng.standard_normal(16),
+                           "label": rng.integers(0, 2, 16)})
+        p = str(tmp_path / f"shard{i}.parquet")
+        df.to_parquet(p, index=False)
+        paths.append(p)
+
+    def build():
+        fs = FeatureSet.files(paths, label_col="label",
+                              shard_per_host=False)
+        tfs = fs.transform(LambdaPreprocessing(_double, cpu_bound=True))
+        # DRAM budget below the epoch: the tail must spill to the arena
+        tfs.cache(600, arena_path=str(tmp_path / f"{backend}.arena"))
+        return tfs
+
+    ref = list(
+        FeatureSet.files(paths, label_col="label", shard_per_host=False)
+        .transform(LambdaPreprocessing(_double))
+        .batches(8))
+
+    tfs = build()
+    e1 = list(tfs.batches(8, num_workers=2, backend=backend))
+    assert len(e1) == len(ref) == 6
+    for a, b in zip(ref, e1):
+        np.testing.assert_array_equal(a.inputs[0], b.inputs[0])
+        np.testing.assert_array_equal(a.targets, b.targets)
+    s1 = tfs.stats().as_dict()
+    assert s1["batches_transformed"] == 6
+
+    # second epoch: replays RAM prefix + arena tail, zero re-transforms
+    e2 = list(tfs.batches(8, num_workers=2, backend=backend))
+    s2 = tfs.stats().as_dict()
+    assert s2["batches_transformed"] == 6, "cached epoch re-transformed"
+    assert s2["arena_hits"] > 0, "tail never spilled to the arena"
+    for a, b in zip(e1, e2):
+        np.testing.assert_array_equal(a.inputs[0], b.inputs[0])
+        np.testing.assert_array_equal(a.targets, b.targets)
+
+
+# ---------------------------------------------------------------------------
+# DIRECT arena: cross-process replay + chaos (PR 10)
+# ---------------------------------------------------------------------------
+class TestDirectArena:
+    def test_cross_process_replay_zero_transforms(self, tmp_path):
+        arena = str(tmp_path / "x.arena")
+        tfs = _array_fs().transform(LambdaPreprocessing(_double))
+        tfs.cache(500, arena_path=arena)  # tiny DRAM prefix, big spill
+        e1 = list(tfs.batches(8))
+        assert tfs.stats().as_dict()["batches_transformed"] == 8
+
+        script = (
+            "import sys, numpy as np\n"
+            "from analytics_zoo_tpu.feature.feature_set import FeatureSet\n"
+            "from analytics_zoo_tpu.feature.common import "
+            "LambdaPreprocessing\n"
+            "x = np.arange(256, dtype=np.float32).reshape(64, 4)\n"
+            "y = np.arange(64, dtype=np.float32)\n"
+            "tfs = FeatureSet.array(x, y).transform("
+            "LambdaPreprocessing(lambda b: b))\n"
+            f"tfs.cache(500, arena_path={arena!r})\n"
+            "out = list(tfs.batches(8))\n"
+            "s = tfs.stats().as_dict()\n"
+            "assert s['batches_transformed'] == 0, s\n"
+            "assert s['arena_hits'] == 8, s\n"
+            "print(out[0].inputs[0][0, 0], out[-1].inputs[0][-1, -1])\n")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env["PYTHONPATH"] = os.pathsep.join(
+            [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+            + env.get("PYTHONPATH", "").split(os.pathsep))
+        r = subprocess.run([sys.executable, "-c", script], env=env,
+                           capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stderr
+        first, last = r.stdout.split()
+        assert float(first) == float(e1[0].inputs[0][0, 0])
+        assert float(last) == float(e1[-1].inputs[0][-1, -1])
+
+    def test_arena_not_committed_on_partial_epoch(self, tmp_path):
+        arena = str(tmp_path / "p.arena")
+        tfs = _array_fs().transform(LambdaPreprocessing(_double))
+        tfs.cache(500, arena_path=arena)
+        it = tfs.batches(8)
+        next(it)
+        it.close()  # abandoned epoch: nothing may publish
+        assert not tfs._arena.has("8:1:0", tfs._fingerprint())
+        assert not os.path.exists(arena + ".lock")  # writer lock released
+        # next full epoch transforms and commits normally
+        list(tfs.batches(8))
+        assert tfs._arena.has("8:1:0", tfs._fingerprint())
+
+    def test_chaos_worker_kill_respawns_complete_epoch(self, tmp_path,
+                                                       monkeypatch):
+        """ZOO_TPU_FAULT=infeed-worker:kill@N mid-epoch: the pool must
+        respawn the dead worker, resubmit its in-flight batches, and the
+        epoch must come out complete, duplicate-free and bit-identical —
+        with no shared-memory segment leaked."""
+        monkeypatch.setenv("ZOO_TPU_FAULT", "infeed-worker:kill@2")
+        monkeypatch.setenv("ZOO_TPU_FAULT_STATE", str(tmp_path))
+        fs = _array_fs(n=128)
+        ref = list(fs.transform(LambdaPreprocessing(_double)).batches(8))
+        pool = ProcessTransformPool(fs.batches(8),
+                                    LambdaPreprocessing(_double),
+                                    num_workers=2)
+        got = list(pool)
+        assert os.path.exists(
+            str(tmp_path / "fired.infeed-worker_kill_2")), \
+            "fault never fired"
+        assert pool.respawns >= 1
+        assert len(got) == len(ref) == 16  # complete, no dups, no drops
+        for a, b in zip(ref, got):
+            np.testing.assert_array_equal(a.inputs[0], b.inputs[0])
+            np.testing.assert_array_equal(a.targets, b.targets)
+
+
+def test_data_smoke_end_to_end():
+    """The scripts/data-smoke CI hook (all legs: staged, DRAM cache,
+    process backend, DIRECT arena + second-process reader, chaos)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+        + env.get("PYTHONPATH", "").split(os.pathsep))
+    env.pop("ZOO_TPU_FAULT", None)
+    env.pop("ZOO_TPU_FAULT_STATE", None)
+    r = subprocess.run(
+        [sys.executable, "-m", "analytics_zoo_tpu.feature.data_smoke",
+         "--batches", "8", "--batch", "8", "--transform-ms", "1"],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, (r.stdout[-1000:], r.stderr[-1000:])
+    import json
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["errors"] == []
+    assert out["process_stats"]["worker_items"]
+    assert out["direct_stats"]["arena_hits"] > 0
