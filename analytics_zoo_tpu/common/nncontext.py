@@ -142,6 +142,14 @@ class ZooConfig:
     # train() — set by zoo-launch's on_failure=restart attempts
     # (ZOO_TPU_AUTO_RESUME); a plain fit() stays a fresh run by default
     auto_resume: bool = False
+    # unified telemetry spine (utils/telemetry.py): span tracer + metrics
+    # registry + flight recorder. Off by default — the disabled span path
+    # is a single global check (guarded by tests/test_telemetry.py).
+    telemetry: bool = False
+    # when set (and telemetry on): Chrome-trace JSON + periodic atomic
+    # metrics.json per process land here; fault-path flight dumps go to
+    # <trace_dir>/debug/. `--trace-dir` on zoo-launch/zoo-serving sets it.
+    trace_dir: Optional[str] = None
     # NNFrames ingest: when the processed samples of a DataFrame would
     # exceed this many bytes, NNEstimator.fit spills them to sharded .npz
     # files and streams (ShardedFileFeatureSet) instead of holding the
@@ -190,6 +198,7 @@ class ZooContext:
 
         self.config = config or ZooConfig.from_env()
         _maybe_enable_compile_cache(self.config)
+        _maybe_enable_telemetry(self.config)
         self.devices = list(devices) if devices is not None else jax.devices()
         self.process_index = jax.process_index()
         self.num_processes = jax.process_count()
@@ -278,6 +287,21 @@ def _maybe_enable_compile_cache(cfg: ZooConfig):
     except Exception:  # noqa: BLE001
         pass
     logger.info("persistent compilation cache -> %s", directory)
+
+
+def _maybe_enable_telemetry(cfg: ZooConfig):
+    """Arm the telemetry spine from ``ZooConfig.telemetry`` /
+    ``trace_dir`` (env: ``ZOO_TPU_TELEMETRY`` / ``ZOO_TPU_TRACE_DIR``).
+    Only ever turns telemetry ON — an env-enabled run (zoo-launch
+    --trace-dir exports to every worker) is not switched off by the
+    default config."""
+    from ..utils import telemetry
+
+    if not (cfg.telemetry or telemetry.enabled()):
+        return
+    rank = os.environ.get("ZOO_TPU_PROCESS_ID", "0")
+    telemetry.configure(enabled=True, trace_dir=cfg.trace_dir,
+                        service=f"train-worker-{rank}")
 
 
 def _can_use_mesh_utils(shape, n):

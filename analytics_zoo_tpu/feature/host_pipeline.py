@@ -36,6 +36,8 @@ import time
 
 import numpy as np
 
+from ..utils import telemetry
+from ..utils.telemetry import span
 from .feature_set import (FeatureSet, MiniBatch, PrefetchIterator,
                           TransformedFeatureSet, minibatch_len,
                           register_pipeline)
@@ -78,7 +80,13 @@ class ParallelTransformIterator:
             except StopIteration:
                 self._exhausted = True
                 break
-            self._futures.append(self._pool.submit(self._fn, item))
+            self._futures.append(self._pool.submit(self._run, item))
+
+    def _run(self, item):
+        # runs on a pool thread: the span lands on the zoo-transform
+        # thread's timeline in the exported trace
+        with span("infeed/transform"):
+            return self._fn(item)
 
     def __iter__(self):
         return self
@@ -383,6 +391,12 @@ class ProcessTransformPool:
 
     def _handle(self, msg):
         kind, wid, seq = msg[0], msg[1], msg[2]
+        if kind == "spans":
+            # telemetry side-channel: replay the worker's span events
+            # under its real pid so the trace shows a per-worker timeline
+            telemetry.ingest_events(
+                msg[3], pid=seq, process_name=f"zoo-infeed-{wid}")
+            return
         if kind == "fatal":
             # the worker can't run at all (chain failed to unpickle in
             # the spawned interpreter): surface on the next __next__
@@ -567,7 +581,8 @@ class DeviceStagingIterator:
             return None
         t0 = time.perf_counter()
         try:
-            hb = next(self._host_it)
+            with span("infeed/wait"):
+                hb = next(self._host_it)
         except StopIteration:
             self._eof = True
             return None
@@ -673,7 +688,10 @@ def resolve_infeed_backend(backend: Optional[str] = None,
     """Pick the transform-pool backend: ``thread`` or ``process``.
 
     Explicit wins: ``backend`` argument, else ``ZOO_TPU_INFEED_BACKEND``,
-    else ``auto``. Auto chooses ``process`` only when it can actually
+    else ``auto`` — an explicit ``"auto"`` (the ZooConfig default the
+    engine always passes) also defers to the env var, so
+    ``ZOO_TPU_INFEED_BACKEND=process`` reaches an unmodified training
+    script. Auto chooses ``process`` only when it can actually
     pay off: the Preprocessing chain declares itself CPU-bound Python
     (``cpu_bound=True`` — GIL-holding work that threads serialize), the
     chain survives pickling (spawned workers must reconstruct it), and
@@ -681,8 +699,10 @@ def resolve_infeed_backend(backend: Optional[str] = None,
     where numpy's GIL-releasing kernels already scale and the hand-off
     is cheaper.
     """
-    b = (backend or os.environ.get("ZOO_TPU_INFEED_BACKEND") or
-         "auto").strip().lower()
+    b = (backend or "auto").strip().lower()
+    if b == "auto":
+        b = (os.environ.get("ZOO_TPU_INFEED_BACKEND") or
+             "auto").strip().lower()
     if b not in INFEED_BACKENDS:
         raise ValueError(
             f"ZOO_TPU_INFEED_BACKEND={b!r}: expected one of "

@@ -30,10 +30,17 @@ Wire protocol (one message per task, on the shared result queue):
     unpickle — e.g. it references names the spawned interpreter cannot
     import). The parent surfaces this immediately instead of burning
     the respawn budget on a structurally-broken worker.
+``("spans", wid, pid, events)``
+    Telemetry only (shipped when ``ZOO_TPU_TELEMETRY`` is on, inherited
+    through the spawn env): compact span-event tuples recorded around
+    this worker's transforms. The parent ingests them under the
+    worker's own pid so the exported Chrome trace shows a timeline per
+    infeed worker process.
 """
 
 from __future__ import annotations
 
+import os
 import pickle
 import time
 import traceback
@@ -163,7 +170,18 @@ def worker_main(wid: int, shm_name: Optional[str], slot_bytes: int,
     transform, before the result ships — so an injected kill genuinely
     loses a batch mid-flight and the parent must recover it.
     """
-    from ..utils import faults
+    from ..utils import faults, telemetry
+
+    tracing = telemetry.enabled()
+    if tracing:
+        # spans recorded here are drained into compact tuples and shipped
+        # on the result queue; the parent replays them under this pid
+        telemetry.enable_forwarding()
+
+    def _ship_spans() -> None:
+        evs = telemetry.drain_events()
+        if evs:
+            result_q.put(("spans", wid, os.getpid(), evs))
 
     try:
         fn = pickle.loads(fn_payload)
@@ -180,24 +198,35 @@ def worker_main(wid: int, shm_name: Optional[str], slot_bytes: int,
             seq, batch = task
             t0 = time.perf_counter()
             try:
-                out = fn(batch)
+                with telemetry.span("infeed/transform", seq=seq, wid=wid):
+                    out = fn(batch)
                 items += 1
                 faults.check("infeed-worker", items)
             except BaseException as e:  # noqa: BLE001 - ship to parent
                 result_q.put(("err", wid, seq, _encode_error(e)))
+                if tracing:
+                    _ship_spans()
                 continue
             elapsed = time.perf_counter() - t0
+            shipped = False
             if shm is not None:
                 arrays, template = flatten_batch(out)
                 if arrays is not None and slot_nbytes(arrays) <= slot_bytes:
                     slot = _acquire_slot(free_q)
                     if slot is not None:
-                        metas = write_slot(shm.buf, slot * slot_bytes,
-                                           arrays)
+                        with telemetry.span("infeed/slot_write", seq=seq):
+                            metas = write_slot(shm.buf, slot * slot_bytes,
+                                               arrays)
                         result_q.put(("shm", wid, seq, slot, metas,
                                       template, elapsed))
-                        continue
-            result_q.put(("pkl", wid, seq, pickle.dumps(out, -1), elapsed))
+                        shipped = True
+            if not shipped:
+                result_q.put(("pkl", wid, seq, pickle.dumps(out, -1),
+                              elapsed))
+            if tracing:
+                _ship_spans()
     finally:
+        if tracing:
+            _ship_spans()
         if shm is not None:
             shm.close()

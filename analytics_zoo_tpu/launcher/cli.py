@@ -52,6 +52,11 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--coordinator-port", type=int, default=None,
                     help="fixed coordination-service port (default: an "
                          "OS-assigned free port)")
+    ap.add_argument("--trace-dir", default=None, metavar="DIR",
+                    help="enable telemetry: every worker writes "
+                         "trace-<pid>.json + metrics-<pid>.json here, "
+                         "and the launcher records gang lifecycle events "
+                         "(see docs/observability.md)")
     ap.add_argument("--no-prefix", action="store_true",
                     help="disable the [worker-N] log line prefixes")
     ap.add_argument("script", help="training script to run on every host")
@@ -79,7 +84,8 @@ def main(argv=None) -> int:
                       coordinator_port=args.coordinator_port,
                       prefix=not args.no_prefix,
                       max_restarts=args.max_restarts,
-                      restart_backoff_s=args.restart_backoff_s)
+                      restart_backoff_s=args.restart_backoff_s,
+                      trace_dir=args.trace_dir)
     except LaunchError as e:
         print(f"zoo-launch: {e}", file=sys.stderr)
         return 2

@@ -43,6 +43,7 @@ import threading
 import time
 from typing import Dict, IO, List, NamedTuple, Optional, Sequence
 
+from ..utils import telemetry
 from .supervisor import (inject_pythonpath, pump_lines, spawn_supervised,
                          terminate_all)
 
@@ -130,10 +131,20 @@ def launch(script_argv: Sequence[str], num_hosts: Optional[int] = None,
            coordinator_port: Optional[int] = None,
            grace_s: float = 10.0, stream=None, prefix: bool = True,
            python: Optional[str] = None, max_restarts: int = 3,
-           restart_backoff_s: float = 1.0) -> int:
+           restart_backoff_s: float = 1.0,
+           trace_dir: Optional[str] = None) -> int:
     """Run ``script_argv`` (a train script + its args) as a multi-process
     job. See module docstring for the env contract and failure policy.
-    Returns the first nonzero worker exit code, or 0."""
+    Returns the first nonzero worker exit code, or 0.
+
+    ``trace_dir`` turns on telemetry for the launcher *and* (via the
+    exported ``ZOO_TPU_TELEMETRY`` / ``ZOO_TPU_TRACE_DIR`` env) every
+    worker: each process writes its own ``trace-<pid>.json`` +
+    ``metrics-<pid>.json`` there, and the launcher records gang
+    lifecycle events (spawn, exit, restart, drain)."""
+    if trace_dir is not None:
+        telemetry.configure(enabled=True, trace_dir=trace_dir,
+                            service="launcher")
     if on_failure not in ("kill-all", "report", "restart"):
         raise LaunchError(
             f"on_failure must be 'kill-all', 'report' or 'restart', got "
@@ -180,16 +191,23 @@ def launch(script_argv: Sequence[str], num_hosts: Optional[int] = None,
                     "on-failure=%s%s: %s", world, coordinator, on_failure,
                     f" (attempt {attempt + 1})" if attempt else "",
                     " ".join(shlex.quote(c) for c in cmd_tail))
+        telemetry.event("launch/gang_start", world=world,
+                        attempt=attempt + 1, coordinator=coordinator)
         first_rc, failed_pid = _run_gang(
             cmd_tail, world, coordinator, base_env, extra_env, on_failure,
             grace_s, stream, lock, prefix, python)
         if first_rc == 0:
+            telemetry.event("launch/job_complete", world=world,
+                            attempts=attempt + 1)
             with lock:
                 stream.write(f"[zoo-launch] job complete: {world} "
                              f"worker(s) exited 0\n")
                 stream.flush()
             return 0
         if on_failure != "restart" or attempt >= max_restarts:
+            telemetry.event("launch/job_failed", rc=first_rc,
+                            failed_worker=failed_pid,
+                            attempts=attempt + 1)
             if on_failure == "restart":
                 with lock:
                     stream.write(
@@ -200,6 +218,9 @@ def launch(script_argv: Sequence[str], num_hosts: Optional[int] = None,
             return first_rc
         attempt += 1
         delay = restart_backoff_s * (2 ** (attempt - 1))
+        telemetry.event("launch/gang_restart", rc=first_rc,
+                        failed_worker=failed_pid, attempt=attempt,
+                        delay_s=delay)
         with lock:
             stream.write(
                 f"[zoo-launch] worker-{failed_pid} rc={first_rc}: "
@@ -229,6 +250,8 @@ def _run_gang(cmd_tail: List[str], world: int, coordinator: str,
                 prefix=prefix)
             procs.append(sp.proc)
             pumps.append(sp.pump)
+            telemetry.event("launch/worker_spawn", worker=pid,
+                            os_pid=sp.proc.pid)
     except BaseException:
         _terminate_all(procs, grace_s)
         raise
@@ -243,6 +266,7 @@ def _run_gang(cmd_tail: List[str], world: int, coordinator: str,
             if rc is None:
                 continue
             pending.discard(pid)
+            telemetry.event("launch/worker_exit", worker=pid, rc=rc)
             if rc != 0:
                 with lock:
                     stream.write(
@@ -252,6 +276,8 @@ def _run_gang(cmd_tail: List[str], world: int, coordinator: str,
                     first_rc, failed_pid = rc, pid
                 if on_failure in ("kill-all", "restart") and not killed \
                         and pending:
+                    telemetry.event("launch/terminate_survivors",
+                                    n=len(pending), failed_worker=pid)
                     with lock:
                         stream.write(
                             f"[zoo-launch] on-failure={on_failure}: "

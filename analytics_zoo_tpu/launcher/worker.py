@@ -38,8 +38,24 @@ def _grace_s() -> float:
         return 30.0
 
 
+def _flight(reason: str, **args):
+    """Flight-recorder dump via sys.modules (not an import: this worker
+    shim stays light, and a process that never loaded telemetry has
+    nothing worth dumping anyway)."""
+    tel = sys.modules.get("analytics_zoo_tpu.utils.telemetry")
+    if tel is None:
+        return
+    try:
+        tel.event("launch/worker_signal", **args)
+        tel.dump_flight(reason)
+    except Exception:  # noqa: BLE001 - teardown must proceed
+        pass
+
+
 def _hard_exit(signum: int):
     rank = os.environ.get("ZOO_TPU_PROCESS_ID", "?")
+    _flight(f"worker {rank} hard exit on signal {signum}",
+            rank=rank, signal=signum, drain=False)
     try:
         from analytics_zoo_tpu.feature.feature_set import \
             shutdown_all_pipelines
@@ -64,6 +80,13 @@ def _shutdown_handler(signum, frame):  # noqa: ARG001 - signal signature
         print(f"[launcher.worker {rank}] SIGTERM: draining — checkpoint "
               f"at next step boundary (grace {_grace_s():.0f}s)",
               file=sys.stderr, flush=True)
+        tel = sys.modules.get("analytics_zoo_tpu.utils.telemetry")
+        if tel is not None:
+            try:
+                tel.event("launch/drain_requested", rank=rank,
+                          signal=signum)
+            except Exception:  # noqa: BLE001
+                pass
         engine.request_preemption()
         t = threading.Timer(_grace_s(), _hard_exit, args=(signum,))
         t.daemon = True

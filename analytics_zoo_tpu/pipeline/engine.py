@@ -38,9 +38,11 @@ from ..feature.feature_set import (ArrayFeatureSet, FeatureSet, MiniBatch,
 from ..feature.host_pipeline import (DeviceStagingIterator,
                                      build_host_pipeline)
 from ..utils import faults, file_io, serialization, sharded_checkpoint
+from ..utils import telemetry
 from ..utils.crc32c import crc32c
 from ..utils.profiling import (InfeedMonitor, ProfilerHook, inference_window,
                                peak_flops)
+from ..utils.telemetry import span
 from ..utils.sharded_checkpoint import ChecksumError
 
 logger = logging.getLogger("analytics_zoo_tpu.engine")
@@ -730,6 +732,8 @@ class SPMDTrainer:
                     # deliberate exit, final checkpoint already saved —
                     # never burn failure retries on an eviction notice
                     self.wait_for_checkpoint()
+                    telemetry.dump_flight(
+                        f"TrainingPreempted @step {self.step}")
                     raise
                 except (jax.errors.JaxRuntimeError, RuntimeError) as e:
                     retries += 1
@@ -744,6 +748,9 @@ class SPMDTrainer:
                     has_ckpt = self.checkpoint_dir is not None and \
                         self.has_checkpoint(self.checkpoint_dir)
                     if retries > max_retries or not has_ckpt:
+                        telemetry.dump_flight(
+                            f"unhandled step exception @step {self.step}: "
+                            f"{type(e).__name__}: {e}")
                         raise
                     logger.warning("step failed (%s); restoring latest "
                                    "checkpoint (retry %d/%d)", e, retries,
@@ -804,7 +811,8 @@ class SPMDTrainer:
         staging = DeviceStagingIterator(
             it, self._put_batch, self._put_stacked,
             depth=cfg.device_ahead,
-            monitor=InfeedMonitor(worker_provider=worker_provider))
+            monitor=InfeedMonitor(worker_provider=worker_provider,
+                                  scope="train"))
         try:
             self._epoch_loop(staging, step_fn, record, batch_size,
                              time.time(), checkpoint_trigger, validation_set,
@@ -863,7 +871,8 @@ class SPMDTrainer:
         cfg = self.ctx.config
         n_batches = 0
         last_loss = None
-        monitor = staging.monitor or InfeedMonitor()
+        monitor = staging.monitor or InfeedMonitor(scope="train")
+        self._steps_ctr = telemetry.counter("zoo_train_steps_total")
         window_t0 = time.perf_counter()
         window_steps = 0
         self._last_log_step = min(self._last_log_step, self.step)
@@ -873,6 +882,7 @@ class SPMDTrainer:
 
         while True:
             if preemption_requested():
+                telemetry.event("train/preempted", step=self.step)
                 if self.checkpoint_dir is not None:
                     self.save_checkpoint(self.checkpoint_dir)
                     self.wait_for_checkpoint()
@@ -884,56 +894,68 @@ class SPMDTrainer:
                     _iteration_granularity_all(
                         record, end_trigger, checkpoint_trigger,
                         validation_trigger))
-            # batches for this dispatch are already device-resident:
-            # the staging iterator ran device_put while the previous
-            # dispatch was computing
-            chunk = staging.next_chunk(k)
-            if chunk is None:
-                break
-            if chunk.stacked is not None:
-                multi = self.build_multi_step(k)
-                self._maybe_record_flops(
-                    multi, (self.params, self.opt_state,
-                            self.net_state, chunk.stacked, self.step), k)
-                (self.params, self.opt_state, self.net_state,
-                 logs) = multi(self.params, self.opt_state,
-                               self.net_state, chunk.stacked, self.step)
-                done = k
-            else:
-                # single-step path: k == 1, or an epoch tail shorter than
-                # k (reuse the single-step program rather than compiling
-                # a second scan length)
-                done = 0
-                for batch in chunk.singles:
-                    if done == 0:
-                        self._maybe_record_flops(
-                            step_fn, (self.params, self.opt_state,
-                                      self.net_state, batch, self.step), 1)
-                    (self.params, self.opt_state, self.net_state,
-                     logs) = step_fn(self.params, self.opt_state,
-                                     self.net_state, batch,
-                                     self.step + done)
-                    done += 1
-            self.step += done
-            self.epoch_batches += done
-            n_batches += done
-            window_steps += done
-            record.iteration = self.step
-            record.epoch_finished = False
-            # chaos harness: an armed step:kill@N fault fires here (at or
-            # after N — multi-step dispatch cannot jump over it)
-            faults.check("step", step=self.step)
-            last_loss = logs["loss"]
+            with span("train/step", step=self.step, k=k):
+                # batches for this dispatch are already device-resident:
+                # the staging iterator ran device_put while the previous
+                # dispatch was computing
+                chunk = staging.next_chunk(k)
+                if chunk is None:
+                    break
+                if chunk.stacked is not None:
+                    multi = self.build_multi_step(k)
+                    self._maybe_record_flops(
+                        multi, (self.params, self.opt_state,
+                                self.net_state, chunk.stacked, self.step), k)
+                    with span("train/dispatch", step=self.step, k=k):
+                        (self.params, self.opt_state, self.net_state,
+                         logs) = multi(self.params, self.opt_state,
+                                       self.net_state, chunk.stacked,
+                                       self.step)
+                    done = k
+                else:
+                    # single-step path: k == 1, or an epoch tail shorter
+                    # than k (reuse the single-step program rather than
+                    # compiling a second scan length)
+                    done = 0
+                    for batch in chunk.singles:
+                        if done == 0:
+                            self._maybe_record_flops(
+                                step_fn, (self.params, self.opt_state,
+                                          self.net_state, batch,
+                                          self.step), 1)
+                        with span("train/dispatch", step=self.step + done):
+                            (self.params, self.opt_state, self.net_state,
+                             logs) = step_fn(self.params, self.opt_state,
+                                             self.net_state, batch,
+                                             self.step + done)
+                        done += 1
+                self.step += done
+                self.epoch_batches += done
+                n_batches += done
+                window_steps += done
+                record.iteration = self.step
+                record.epoch_finished = False
+                self._steps_ctr.inc(done)
+                # chaos harness: an armed step:kill@N fault fires here (at
+                # or after N — multi-step dispatch cannot jump over it)
+                faults.check("step", step=self.step)
+                last_loss = logs["loss"]
             if profiler is not None:
                 profiler.step(self.step)
             if self.step - self._last_log_step >= log_every:
                 self._last_log_step = self.step
-                loss_v = float(np.asarray(last_loss))
+                # the ONE host transfer of the logging window doubles as
+                # the device barrier for everything dispatched before it
+                with span("train/device_sync", step=self.step):
+                    loss_v = float(np.asarray(last_loss))
                 record.loss = loss_v
                 lr = float(self.lr_schedule(self.step))
                 now = time.perf_counter()
                 wall = max(now - window_t0, 1e-9)
-                infeed = monitor.window(window_steps, wall)
+                with span("train/metric_fetch", step=self.step):
+                    infeed = monitor.window(window_steps, wall)
+                telemetry.gauge("zoo_train_loss").set(loss_v)
+                telemetry.gauge("zoo_train_learning_rate").set(lr)
                 if self.train_summary is not None:
                     self.train_summary.add_scalar("Loss", loss_v, self.step)
                     self.train_summary.add_scalar("LearningRate", lr,
@@ -1048,7 +1070,7 @@ class SPMDTrainer:
         k = self._eval_dispatch_target()
         eval_fn = self.build_eval_step()
         acc: Dict[str, Any] = {}
-        monitor = InfeedMonitor()
+        monitor = InfeedMonitor(scope="eval")
         it, staging = self._inference_pipeline(data, batch_size, monitor)
         n_batches = n_samples = fused = 0
         t0 = time.perf_counter()
@@ -1058,17 +1080,20 @@ class SPMDTrainer:
                 if chunk is None:
                     break
                 if chunk.stacked is not None:
-                    stats = self.build_multi_eval(chunk.k)(
-                        self.params, self.net_state, chunk.stacked)
+                    with span("eval/dispatch", k=chunk.k):
+                        stats = self.build_multi_eval(chunk.k)(
+                            self.params, self.net_state, chunk.stacked)
                     fused += 1
                 else:
                     stats = None
-                    for batch in chunk.singles:
-                        s = eval_fn(self.params, self.net_state, batch)
-                        stats = s if stats is None else jax.tree.map(
-                            jnp.add, stats, s)
+                    with span("eval/dispatch", k=len(chunk.singles)):
+                        for batch in chunk.singles:
+                            s = eval_fn(self.params, self.net_state, batch)
+                            stats = s if stats is None else jax.tree.map(
+                                jnp.add, stats, s)
                 # ONE host fetch per chunk: the accumulated scalar stats
-                host = jax.device_get(stats)
+                with span("eval/device_sync"):
+                    host = jax.device_get(stats)
                 for name, (num, den) in host.items():
                     if name in acc:
                         acc[name] = (acc[name][0] + num, acc[name][1] + den)
@@ -1108,7 +1133,7 @@ class SPMDTrainer:
         # (stacked?, device preds, per-batch real counts) per dispatch;
         # device arrays accumulate un-fetched until final assembly
         results: List[Any] = []
-        monitor = InfeedMonitor()
+        monitor = InfeedMonitor(scope="predict")
         it, staging = self._inference_pipeline(data, batch_size, monitor)
         n_batches = n_samples = fused = 0
         t0 = time.perf_counter()
@@ -1119,15 +1144,17 @@ class SPMDTrainer:
                     break
                 counts = chunk.real_counts
                 if chunk.stacked is not None:
-                    preds = self.build_multi_predict(chunk.k)(
-                        self.params, self.net_state, chunk.stacked[0])
+                    with span("predict/dispatch", k=chunk.k):
+                        preds = self.build_multi_predict(chunk.k)(
+                            self.params, self.net_state, chunk.stacked[0])
                     results.append((True, preds, counts))
                     fused += 1
                 else:
-                    for batch, c in zip(chunk.singles, counts):
-                        preds = predict_fn(self.params, self.net_state,
-                                           batch[0])
-                        results.append((False, preds, [c]))
+                    with span("predict/dispatch", k=len(chunk.singles)):
+                        for batch, c in zip(chunk.singles, counts):
+                            preds = predict_fn(self.params, self.net_state,
+                                               batch[0])
+                            results.append((False, preds, [c]))
                 n_batches += len(chunk.hosts)
                 n_samples += sum(counts)
         finally:
@@ -1340,28 +1367,31 @@ class SPMDTrainer:
         step = int(meta["step"])
         sub = f"{SPMDTrainer.CKPT_PREFIX}{step}"
         base = os.path.join(directory, sub)
-        file_io.makedirs(base)
-        model_data, model_tdef = serialization.pytree_bytes(
-            {"params": params_np, "state": state_np})
-        optim_data = serialization.leaves_bytes(opt_leaves)
-        meta_data, meta_tdef = serialization.pytree_bytes(meta)
-        files = (("model.npz", model_data),
-                 ("optim.npz", optim_data),
-                 ("meta.npz", meta_data),
-                 ("model.npz.treedef", model_tdef),
-                 ("meta.npz.treedef", meta_tdef))
-        sums = {}
-        for fname, data in files:
-            faults.checked_write(os.path.join(base, fname), data,
-                                 file_io.write_bytes)
-            sums[fname] = {"crc32c": crc32c(data), "size": len(data)}
-        manifest = {"format": "flat-v2", "step": step,
-                    "epoch": int(meta["epoch"]), "files": sums}
-        file_io.write_bytes_atomic(os.path.join(base, "manifest.json"),
-                                   json.dumps(manifest).encode())
-        file_io.write_bytes_atomic(
-            os.path.join(directory, SPMDTrainer.LATEST_FILE), sub.encode())
-        SPMDTrainer._prune_checkpoints(directory, keep)
+        with span("ckpt/write", step=step):
+            file_io.makedirs(base)
+            model_data, model_tdef = serialization.pytree_bytes(
+                {"params": params_np, "state": state_np})
+            optim_data = serialization.leaves_bytes(opt_leaves)
+            meta_data, meta_tdef = serialization.pytree_bytes(meta)
+            files = (("model.npz", model_data),
+                     ("optim.npz", optim_data),
+                     ("meta.npz", meta_data),
+                     ("model.npz.treedef", model_tdef),
+                     ("meta.npz.treedef", meta_tdef))
+            sums = {}
+            for fname, data in files:
+                faults.checked_write(os.path.join(base, fname), data,
+                                     file_io.write_bytes)
+                sums[fname] = {"crc32c": crc32c(data), "size": len(data)}
+            manifest = {"format": "flat-v2", "step": step,
+                        "epoch": int(meta["epoch"]), "files": sums}
+            file_io.write_bytes_atomic(os.path.join(base, "manifest.json"),
+                                       json.dumps(manifest).encode())
+            file_io.write_bytes_atomic(
+                os.path.join(directory, SPMDTrainer.LATEST_FILE),
+                sub.encode())
+            SPMDTrainer._prune_checkpoints(directory, keep)
+        telemetry.counter("zoo_checkpoint_writes_total").inc()
         logger.info("checkpoint saved to %s @step %d", base, step)
 
     @staticmethod
@@ -1494,13 +1524,16 @@ class SPMDTrainer:
         # must finish (and surface its error) before the next snapshot
         self.wait_for_checkpoint()
         if self._needs_sharded_ckpt():
-            self._save_checkpoint_sharded(directory)
+            with span("ckpt/write", step=self.step, format="sharded"):
+                self._save_checkpoint_sharded(directory)
+            telemetry.counter("zoo_checkpoint_writes_total").inc()
             return
         if jax.process_index() == 0:
             faults.begin_save()
             keep = int(getattr(self.ctx.config, "keep_checkpoints", 3))
             use_async = self._async_ckpt_eligible()
-            snapshot = self._flat_snapshot(copy=use_async)
+            with span("ckpt/snapshot", step=self.step):
+                snapshot = self._flat_snapshot(copy=use_async)
             if use_async:
                 # device->host transfer + copy happened above
                 # (synchronous, it must see THIS step's state and own its

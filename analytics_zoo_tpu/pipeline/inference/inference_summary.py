@@ -14,57 +14,36 @@ TensorBoard events (the serving bench and smoke entry use this).
 
 from __future__ import annotations
 
+import itertools
 import os
 import threading
 import time
-from collections import deque
 from typing import Dict, Optional, Sequence
 
+from ...utils import telemetry
 
-class LatencyStats:
+
+class LatencyStats(telemetry.Summary):
     """Bounded reservoir of recent latencies with percentile queries.
 
     Keeps the last ``maxlen`` observations (seconds) in a ring buffer so
     a long-running serving loop reports *recent* tail latency, not the
     all-time distribution.  Thread-safe: stages record concurrently.
+
+    Storage is :class:`telemetry.Summary` — stage reservoirs are
+    registered in the process metrics registry, so ``metrics.json`` /
+    Prometheus render the same numbers ``stats.json`` does (the
+    summary is an exporter, not a second bookkeeping system).
     """
 
-    def __init__(self, maxlen: int = 4096):
-        self._buf: deque = deque(maxlen=maxlen)
-        self._lock = threading.Lock()
-        self.count = 0          # total observations (not capped)
-        self.total = 0.0        # running sum of all observations
+    def __init__(self, name: str = "", labels=(), maxlen: int = 4096):
+        super().__init__(name=name, labels=labels, maxlen=maxlen)
 
-    def record(self, latency_s: float):
-        with self._lock:
-            self._buf.append(float(latency_s))
-            self.count += 1
-            self.total += float(latency_s)
 
-    def percentile(self, pct: float) -> float:
-        """Linear-interpolated percentile (numpy 'linear' method) over
-        the current reservoir, in seconds.  0.0 when empty."""
-        with self._lock:
-            data = sorted(self._buf)
-        if not data:
-            return 0.0
-        if len(data) == 1:
-            return data[0]
-        rank = (pct / 100.0) * (len(data) - 1)
-        lo = int(rank)
-        hi = min(lo + 1, len(data) - 1)
-        frac = rank - lo
-        return data[lo] * (1.0 - frac) + data[hi] * frac
-
-    def percentiles(self, pcts: Sequence[float] = (50, 95, 99)
-                    ) -> Dict[str, float]:
-        """{'p50': ..., 'p95': ..., 'p99': ...} in **milliseconds**."""
-        return {f"p{int(p) if float(p).is_integer() else p}":
-                self.percentile(p) * 1e3 for p in pcts}
-
-    def mean(self) -> float:
-        with self._lock:
-            return self.total / self.count if self.count else 0.0
+# distinct serving instances in one process (tests build several) must
+# not share stage reservoirs — each summary labels its metrics with a
+# process-unique instance id
+_INSTANCE_IDS = itertools.count()
 
 
 class InferenceSummary:
@@ -85,6 +64,8 @@ class InferenceSummary:
                 os.path.join(log_dir, app_name, "inference"))
         self._step = 0
         self._lock = threading.Lock()
+        self._app = app_name
+        self._inst = str(next(_INSTANCE_IDS))
         self._stages: Dict[str, LatencyStats] = {}
         self._queue_depths: Dict[str, int] = {}
 
@@ -120,7 +101,11 @@ class InferenceSummary:
         with self._lock:
             st = self._stages.get(stage)
             if st is None:
-                st = self._stages[stage] = LatencyStats()
+                st = telemetry.get_registry().register(
+                    LatencyStats, "zoo_serving_stage_seconds",
+                    {"stage": stage, "app": self._app,
+                     "inst": self._inst})
+                self._stages[stage] = st
             return st
 
     def record_stage(self, stage: str, latency_s: float,
@@ -141,6 +126,8 @@ class InferenceSummary:
     def record_queue_depth(self, name: str, depth: int):
         with self._lock:
             self._queue_depths[name] = int(depth)
+        telemetry.gauge("zoo_serving_queue_depth", queue=name,
+                        app=self._app, inst=self._inst).set(depth)
         if self.writer is not None:
             self.add_scalar(f"Queue/{name}", depth)
 

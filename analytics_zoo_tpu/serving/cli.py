@@ -42,6 +42,8 @@ import signal
 import sys
 import time
 
+from ..utils import telemetry
+
 PIDFILE = "cluster-serving.pid"
 LOGFILE = "cluster-serving.log"
 CONFIG = "config.yaml"
@@ -164,6 +166,10 @@ def _serve(cfg: str, warmup: bool = False, workdir: str = "."):
         except Exception:  # noqa: BLE001 - serving may not need jax yet
             pass
     serving, _ctl = _build_serving(cfg, workdir)
+    if serving.helper.telemetry or telemetry.enabled():
+        telemetry.configure(enabled=True,
+                            trace_dir=serving.helper.trace_dir,
+                            service="serving")
     if warmup or serving.helper.warmup:
         # pre-compile every padding-bucket signature before the loop
         # accepts traffic; per-bucket compile time goes to the log
@@ -175,7 +181,9 @@ def _serve(cfg: str, warmup: bool = False, workdir: str = "."):
         print(f"warmup: {len(times)}/{len(serving.buckets)} buckets in "
               f"{time.time() - t0:.3f}s", flush=True)
 
-    def _term(_sig, _frm):
+    def _term(sig, _frm):
+        telemetry.event("serving/drain", signal=sig)
+        telemetry.dump_flight(f"zoo-serving draining on signal {sig}")
         serving._stop.set()
 
     signal.signal(signal.SIGTERM, _term)
@@ -306,6 +314,10 @@ def _print_fleet(workdir: str) -> bool:
             state = "up"
         else:
             state = "DOWN"
+        if r.get("stale"):
+            # alive by signal-0 but the heartbeat/stats file stopped
+            # refreshing: wedged, and the supervisor hasn't acted yet
+            state = "STALE"
         age = (f"{r['health_age_s']:.1f}s"
                if r.get("health_age_s") is not None else "-")
         print(f"  worker {r['worker_id']}: pid={r['pid']} {state:4s} "
@@ -315,12 +327,31 @@ def _print_fleet(workdir: str) -> bool:
     return bool(rows)
 
 
+def _print_fleet_metrics(workdir: str):
+    """Merged per-worker telemetry counters/gauges (fleet totals) —
+    present only when workers run with telemetry on."""
+    from .fleet import fleet_metrics
+
+    view = fleet_metrics(workdir)
+    if not view["workers"]:
+        return
+    ages = ", ".join(f"w{w['worker_id']}={w['age_s']:.1f}s"
+                     for w in view["workers"])
+    print(f"  metrics snapshots: {ages}")
+    for m in view["merged"]:
+        lbl = ",".join(f"{k}={v}" for k, v in sorted(m["labels"].items()))
+        lbl = f"{{{lbl}}}" if lbl else ""
+        print(f"    {m['name']}{lbl} = {m['value']:g}")
+
+
 def cmd_status(workdir: str) -> int:
     _, pidfile, _ = _paths(workdir)
     pid = _read_pid(pidfile)
     if pid is not None:
         print(f"running (pid {pid})")
     fleet_rows = _print_fleet(workdir)
+    if fleet_rows:
+        _print_fleet_metrics(workdir)
     if pid is None and not fleet_rows:
         print("not running")
         return 3
@@ -467,6 +498,10 @@ def main(argv=None) -> int:
     ap.add_argument("--warmup", action="store_true",
                     help="start: pre-compile all padding buckets before "
                          "accepting traffic (logs compile time per bucket)")
+    ap.add_argument("--trace-dir", default=None,
+                    help="enable telemetry and write Chrome-trace + "
+                         "metrics.json files under this directory "
+                         "(fleet workers inherit via the environment)")
     ap.add_argument("--model", default=None,
                     help="registry verbs: model name (deploy defaults to "
                          "the registry's default model)")
@@ -492,6 +527,11 @@ def main(argv=None) -> int:
                          "model directory when present)")
     args = ap.parse_args(argv)
     workdir = os.path.abspath(args.dir)
+    if args.trace_dir:
+        # exports ZOO_TPU_TELEMETRY / ZOO_TPU_TRACE_DIR so daemonized
+        # starts and fleet worker subprocesses inherit the settings
+        telemetry.configure(enabled=True, trace_dir=args.trace_dir,
+                            service="serving")
     if args.command == "init":
         return cmd_init(workdir)
     if args.command == "start":

@@ -62,6 +62,8 @@ import numpy as np
 from ..pipeline.inference import InferenceModel
 from ..pipeline.inference.inference_model import AbstractModel
 from ..pipeline.inference.inference_summary import InferenceSummary
+from ..utils import telemetry
+from ..utils.telemetry import span
 from .admission import (AdaptiveBatcher, AdmissionController, SHED_DEADLINE,
                         SHED_EXPIRED, now_ms)
 from .queue_backend import StreamQueue, get_queue_backend
@@ -192,6 +194,10 @@ class ClusterServingHelper:
         self.max_restarts = int(params.get("max_restarts") or 10)
         self.restart_backoff_s = float(
             params.get("restart_backoff_s") or 0.5)
+        # -- telemetry (docs/observability.md): span tracing + per-process
+        # metrics.json; the CLI --trace-dir flag overrides trace_dir
+        self.telemetry = _parse_bool(params.get("telemetry"), False)
+        self.trace_dir = params.get("trace_dir")
         # -- model registry (docs/model-registry.md) --------------------
         reg = config.get("registry") or {}
         self.registry_root = reg.get("root")
@@ -346,6 +352,8 @@ class ClusterServing:
                 {"error": msg, "code": code}).encode()
         self.db.put_results(payload)
         self._count(shed=len(metas))
+        telemetry.event("serving/shed", code=code, n=len(metas))
+        telemetry.counter("zoo_serving_shed_total", code=code).inc(len(metas))
 
     @staticmethod
     def _timing_payload(meta: RecordMeta, disp_ts_ms: float,
@@ -462,7 +470,8 @@ class ClusterServing:
             meta, rid, rec = item
             t0 = time.perf_counter()
             try:
-                arr = self._decode_record(rec)
+                with span("serving/decode"):
+                    arr = self._decode_record(rec)
             except Exception as e:  # bad record: report, keep serving
                 self._on_decode_error(rid, rec, e)
                 continue
@@ -496,8 +505,11 @@ class ClusterServing:
                         self._oldest_deadline(batch_items))
                     if budget <= 0.0:
                         break
+                    telemetry.event("serving/linger", n=len(batch_items),
+                                    budget_ms=round(budget * 1e3, 3))
                     try:
-                        nxt = ready.get(timeout=budget)
+                        with span("serving/linger_wait", n=len(batch_items)):
+                            nxt = ready.get(timeout=budget)
                     except queue.Empty:
                         break
                 if nxt is _SENTINEL:
@@ -527,15 +539,17 @@ class ClusterServing:
         n = len(arrays)
         bucket = pick_bucket(n, self.buckets)
         try:
-            batch = np.stack(arrays)
-            if n < bucket:
-                pad = np.repeat(batch[-1:], bucket - n, axis=0)
-                batch = np.concatenate([batch, pad])
-            disp_ts_ms = now_ms()
-            t0 = time.perf_counter()
-            # async dispatch: don't block on the host transfer of batch
-            # k before submitting k+1 — the writer stage synchronizes
-            out = self.model.predict_async(batch)
+            with span("serving/dispatch", n=n, bucket=bucket):
+                batch = np.stack(arrays)
+                if n < bucket:
+                    pad = np.repeat(batch[-1:], bucket - n, axis=0)
+                    batch = np.concatenate([batch, pad])
+                disp_ts_ms = now_ms()
+                t0 = time.perf_counter()
+                # async dispatch: don't block on the host transfer of
+                # batch k before submitting k+1 — the writer stage
+                # synchronizes
+                out = self.model.predict_async(batch)
         except Exception as e:
             logger.warning("dropping batch of %d (%s)", n, e)
             self._count(dropped=n)
@@ -553,7 +567,8 @@ class ClusterServing:
                 return
             metas, n, t_disp, disp_ts_ms, out = item
             try:
-                preds = np.asarray(out)[:n]   # host transfer = sync point
+                with span("serving/device_sync", n=n):
+                    preds = np.asarray(out)[:n]  # host transfer sync point
             except Exception as e:
                 logger.warning("dropping results for %d records (%s)",
                                n, e)
@@ -566,14 +581,15 @@ class ClusterServing:
             self.admission.observe_batch(n, dt)
             done_ms = now_ms()
             t0 = time.perf_counter()
-            results = {}
-            for meta, p in zip(metas, preds):
-                obj = self._format_result(p)
-                obj["timing"] = self._timing_payload(
-                    meta, disp_ts_ms, dt * 1e3, done_ms)
-                self._record_row_timing(obj["timing"])
-                results[meta.uri] = json.dumps(obj).encode()
-            self.db.put_results(results)
+            with span("serving/write", n=n):
+                results = {}
+                for meta, p in zip(metas, preds):
+                    obj = self._format_result(p)
+                    obj["timing"] = self._timing_payload(
+                        meta, disp_ts_ms, dt * 1e3, done_ms)
+                    self._record_row_timing(obj["timing"])
+                    results[meta.uri] = json.dumps(obj).encode()
+                self.db.put_results(results)
             now = time.perf_counter()
             self.summary.record_stage("write", now - t0, batch_size=n)
             for meta in metas:

@@ -80,10 +80,29 @@ def read_health(workdir: str, worker_id: int) -> Optional[dict]:
         return None
 
 
-def fleet_status(workdir: str) -> List[dict]:
+#: heartbeat / stats-file age past which a *live* worker is flagged
+#: stale by :func:`fleet_status` (the supervisor may not have acted yet
+#: — e.g. it is down, or the worker wedged inside its health_timeout)
+STALE_AFTER_S = 10.0
+
+
+def _file_age_s(path: str, now: float) -> Optional[float]:
+    try:
+        return round(now - os.path.getmtime(path), 2)
+    except OSError:
+        return None
+
+
+def fleet_status(workdir: str,
+                 stale_after_s: float = STALE_AFTER_S) -> List[dict]:
     """Per-worker status rows from the health files: worker id, pid,
     heartbeat age, liveness (signal-0 probe), records served, shed count.
-    Works from any process — `zoo-serving status` renders these."""
+    Works from any process — `zoo-serving status` renders these.
+
+    A row is flagged ``stale`` when the worker looks alive but its
+    heartbeat or stats dump has not been refreshed within
+    ``stale_after_s`` — the wedged-but-not-dead case the supervisor's
+    own health_timeout may not have caught yet."""
     hdir = os.path.join(workdir, HEALTH_DIR)
     rows = []
     try:
@@ -111,11 +130,19 @@ def fleet_status(workdir: str) -> List[dict]:
         wid = h.get("worker_id")
         seen.add(str(wid))
         s = sup.get(str(wid), {})
+        health_age = round(now - h.get("ts", 0.0), 2)
+        stats_age = _file_age_s(
+            os.path.join(workdir, f"stats-worker-{wid}.json"), now)
+        stale = alive and (
+            health_age > stale_after_s or
+            (stats_age is not None and stats_age > stale_after_s))
         rows.append({
             "worker_id": wid,
             "pid": pid,
             "alive": alive,
-            "health_age_s": round(now - h.get("ts", 0.0), 2),
+            "health_age_s": health_age,
+            "stats_age_s": stats_age,
+            "stale": stale,
             "records_served": h.get("records_served", 0),
             "shed": h.get("shed", 0),
             "restarts": s.get("restarts", h.get("restarts", 0)),
@@ -129,13 +156,53 @@ def fleet_status(workdir: str) -> List[dict]:
             continue
         rows.append({
             "worker_id": int(wid), "pid": None, "alive": False,
-            "health_age_s": None, "records_served": 0, "shed": 0,
+            "health_age_s": None, "stats_age_s": None, "stale": False,
+            "records_served": 0, "shed": 0,
             "restarts": s.get("restarts", 0),
             "backoff_until": s.get("backoff_until", 0.0),
             "crash_looped": s.get("crash_looped", False),
         })
     rows.sort(key=lambda r: (r["worker_id"] is None, r["worker_id"]))
     return rows
+
+
+def fleet_metrics(workdir: str) -> dict:
+    """Merge per-worker telemetry snapshots (``metrics-worker-N.json``,
+    written by each worker's metrics exporter when telemetry is on) into
+    one fleet view: counters and gauges are summed by (name, labels) —
+    fleet totals — while each worker's full snapshot rides along with
+    its age. ``zoo-serving status`` renders this next to the health rows;
+    missing/unreadable files are skipped (telemetry may be off)."""
+    now = time.time()
+    workers: List[dict] = []
+    merged: Dict[tuple, float] = {}
+    try:
+        names = sorted(n for n in os.listdir(workdir)
+                       if n.startswith("metrics-worker-")
+                       and n.endswith(".json"))
+    except FileNotFoundError:
+        names = []
+    for name in names:
+        try:
+            with open(os.path.join(workdir, name)) as f:
+                snap = json.load(f)
+        except (OSError, ValueError):
+            continue
+        wid = name[len("metrics-worker-"):-len(".json")]
+        metrics = snap.get("metrics", [])
+        workers.append({"worker_id": wid,
+                        "service": snap.get("service", ""),
+                        "age_s": round(now - snap.get("ts", 0.0), 2),
+                        "metrics": metrics})
+        for m in metrics:
+            if m.get("type") not in ("counter", "gauge"):
+                continue
+            key = (m.get("name"),
+                   tuple(sorted((m.get("labels") or {}).items())))
+            merged[key] = merged.get(key, 0.0) + float(m.get("value", 0.0))
+    return {"workers": workers,
+            "merged": [{"name": k[0], "labels": dict(k[1]), "value": v}
+                       for k, v in sorted(merged.items())]}
 
 
 class ServingFleet:
@@ -338,6 +405,9 @@ class ServingFleet:
     # -- observability --------------------------------------------------
     def status(self) -> List[dict]:
         return fleet_status(self.workdir)
+
+    def metrics(self) -> dict:
+        return fleet_metrics(self.workdir)
 
     def worker_stats(self) -> List[dict]:
         """Per-worker pipeline_stats() snapshots (from each worker's

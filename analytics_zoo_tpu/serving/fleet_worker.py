@@ -30,6 +30,7 @@ import sys
 import threading
 import time
 
+from ..utils import telemetry
 from .fleet import HEALTH_DIR, write_health
 
 logger = logging.getLogger("analytics_zoo_tpu.serving.fleet_worker")
@@ -126,11 +127,24 @@ def main(argv=None) -> int:
     workdir = os.path.abspath(args.workdir)
     os.makedirs(os.path.join(workdir, HEALTH_DIR), exist_ok=True)
     serving, _ctl = _build_serving(args.config, workdir, args.worker_id)
+    if serving.helper.telemetry or telemetry.enabled():
+        # per-worker metrics snapshots land next to the stats dumps so
+        # the supervisor (worker 0's host) can merge a fleet view
+        telemetry.configure(enabled=True,
+                            trace_dir=serving.helper.trace_dir,
+                            service=f"serving-worker-{args.worker_id}",
+                            export_metrics=False)
+        telemetry.start_metrics_exporter(os.path.join(
+            workdir, f"metrics-worker-{args.worker_id}.json"))
     if serving.helper.warmup:
         serving.warmup()
     stop = threading.Event()
 
-    def _term(_sig, _frm):
+    def _term(sig, _frm):
+        telemetry.event("serving/drain", signal=sig,
+                        worker=args.worker_id)
+        telemetry.dump_flight(
+            f"serving worker {args.worker_id} draining on signal {sig}")
         stop.set()
         serving._stop.set()
 

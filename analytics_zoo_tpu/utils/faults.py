@@ -158,6 +158,20 @@ def _claim_exclusive(spec: _Spec) -> bool:
     return True
 
 
+def _flight(spec: _Spec, detail: str, **args) -> None:
+    """Leave post-mortem evidence before a fatal fault fires: an instant
+    event naming the site, then the flight-recorder dump
+    (``debug/flight-<pid>-<ts>.json`` — last-N spans + metrics). No-op
+    when telemetry is disabled; never masks the fault itself."""
+    try:
+        from . import telemetry
+        telemetry.event(f"fault/{spec.site}", action=spec.action,
+                        arg=spec.arg, **args)
+        telemetry.dump_flight(f"ZOO_TPU_FAULT {spec.raw}: {detail}")
+    except Exception:  # noqa: BLE001 - the fault must still fire
+        pass
+
+
 def _die(spec: _Spec, detail: str) -> None:
     # SIGKILL: no handlers, no atexit, no flush — the honest crash.
     sys.stderr.write(f"[faults] firing {spec.raw}: {detail}\n")
@@ -174,6 +188,7 @@ def check(site: str, step: Optional[int] = None) -> None:
             if step is not None and step >= spec.arg \
                     and not _already_fired(spec):
                 _record_fired(spec)
+                _flight(spec, f"step {step} >= {spec.arg}", step=step)
                 if spec.action == "kill":
                     _die(spec, f"step {step} >= {spec.arg}")
                 raise FaultInjected(f"injected failure at step {step} "
@@ -181,6 +196,8 @@ def check(site: str, step: Optional[int] = None) -> None:
         elif site == "infeed-worker":
             if step is not None and step >= spec.arg \
                     and not _already_fired(spec) and _claim_exclusive(spec):
+                _flight(spec, f"infeed item {step} >= {spec.arg}",
+                        item=step)
                 if spec.action == "kill":
                     _die(spec, f"infeed item {step} >= {spec.arg}")
                 raise FaultInjected(f"injected infeed failure at item "
@@ -191,6 +208,14 @@ def check(site: str, step: Optional[int] = None) -> None:
                     spec.io_count += 1
                     n = spec.io_count
                 if n <= spec.arg:
+                    # transient faults are retried, not fatal: event
+                    # only, no flight dump
+                    try:
+                        from . import telemetry
+                        telemetry.event("fault/file-io", action="transient",
+                                        n=n, arg=spec.arg)
+                    except Exception:  # noqa: BLE001
+                        pass
                     raise TransientFault(
                         f"injected transient IO error {n}/{spec.arg} "
                         f"({spec.raw})")
@@ -218,6 +243,7 @@ def checked_write(path: str, data: bytes,
         if spec.save_index == spec.arg and spec.writes_in_save == 2:
             _record_fired(spec)
             writer(path, data[: max(1, len(data) // 2)])
+            _flight(spec, f"mid-write of {path}", path=path)
             if spec.action == "kill":
                 _die(spec, f"mid-write of {path}")
             raise FaultInjected(

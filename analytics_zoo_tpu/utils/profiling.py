@@ -26,6 +26,8 @@ import threading
 
 import numpy as np
 
+from . import telemetry
+
 logger = logging.getLogger("analytics_zoo_tpu.profiling")
 
 # chip peak bf16 matmul FLOPs by device_kind substring (public specs)
@@ -110,25 +112,41 @@ class InfeedMonitor:
     decode pool itself is working* — a starved step loop with idle
     workers means the bottleneck is upstream (disk, hand-off), while
     saturated workers mean the pool needs more processes.
+
+    The wait time itself lives in the telemetry registry
+    (``zoo_infeed_wait_seconds_total{scope=...}`` plus a latency
+    histogram) — this class is a *windowing view* over that counter,
+    and TrainSummary scalars are derived from it, so infeed wait exists
+    exactly once (docs/observability.md).
     """
 
-    def __init__(self, worker_provider=None):
+    def __init__(self, worker_provider=None, scope: str = "default"):
         self._lock = threading.Lock()
-        self._wait = 0.0
-        self.total_wait = 0.0
+        self.scope = scope
+        self._ctr = telemetry.counter("zoo_infeed_wait_seconds_total",
+                                      scope=scope)
+        self._hist = telemetry.histogram("zoo_infeed_wait_seconds",
+                                         scope=scope)
+        self._base = self._ctr.value   # counter survives across monitors
+        self._last = self._base
         self._worker_provider = worker_provider
         self._worker_prev: dict = {}
 
     def input_wait(self, seconds: float):
-        with self._lock:
-            self._wait += seconds
-            self.total_wait += seconds
+        self._ctr.inc(seconds)
+        self._hist.observe(seconds)
+
+    @property
+    def total_wait(self) -> float:
+        """Wait accumulated over this monitor's lifetime (seconds)."""
+        return self._ctr.value - self._base
 
     def window(self, steps: int, wall_s: float):
         """Scalars for a window of ``steps`` steps over ``wall_s`` seconds;
         resets the window accumulator."""
         with self._lock:
-            wait, self._wait = self._wait, 0.0
+            cur = self._ctr.value
+            wait, self._last = cur - self._last, cur
         steps = max(int(steps), 1)
         wall_s = max(wall_s, 1e-9)
         out = {
@@ -148,6 +166,10 @@ class InfeedMonitor:
                 out["infeed_workers"] = float(len(snap))
                 out["infeed_worker_utilization"] = min(
                     1.0, sum(busy) / (len(busy) * wall_s))
+        for key in ("input_bound_fraction", "step_time_ms",
+                    "infeed_worker_utilization"):
+            if key in out:
+                telemetry.gauge(f"zoo_{key}", scope=self.scope).set(out[key])
         return out
 
 

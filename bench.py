@@ -72,6 +72,33 @@ def _sigterm(_sig, _frm):
     os._exit(0)
 
 
+# Telemetry artifacts (docs/observability.md): ZOO_BENCH_TRACE_DIR turns
+# the spine on for the bench process; after each leg the trace + metrics
+# collected so far are flushed and the leg's row points at them.
+BENCH_TRACE_DIR = os.environ.get("ZOO_BENCH_TRACE_DIR") or None
+
+
+def _stamp_leg_artifacts(leg):
+    """When telemetry is on, snapshot this leg's trace + metrics into
+    per-leg files and stamp their paths into the leg's result row."""
+    if BENCH_TRACE_DIR is None:
+        return
+    try:
+        from analytics_zoo_tpu.utils import telemetry
+
+        if not telemetry.enabled():
+            return
+        tpath = os.path.join(BENCH_TRACE_DIR, f"bench-{leg}-trace.json")
+        telemetry.write_trace(tpath)
+        mpath = os.path.join(BENCH_TRACE_DIR, f"bench-{leg}-metrics.json")
+        telemetry._atomic_write_json(mpath, telemetry.snapshot_metrics())
+        RESULT[f"{leg}_trace_artifact"] = tpath
+        RESULT[f"{leg}_metrics_artifact"] = mpath
+    except Exception as e:  # noqa: BLE001 - artifacts never fail a leg
+        print(f"# telemetry artifact stamp failed for {leg}: {e}",
+              file=sys.stderr)
+
+
 # Hard bench gates: invariants a leg asserts about its own numbers (the
 # attention hot path carries zero copy/transpose ops, the stub int8 chain
 # beats stub f32, ...). Failures are recorded in the JSON
@@ -1898,6 +1925,10 @@ def main():
     RESULT["platform_provenance"] = info.get("provenance", "probe")
     emit()
     print(f"# backend: {info}", file=sys.stderr)
+    if BENCH_TRACE_DIR is not None:
+        from analytics_zoo_tpu.utils import telemetry
+        telemetry.configure(enabled=True, trace_dir=BENCH_TRACE_DIR,
+                            service="bench")
 
     x, y = make_data()
     tpu_sps = None
@@ -1909,6 +1940,7 @@ def main():
         traceback.print_exc()
         RESULT["ncf_error"] = (str(e).splitlines()[0][:500]
                                if str(e) else repr(e)[:500])
+    _stamp_leg_artifacts("ncf")
     emit()
 
     if tpu_sps is not None:
@@ -1931,6 +1963,7 @@ def main():
             # message head, not a traceback tail slice (ADVICE r2)
             RESULT["bert_error"] = (str(e).splitlines()[0][:500]
                                     if str(e) else repr(e)[:500])
+        _stamp_leg_artifacts("bert")
         emit()
     else:
         RESULT["bert_skipped"] = "time budget exhausted"
@@ -1944,6 +1977,7 @@ def main():
         except Exception as e:  # noqa: BLE001
             RESULT["resnet_error"] = (str(e).splitlines()[0][:500]
                                       if str(e) else repr(e)[:500])
+        _stamp_leg_artifacts("resnet")
         emit()
 
     # Long-context leg (SURVEY §5.7): BERT at L=2048 routes through the
@@ -1968,6 +2002,7 @@ def main():
         except Exception as e:  # noqa: BLE001
             RESULT["bert_long_error"] = (str(e).splitlines()[0][:500]
                                          if str(e) else repr(e)[:500])
+        _stamp_leg_artifacts("bert_long")
         emit()
 
     # Attention-fallback leg: blockwise-vs-old-reference step wall time
@@ -1981,6 +2016,7 @@ def main():
             traceback.print_exc()
             RESULT["attn_error"] = (str(e).splitlines()[0][:500]
                                     if str(e) else repr(e)[:500])
+        _stamp_leg_artifacts("attn")
         emit()
 
     # Serving-latency leg (SURVEY §7 hard-part (e)): AOT predict p50/p99
@@ -1993,6 +2029,7 @@ def main():
             traceback.print_exc()
             RESULT["serving_error"] = (str(e).splitlines()[0][:500]
                                        if str(e) else repr(e)[:500])
+        _stamp_leg_artifacts("serving")
         emit()
 
     # Int8-v2 quant leg: device_sync-correct int8 vs f32 latency +
@@ -2007,6 +2044,7 @@ def main():
             traceback.print_exc()
             RESULT["quant_error"] = (str(e).splitlines()[0][:500]
                                      if str(e) else repr(e)[:500])
+        _stamp_leg_artifacts("quant")
         emit()
 
     # Pipelined-serving leg: end-to-end throughput + tail latency of the
@@ -2020,6 +2058,7 @@ def main():
             traceback.print_exc()
             RESULT["serving_pipe_error"] = (str(e).splitlines()[0][:500]
                                             if str(e) else repr(e)[:500])
+        _stamp_leg_artifacts("serving_pipe")
         emit()
 
     # Multi-model registry leg: per-model throughput through the routed
@@ -2033,6 +2072,7 @@ def main():
             traceback.print_exc()
             RESULT["registry_error"] = (str(e).splitlines()[0][:500]
                                         if str(e) else repr(e)[:500])
+        _stamp_leg_artifacts("registry")
         emit()
 
     # Admission-control leg: saturating burst with vs without deadlines
@@ -2047,6 +2087,7 @@ def main():
             traceback.print_exc()
             RESULT["admission_error"] = (str(e).splitlines()[0][:500]
                                          if str(e) else repr(e)[:500])
+        _stamp_leg_artifacts("admission")
         emit()
 
     # Serving-fleet leg: 2 supervised worker processes vs 1 over the
@@ -2060,6 +2101,7 @@ def main():
             traceback.print_exc()
             RESULT["fleet_error"] = (str(e).splitlines()[0][:500]
                                      if str(e) else repr(e)[:500])
+        _stamp_leg_artifacts("fleet")
         emit()
 
     # Input-pipeline leg — platform-independent (decode is host-side work
@@ -2078,6 +2120,7 @@ def main():
         _gate("infeed_input_bound_fraction_reported",
               "infeed_input_bound_fraction" in RESULT,
               RESULT.get("infeed_error", "key missing"))
+        _stamp_leg_artifacts("infeed")
         emit()
 
     # Infeed backend A/B — thread vs process transform pool on a
@@ -2094,6 +2137,7 @@ def main():
                                               if str(e) else repr(e)[:500])
             _gate("infeed_backend_measured", False,
                   RESULT["infeed_backend_error"])
+        _stamp_leg_artifacts("infeed_backend")
         emit()
 
     # Staged host pipeline leg — serial vs transform-pool/staging overlap
@@ -2105,6 +2149,7 @@ def main():
         except Exception as e:  # noqa: BLE001
             RESULT["input_pipe_error"] = (str(e).splitlines()[0][:500]
                                           if str(e) else repr(e)[:500])
+        _stamp_leg_artifacts("input_pipe")
         emit()
 
     # Fused evaluate/predict leg — scan-dispatched inference with
@@ -2118,6 +2163,7 @@ def main():
             traceback.print_exc()
             RESULT["eval_pred_error"] = (str(e).splitlines()[0][:500]
                                          if str(e) else repr(e)[:500])
+        _stamp_leg_artifacts("eval_pred")
         emit()
 
     # AutoML trials/hour — the last unmeasured BASELINE.md target row;
@@ -2128,6 +2174,7 @@ def main():
         except Exception as e:  # noqa: BLE001
             RESULT["automl_error"] = (str(e).splitlines()[0][:500]
                                       if str(e) else repr(e)[:500])
+        _stamp_leg_artifacts("automl")
         emit()
 
     RESULT["bench_gates_failed"] = GATE_FAILURES

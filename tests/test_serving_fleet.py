@@ -16,7 +16,7 @@ import pytest
 from analytics_zoo_tpu.serving.admission import (
     SHED_DEADLINE, AdaptiveBatcher, AdmissionController, now_ms)
 from analytics_zoo_tpu.serving.fleet import (
-    fleet_status, read_health, write_health)
+    fleet_metrics, fleet_status, read_health, write_health)
 from analytics_zoo_tpu.utils.profiling import Ewma
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -120,6 +120,74 @@ def test_health_files_and_fleet_status(tmp_path):
     assert me["health_age_s"] < 5.0
     assert rows[1]["alive"] is False    # pid 999999999 does not exist
     assert fleet_status(str(tmp_path / "nope")) == []
+
+
+def test_fleet_status_flags_stale_live_worker(tmp_path):
+    wd = str(tmp_path)
+    # live pid, fresh heartbeat: any positive age beats a 0.0 threshold
+    write_health(wd, 0, {"pid": os.getpid(), "records_served": 1})
+    time.sleep(0.05)
+    rows = fleet_status(wd, stale_after_s=0.0)
+    assert rows[0]["alive"] is True and rows[0]["stale"] is True
+    # generous threshold: same worker is not stale
+    assert fleet_status(wd, stale_after_s=60.0)[0]["stale"] is False
+    # a dead worker is DOWN, not STALE — staleness is the wedged-but-
+    # alive case only
+    write_health(wd, 1, {"pid": 999999999})
+    time.sleep(0.05)
+    r1 = fleet_status(wd, stale_after_s=0.0)[1]
+    assert r1["alive"] is False and r1["stale"] is False
+
+
+def test_fleet_status_flags_stale_stats_file(tmp_path):
+    wd = str(tmp_path)
+    write_health(wd, 0, {"pid": os.getpid(), "records_served": 1})
+    stats = os.path.join(wd, "stats-worker-0.json")
+    with open(stats, "w") as f:
+        json.dump({"records": 1}, f)
+    old = time.time() - 120.0
+    os.utime(stats, (old, old))
+    row = fleet_status(wd)[0]  # default 10s threshold
+    assert row["stats_age_s"] > 100.0
+    assert row["alive"] is True and row["stale"] is True
+
+
+def test_fleet_metrics_merges_counters_across_workers(tmp_path):
+    wd = str(tmp_path)
+    for wid, served in ((0, 5.0), (1, 7.0)):
+        with open(os.path.join(wd, f"metrics-worker-{wid}.json"),
+                  "w") as f:
+            json.dump({"ts": time.time(),
+                       "service": f"serving-worker-{wid}",
+                       "metrics": [
+                           {"name": "zoo_served_total", "type": "counter",
+                            "labels": {}, "value": served},
+                           {"name": "zoo_stage_lat_s", "type": "summary",
+                            "labels": {}, "count": 3, "sum": 0.1,
+                            "quantiles": {}}]}, f)
+    view = fleet_metrics(wd)
+    assert [w["worker_id"] for w in view["workers"]] == ["0", "1"]
+    merged = {m["name"]: m["value"] for m in view["merged"]}
+    # counters sum; summaries stay per-worker (not mergeable)
+    assert merged == {"zoo_served_total": 12.0}
+    assert fleet_metrics(str(tmp_path / "nope")) == \
+        {"workers": [], "merged": []}
+
+
+def test_status_cli_renders_stale_worker(tmp_path, capsys):
+    from analytics_zoo_tpu.serving.cli import cmd_status
+
+    wd = str(tmp_path)
+    write_health(wd, 0, {"pid": os.getpid(), "records_served": 5})
+    stats = os.path.join(wd, "stats-worker-0.json")
+    with open(stats, "w") as f:
+        json.dump({"records": 5}, f)
+    old = time.time() - 120.0
+    os.utime(stats, (old, old))
+    rc = cmd_status(wd)
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "worker 0:" in out and "STALE" in out
 
 
 def test_status_cli_renders_worker_rows(tmp_path, capsys):
