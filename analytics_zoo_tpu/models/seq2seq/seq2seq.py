@@ -142,6 +142,34 @@ class _RNNCoder(KerasLayer):
             finals.extend(carry)
         return y, finals
 
+    def step(self, params, xt, states):
+        """One decode timestep: xt (B, D_in), states: n_states arrays
+        (B, H). Returns (ht (B, H), new_states).
+
+        The incremental-decode twin of ``_run_stack``: the recurrent
+        state IS the RNN's cache, so carrying it forward makes each
+        emitted token O(1) in sequence length — ``Seq2seq.infer`` used
+        to re-run the whole decoder prefix per token (O(T^2) total).
+        """
+        y = xt
+        if self.embedding is not None:
+            y = self.embedding.call(params.get("embedding", {}),
+                                    y[:, None], training=False)[:, 0]
+        h = self.hidden_size
+        spl = self.states_per_layer
+        new_states: List[jnp.ndarray] = []
+        for l in range(self.nlayers):
+            p = params[f"l{l}"]
+            xw = jnp.matmul(y, p["W"].astype(y.dtype)) + \
+                p["b"].astype(y.dtype)
+            carry = tuple(s.astype(y.dtype)
+                          for s in states[l * spl:(l + 1) * spl])
+            carry, y = _cell_step(self.rnn_type, carry, xw,
+                                  p["U"].astype(y.dtype), h, self.act,
+                                  self.inner)
+            new_states.extend(carry)
+        return y, new_states
+
 
 class RNNEncoder(_RNNCoder):
     """Outputs: [seq_output (B,T,H)] + per-layer final states
@@ -318,16 +346,83 @@ class Seq2seq(ZooModel):
 
     def infer(self, input, start_sign, max_seq_len=30, stop_sign=None,
               build_output=None):
-        """Greedy decode (Seq2seq.scala:114-160).
+        """Greedy decode (Seq2seq.scala:114-160), cached.
 
-        * input: (T_in, feat) or (1, T_in, feat) encoder input.
+        * input: (T_in, feat) or (B, T_in, feat) encoder input.
         * start_sign: (feat,) tensor fed as the first decoder step.
-        * stop_sign: stop early when the newest prediction matches.
-        * build_output: optional callable mapping the model output sequence
-          (e.g. a Dense over hidden) before selecting the last timestep.
+        * stop_sign: stop early when the newest prediction matches
+          (per sequence: a finished row repeats its stop token while the
+          rest of the batch keeps decoding).
+        * build_output: optional callable mapping the model output
+          sequence (e.g. a Dense over hidden) before selecting the last
+          timestep.
 
-        Returns the decoded sequence (1, T_out, ...) including start_sign.
+        Returns the decoded sequence (B, T_out, ...) including
+        start_sign. Matches ``infer_reference`` exactly, but runs the
+        encoder once and advances the decoder one timestep per token by
+        carrying the recurrent states — O(T) total instead of the
+        reference loop's O(T^2) full-prefix re-decode per token.
         """
+        input = np.asarray(input, np.float32)
+        if input.ndim == len(self.input_shape_):
+            input = input[None]
+        params, _ = self.model._params_tuple()
+        enc_outs = self.encoder.call(params[self.encoder.name],
+                                     jnp.asarray(input), training=False)
+        states = list(enc_outs[1:])
+        if self.bridge is not None:
+            mapped = self.bridge.call(params[self.bridge.name], states,
+                                      training=False)
+            states = list(mapped) if isinstance(mapped, tuple) \
+                else [mapped]
+
+        step = getattr(self, "_decode_step", None)
+        if step is None:
+            dec, gen = self.decoder, self.generator
+
+            def _step(params, xt, states):
+                y, new_states = dec.step(params[dec.name], xt, states)
+                out = y[:, None]
+                if gen is not None:
+                    out = gen.call(params[gen.name], out, training=False)
+                return out, new_states
+
+            step = self._decode_step = jax.jit(_step)
+
+        b = input.shape[0]
+        start = np.asarray(start_sign, np.float32)[None, None]
+        cur = np.broadcast_to(start,
+                              (b, 1) + start.shape[2:]).copy()
+        outs = [cur]
+        xt = jnp.asarray(cur[:, 0])
+        stop = None if stop_sign is None \
+            else np.asarray(stop_sign, np.float32)
+        done = np.zeros((b,), bool)
+        for _ in range(max_seq_len):
+            out, states = step(params, xt, states)
+            out_np = np.asarray(out)
+            if build_output is not None:
+                out_np = np.asarray(build_output(out_np))
+            nxt = out_np[:, -1:]
+            if done.any():
+                # frozen rows repeat their stop token; their recurrent
+                # states keep advancing but the outputs are pinned
+                nxt = np.where(done.reshape((b,) + (1,) * (nxt.ndim - 1)),
+                               outs[-1][:, -1:], nxt)
+            outs.append(nxt)
+            if stop is not None:
+                done |= np.array([np.allclose(nxt[i, 0], stop, atol=1e-8)
+                                  for i in range(b)])
+                if done.all():
+                    break
+            xt = jnp.asarray(nxt[:, 0])
+        return np.concatenate(outs, axis=1)
+
+    def infer_reference(self, input, start_sign, max_seq_len=30,
+                        stop_sign=None, build_output=None):
+        """The reference's per-token full-model re-predict loop — kept
+        as the parity oracle for ``infer`` (and for its exact batch-1
+        early-stop semantics)."""
         input = np.asarray(input, np.float32)
         if input.ndim == len(self.input_shape_):
             input = input[None]

@@ -1,5 +1,12 @@
 from .attention import (attention_blockwise, attention_reference,
                         flash_attention, flash_attention_blhd)
+from .kv_cache import (DecodeState, cache_length_buckets,
+                       cached_attention_step, decode_step_is_cached,
+                       evict_slot, init_decode_state, pick_cache_bucket,
+                       place_slot, write_prompt)
 
 __all__ = ["attention_blockwise", "attention_reference", "flash_attention",
-           "flash_attention_blhd"]
+           "flash_attention_blhd", "DecodeState", "cache_length_buckets",
+           "cached_attention_step", "decode_step_is_cached", "evict_slot",
+           "init_decode_state", "pick_cache_bucket", "place_slot",
+           "write_prompt"]

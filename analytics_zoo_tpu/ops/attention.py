@@ -351,9 +351,18 @@ def attention_blockwise(q, k, v, bias=None, causal=False, sm_scale=None,
 # Pallas flash attention (forward; backward via custom_vjp recompute)
 # ---------------------------------------------------------------------------
 
+def _compiler_params(dimension_semantics):
+    """jax renamed pltpu.TPUCompilerParams -> CompilerParams; resolve
+    whichever this install ships so interpret-mode runs on older jax."""
+    from jax.experimental.pallas import tpu as pltpu
+    cls = getattr(pltpu, "CompilerParams", None) or \
+        getattr(pltpu, "TPUCompilerParams")
+    return cls(dimension_semantics=dimension_semantics)
+
+
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, kb_ref, o_ref, lse_ref, m_scr,
                       l_scr, acc_scr, *, sm_scale, causal, block_q, block_k,
-                      num_k_blocks):
+                      num_k_blocks, q_offset=0):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
@@ -379,7 +388,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, kb_ref, o_ref, lse_ref, m_scr,
         # additive key bias (padding mask), broadcast over query rows
         s = s + kb_ref[0].astype(jnp.float32)      # (1, block_k) -> rows
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            # bottom-right alignment: query row i attends keys <= i + offset
+            # where offset = lk - lq; offset 0 recovers square-L masking,
+            # offset > 0 is the decode shape (short q vs long cached k).
+            q_pos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
@@ -399,8 +411,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, kb_ref, o_ref, lse_ref, m_scr,
 
     if causal:
         from jax.experimental import pallas as pl  # noqa: F811
-        # skip fully-masked k-blocks above the diagonal
-        pl.when(ki * block_k <= (qi + 1) * block_q - 1)(_compute)
+        # skip fully-masked k-blocks above the (offset-shifted) diagonal
+        pl.when(ki * block_k <= q_offset + (qi + 1) * block_q - 1)(_compute)
     else:
         _compute()
 
@@ -477,7 +489,8 @@ def _flash_forward(q, k, v, kbias, num_heads, causal, sm_scale,
 
     kernel = functools.partial(
         _flash_fwd_kernel, sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k, num_k_blocks=num_k)
+        block_q=block_q, block_k=block_k, num_k_blocks=num_k,
+        q_offset=lk - lq)
 
     kbias3 = kbias.reshape(kbias.shape[0], 1, lk)
 
@@ -508,8 +521,8 @@ def _flash_forward(q, k, v, kbias, num_heads, causal, sm_scale,
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        compiler_params=_compiler_params(
+            ("parallel", "parallel", "arbitrary")),
         interpret=_interpret_mode(),
     )
     with jax.named_scope("attn_hot"):
@@ -527,7 +540,7 @@ def _flash_forward(q, k, v, kbias, num_heads, causal, sm_scale,
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref,
                          delta_ref, dq_ref, dq_scr, *, sm_scale, causal,
-                         block_q, block_k, num_k_blocks):
+                         block_q, block_k, num_k_blocks, q_offset=0):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
@@ -550,7 +563,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref,
             preferred_element_type=jnp.float32) * sm_scale
         s = s + kb_ref[0].astype(jnp.float32)
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            q_pos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
@@ -565,7 +578,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref,
             preferred_element_type=jnp.float32) * sm_scale
 
     if causal:
-        pl.when(ki * block_k <= (qi + 1) * block_q - 1)(_compute)
+        pl.when(ki * block_k <= q_offset + (qi + 1) * block_q - 1)(_compute)
     else:
         _compute()
 
@@ -577,7 +590,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref,
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref,
                           delta_ref, dk_ref, dv_ref, db_ref, dk_scr, dv_scr,
                           db_scr, *, sm_scale, causal, block_q, block_k,
-                          num_q_blocks):
+                          num_q_blocks, q_offset=0):
     from jax.experimental import pallas as pl
 
     ki = pl.program_id(1)
@@ -599,7 +612,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref,
             preferred_element_type=jnp.float32) * sm_scale
         s = s + kb_ref[0].astype(jnp.float32)
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            q_pos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
@@ -618,7 +631,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, kb_ref, do_ref, lse_ref,
         db_scr[...] += ds.sum(axis=0, keepdims=True)   # (1, block_k)
 
     if causal:
-        pl.when((qi + 1) * block_q - 1 >= ki * block_k)(_compute)
+        pl.when(q_offset + (qi + 1) * block_q - 1 >= ki * block_k)(_compute)
     else:
         _compute()
 
@@ -656,7 +669,8 @@ def _flash_backward(q, k, v, kbias, o, lse, do, num_heads, causal, sm_scale,
     dq_call = pl.pallas_call(
         functools.partial(
             _flash_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-            block_q=block_q, block_k=block_k, num_k_blocks=num_k),
+            block_q=block_q, block_k=block_k, num_k_blocks=num_k,
+            q_offset=lk - lq),
         grid=(bh, num_q, num_k),
         in_specs=[qkv_spec_q, qkv_spec_k, qkv_spec_k,
                   _bias_specs_3d(num_heads, block_k),
@@ -664,8 +678,8 @@ def _flash_backward(q, k, v, kbias, o, lse, do, num_heads, causal, sm_scale,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=out_struct((bh, lq, d), q.dtype, q, k, v, do),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        compiler_params=_compiler_params(
+            ("parallel", "parallel", "arbitrary")),
         interpret=_interpret_mode(),
     )
     with jax.named_scope("attn_hot"):
@@ -679,7 +693,8 @@ def _flash_backward(q, k, v, kbias, o, lse, do, num_heads, causal, sm_scale,
     dkv_call = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
-            block_q=block_q, block_k=block_k, num_q_blocks=num_q),
+            block_q=block_q, block_k=block_k, num_q_blocks=num_q,
+            q_offset=lk - lq),
         grid=(bh, num_k, num_q),
         in_specs=[kv_spec_q, kv_spec_k, kv_spec_k,
                   pl.BlockSpec((1, 1, block_k),
@@ -700,8 +715,8 @@ def _flash_backward(q, k, v, kbias, o, lse, do, num_heads, causal, sm_scale,
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((1, block_k), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        compiler_params=_compiler_params(
+            ("parallel", "parallel", "arbitrary")),
         interpret=_interpret_mode(),
     )
     with jax.named_scope("attn_hot"):
@@ -808,7 +823,8 @@ def _flash_forward_blhd(q, k, v, kbias, causal, sm_scale,
 
     kernel = functools.partial(
         _flash_fwd_kernel, sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k, num_k_blocks=num_k)
+        block_q=block_q, block_k=block_k, num_k_blocks=num_k,
+        q_offset=lk - lq)
 
     kbias3 = kbias.reshape(kbias.shape[0], 1, lk)
     q_spec = _blhd_spec(block_q, d, h, "qi")
@@ -835,8 +851,8 @@ def _flash_forward_blhd(q, k, v, kbias, causal, sm_scale,
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        compiler_params=_compiler_params(
+            ("parallel", "parallel", "arbitrary")),
         interpret=_interpret_mode(),
     )
     with jax.named_scope("attn_hot"):
@@ -877,15 +893,16 @@ def _flash_backward_blhd(q, k, v, kbias, o, lse, do, causal, sm_scale,
     dq_call = pl.pallas_call(
         functools.partial(
             _flash_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-            block_q=block_q, block_k=block_k, num_k_blocks=num_k),
+            block_q=block_q, block_k=block_k, num_k_blocks=num_k,
+            q_offset=lk - lq),
         grid=(bh, num_q, num_k),
         in_specs=[q_spec, k_spec, k_spec, _bias_specs_3d(h, block_k),
                   q_spec, row_spec_q, delta_spec_i],
         out_specs=q_spec,
         out_shape=out_struct((b, lq, h, d), q.dtype, q, k, v, do),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        compiler_params=_compiler_params(
+            ("parallel", "parallel", "arbitrary")),
         interpret=_interpret_mode(),
     )
     with jax.named_scope("attn_hot"):
@@ -900,7 +917,8 @@ def _flash_backward_blhd(q, k, v, kbias, o, lse, do, causal, sm_scale,
     dkv_call = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
-            block_q=block_q, block_k=block_k, num_q_blocks=num_q),
+            block_q=block_q, block_k=block_k, num_q_blocks=num_q,
+            q_offset=lk - lq),
         grid=(bh, num_k, num_q),
         in_specs=[kv_spec_q, kv_spec_k, kv_spec_k,
                   pl.BlockSpec((1, 1, block_k),
@@ -921,8 +939,8 @@ def _flash_backward_blhd(q, k, v, kbias, o, lse, do, causal, sm_scale,
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((1, block_k), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        compiler_params=_compiler_params(
+            ("parallel", "parallel", "arbitrary")),
         interpret=_interpret_mode(),
     )
     with jax.named_scope("attn_hot"):
@@ -1165,11 +1183,14 @@ def _route_eligible(on_tpu, kb, lq, lk, d, causal) -> bool:
     """Shared cheap routing gates, checked BEFORE the per-shape probe (a
     short-sequence warmup must not pay a Mosaic compile just to be routed
     to XLA anyway). d=64 (the common head dim) is allowed: Mosaic pads
-    the lane dim. causal requires lq == lk: the kernel masks top-left
-    aligned while the reference masks bottom-right aligned."""
+    the lane dim. causal requires lq <= lk: the kernels mask bottom-right
+    aligned (offset = lk - lq, matching the reference), but lq > lk would
+    leave the leading query rows fully masked — their softmax degenerates
+    to the l_safe epsilon — so those shapes stay on the blockwise path,
+    which zeroes masked rows explicitly."""
     eligible = (on_tpu and kb is not None and lq >= 128 and lk >= 128 and
                 lq % 128 == 0 and lk % 128 == 0 and
-                d % 64 == 0 and (not causal or lq == lk) and
+                d % 64 == 0 and (not causal or lq <= lk) and
                 mosaic_partition_ok())
     if os.environ.get("ZOO_TPU_FORCE_PALLAS", "0") != "1" and \
             lq < KERNEL_MIN_SEQ:

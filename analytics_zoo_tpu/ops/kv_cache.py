@@ -1,0 +1,195 @@
+"""KV-cache incremental decode: slab-allocated cache + append-one attention.
+
+The generative serving path (serving/generation.py) and ``Seq2seq.infer``
+decode one token per model call. Recomputing full-sequence attention per
+token is O(L^2) per emitted token — the classic autoregressive trap. This
+module provides the O(L)-per-token alternative:
+
+- ``DecodeState``: a pytree carrying per-layer K/V cache slabs in the blhd
+  layout (B, S, H, D) — the layout the fused-QKV reshape produces, same as
+  ``flash_attention_blhd`` — plus per-sequence write lengths and an RNG.
+- ``prefill``-side helpers that run the prompt through the existing
+  flash/blockwise route once (causal, bottom-right aligned now that the
+  kernels accept lq <= lk) and then stash the projected K/V into the slab.
+- ``cached_attention_step``: one-token attention against the slab — an
+  einsum contracting the single query row against S cached keys, masked at
+  each sequence's write length. The jaxpr contains no (L, L) contraction;
+  ``decode_step_is_cached`` (bench gate) asserts exactly that.
+
+Cache slabs are preallocated at power-of-two lengths (``pick_cache_bucket``)
+so XLA compiles a small fixed set of decode-step shapes; a sequence that
+outgrows its slab is re-placed into the next bucket by the scheduler rather
+than triggering a recompile per token.
+"""
+
+import math
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class DecodeState(NamedTuple):
+    """Pytree state threaded through ``decode_step``.
+
+    ``k_cache``/``v_cache``: one (B, S, H, D) slab per transformer layer
+    (blhd layout; S is the bucket capacity, shared by every slot).
+    ``lengths``: (B,) int32 — tokens written per slot; slot b's valid cache
+    rows are ``[0, lengths[b])``. A freed slot is just ``lengths[b] = 0``:
+    stale rows are masked out, never read.
+    ``rng``: PRNGKey for sampling, split per step (None => greedy only).
+    """
+    k_cache: Tuple[jnp.ndarray, ...]
+    v_cache: Tuple[jnp.ndarray, ...]
+    lengths: jnp.ndarray
+    rng: Optional[jnp.ndarray]
+
+    @property
+    def batch(self) -> int:
+        return self.k_cache[0].shape[0]
+
+    @property
+    def capacity(self) -> int:
+        return self.k_cache[0].shape[1]
+
+    @property
+    def num_layers(self) -> int:
+        return len(self.k_cache)
+
+
+def cache_length_buckets(max_len: int, min_bucket: int = 128):
+    """Power-of-two slab capacities up to (and covering) ``max_len`` —
+    the decode analogue of serving's padding buckets: a small fixed shape
+    set so XLA compiles each decode-step signature once."""
+    if max_len < 1:
+        raise ValueError(f"max_len must be >= 1, got {max_len}")
+    lo = max(1, min_bucket)
+    buckets = []
+    b = 1 << max(0, math.ceil(math.log2(lo)))
+    while True:
+        buckets.append(b)
+        if b >= max_len:
+            return buckets
+        b *= 2
+
+
+def pick_cache_bucket(length: int, buckets) -> int:
+    """Smallest bucket holding ``length`` tokens (prompt + generation
+    headroom). Lengths beyond the largest bucket raise: the scheduler must
+    clamp max_new_tokens to the slab budget at admission, not discover the
+    overflow mid-generation."""
+    for b in buckets:
+        if length <= b:
+            return b
+    raise ValueError(
+        f"length {length} exceeds largest cache bucket {buckets[-1]}")
+
+
+def init_decode_state(num_layers: int, batch: int, capacity: int,
+                      num_heads: int, head_dim: int,
+                      dtype=jnp.float32, rng=None) -> DecodeState:
+    """Preallocate zeroed (B, S, H, D) slabs for every layer."""
+    shape = (batch, capacity, num_heads, head_dim)
+    zeros = tuple(jnp.zeros(shape, dtype) for _ in range(num_layers))
+    return DecodeState(k_cache=zeros, v_cache=zeros,
+                       lengths=jnp.zeros((batch,), jnp.int32), rng=rng)
+
+
+def _write_row(cache, new, lengths):
+    """Write each sequence's (1, H, D) row at its own offset.
+
+    vmapped ``dynamic_update_slice`` keeps this a scatter of B rows into
+    the slab — no slab copy per step beyond XLA's buffer reuse."""
+    return jax.vmap(
+        lambda c, x, i: jax.lax.dynamic_update_slice(c, x, (i, 0, 0))
+    )(cache, new, lengths)
+
+
+def write_prompt(cache, kv, lengths=None):
+    """Stash projected prompt K/V (B, Lp, H, D) into the slab head.
+
+    The slab tail keeps zeros; they are masked by ``lengths`` at read time
+    so per-sequence prompt padding inside Lp is harmless too."""
+    lp = kv.shape[1]
+    cap = cache.shape[1]
+    if lp > cap:
+        raise ValueError(f"prompt length {lp} exceeds slab capacity {cap}")
+    return cache.at[:, :lp].set(kv.astype(cache.dtype))
+
+
+def place_slot(cache, slot, kv):
+    """Replace one slot's slab with a freshly prefetched (S, H, D) or
+    (Lp, H, D) sequence — the continuous-batching join path."""
+    lp = kv.shape[0]
+    return jax.lax.dynamic_update_slice(
+        cache, kv[None].astype(cache.dtype), (slot, 0, 0, 0))
+
+
+def evict_slot(lengths, slot):
+    """Freeing a slot is a length reset — stale K/V rows stay in the slab
+    but are masked out of every subsequent step."""
+    return lengths.at[slot].set(0)
+
+
+def cached_attention_step(q, k_new, v_new, k_cache, v_cache, lengths,
+                          sm_scale=None):
+    """One decode step of attention against the cache. O(S) per token.
+
+    q, k_new, v_new: (B, 1, H, D) — this step's projected query/key/value.
+    k_cache, v_cache: (B, S, H, D) slabs; lengths: (B,) int32 rows written.
+
+    Returns (o, k_cache, v_cache, new_lengths) with o: (B, 1, H, D). The
+    new K/V row is written at ``lengths`` first, so the query attends to
+    itself (causal row i sees keys <= i) and ``new_lengths = lengths + 1``.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    k_cache = _write_row(k_cache, k_new, lengths)
+    v_cache = _write_row(v_cache, v_new, lengths)
+    new_lengths = lengths + 1
+
+    # (B, H, S) scores: single query row vs the whole slab — the only
+    # attention contraction in the step jaxpr, and it is O(S), not O(S^2).
+    f32 = jnp.float32
+    s = jnp.einsum("bhd,bshd->bhs", q[:, 0].astype(f32),
+                   k_cache.astype(f32)) * sm_scale
+    valid = jnp.arange(k_cache.shape[1])[None, :] < new_lengths[:, None]
+    s = jnp.where(valid[:, None, :], s, -1e30)
+    # rows with lengths == 0 (empty slots) softmax over the single -1e30
+    # plateau — finite, and the scheduler discards their output anyway
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhs,bshd->bhd", p, v_cache.astype(f32))
+    return (o[:, None].astype(q.dtype), k_cache, v_cache, new_lengths)
+
+
+def decode_step_is_cached(fn, *args, capacity=None, **kwargs) -> bool:
+    """Jaxpr probe (bench/CI gate): True iff ``fn(*args)`` contains no
+    full-sequence attention contraction — no ``dot_general`` (or einsum
+    lowering) whose OUTPUT carries two axes of at least the slab capacity.
+    The cached step's score tensor is (B, H, S): one S axis. A fallback
+    that recomputed attention over the whole history would produce an
+    (S, S) score block and trip this.
+    """
+    from .attn_smoke import _iter_eqns
+
+    if capacity is None:
+        raise ValueError("pass capacity= (the slab length S)")
+    jaxpr = jax.make_jaxpr(fn, **kwargs)(*args).jaxpr
+
+    def big_square(var):
+        shape = getattr(getattr(var, "aval", None), "shape", ())
+        dims = [d for d in shape if isinstance(d, int) and d >= capacity]
+        return len(dims) >= 2
+
+    for eqn in _iter_eqns(jaxpr):
+        if eqn.primitive.name == "dot_general" and any(
+                big_square(v) for v in eqn.outvars):
+            return False
+    return True
+
+
+__all__ = [
+    "DecodeState", "cache_length_buckets", "pick_cache_bucket",
+    "init_decode_state", "write_prompt", "place_slot", "evict_slot",
+    "cached_attention_step", "decode_step_is_cached",
+]

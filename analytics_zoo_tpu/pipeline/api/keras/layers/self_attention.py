@@ -388,16 +388,18 @@ class TransformerLayer(KerasLayer):
         a = self._attention(p, x, mask_bias, r1, training)
         n = _dp_dropout_add_ln(a, x, p["ln1_g"], p["ln1_b"], r2,
                                self.hidden_p_drop, training)
-        if self.moe_experts:
-            m = self._moe.call(p["moe"], n, training=training)
-        else:
-            m = jnp.matmul(n, p["mlp_in_w"].astype(x.dtype)) + \
-                p["mlp_in_b"].astype(x.dtype)
-            m = self._gelu(m)
-            m = jnp.matmul(m, p["mlp_out_w"].astype(x.dtype)) + \
-                p["mlp_out_b"].astype(x.dtype)
+        m = self._ffn(p, n, training)
         return _dp_dropout_add_ln(m, n, p["ln2_g"], p["ln2_b"], r3,
                                   self.hidden_p_drop, training)
+
+    def _ffn(self, p, n, training):
+        if self.moe_experts:
+            return self._moe.call(p["moe"], n, training=training)
+        m = jnp.matmul(n, p["mlp_in_w"].astype(n.dtype)) + \
+            p["mlp_in_b"].astype(n.dtype)
+        m = self._gelu(m)
+        return jnp.matmul(m, p["mlp_out_w"].astype(n.dtype)) + \
+            p["mlp_out_b"].astype(n.dtype)
 
     def _embed(self, params, inputs, rng, training):
         if self.embedding_layer is not None:
@@ -465,6 +467,128 @@ class TransformerLayer(KerasLayer):
         out = pipeline_forward(stage, blocks, tree, mesh,
                                n_microbatch=n_micro)
         return out["x"]
+
+    # -- KV-cache incremental decode (ops/kv_cache.py) -----------------
+    #
+    # The generative-serving path: prefill runs the prompt once through
+    # the standard causal flash/blockwise route and stashes every
+    # block's projected K/V into preallocated slabs; decode_step then
+    # advances one token per call with O(S) cached attention — the
+    # step's jaxpr has no (L, L) contraction (bench generate gate).
+    # Decode is inference-only: no dropout, per-block param layout
+    # (pipeline_parallel stacking is a training layout).
+
+    def _require_decode_layout(self, params):
+        if self.bidirectional:
+            raise ValueError(
+                "KV-cache decode needs a causal trunk; this layer was "
+                "built bidirectional (BERT-style)")
+        if "blocks" in params:
+            raise ValueError(
+                "KV-cache decode does not support the pipeline-parallel "
+                "stacked-block layout; rebuild with pipeline_parallel=1")
+
+    def init_decode_state(self, batch, capacity, dtype=jnp.float32,
+                          rng=None):
+        """Preallocate (B, S, H, D) K/V slabs for every block."""
+        from .....ops.kv_cache import init_decode_state
+        return init_decode_state(
+            self.n_block, batch, capacity, self.n_head,
+            self.hidden_size // self.n_head, dtype=dtype, rng=rng)
+
+    def lm_logits(self, params, x):
+        """Token logits via embedding weight tying: x @ tok_emb^T."""
+        if self.embedding_layer is not None:
+            raise ValueError("lm_logits needs the built-in token "
+                             "embedding (weight tying)")
+        return jnp.matmul(x, params["tok_emb"].T.astype(x.dtype))
+
+    def prefill(self, params, tokens, lengths, state):
+        """Fill the cache from padded prompts; return last-token logits.
+
+        tokens: (B, Lp) left-aligned prompt ids padded to a shared Lp;
+        lengths: (B,) int32 true prompt lengths (the ragged tail is
+        masked with a key bias). Returns (logits (B, vocab), state).
+        """
+        from .....ops.kv_cache import write_prompt
+        self._require_decode_layout(params)
+        tokens = tokens.astype(jnp.int32)
+        b, lp = tokens.shape
+        nh = self.n_head
+        d = self.hidden_size // nh
+        x = jnp.take(params["tok_emb"], tokens, axis=0)
+        x = x + params["pos_emb"][None, :lp]
+        # additive key bias over the padded tail, rides the flash route
+        # exactly like BERT's attention_mask bias
+        kb = jnp.where(jnp.arange(lp)[None, :] < lengths[:, None],
+                       0.0, -1e9).astype(jnp.float32)
+        kb = kb[:, None, None, :]
+        k_caches, v_caches = [], []
+        for i in range(self.n_block):
+            p = params[f"block{i}"]
+            qkv = jnp.matmul(x, p["qkv_w"].astype(x.dtype)) + \
+                p["qkv_b"].astype(x.dtype)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q4, k4, v4 = (t.reshape(b, lp, nh, d) for t in (q, k, v))
+            o = flash_attention_blhd(q4, k4, v4, bias=kb, causal=True)
+            k_caches.append(write_prompt(state.k_cache[i], k4))
+            v_caches.append(write_prompt(state.v_cache[i], v4))
+            a = jnp.matmul(o.reshape(b, lp, self.hidden_size),
+                           p["proj_w"].astype(x.dtype)) + \
+                p["proj_b"].astype(x.dtype)
+            n = _dp_dropout_add_ln(a, x, p["ln1_g"], p["ln1_b"], None,
+                                   0.0, False)
+            m = self._ffn(p, n, False)
+            x = _dp_dropout_add_ln(m, n, p["ln2_g"], p["ln2_b"], None,
+                                   0.0, False)
+        last = jnp.take_along_axis(
+            x, jnp.maximum(lengths - 1, 0)[:, None, None].astype(
+                jnp.int32), axis=1)[:, 0]
+        state = state._replace(k_cache=tuple(k_caches),
+                               v_cache=tuple(v_caches),
+                               lengths=lengths.astype(jnp.int32))
+        return self.lm_logits(params, last), state
+
+    def decode_step(self, params, state, tokens):
+        """Advance every slot one token: (B,) ids -> ((B, vocab), state).
+
+        Appends each slot's K/V row at its own write offset and attends
+        the single query row against the slab — O(S) per token, no
+        full-sequence recompute.
+        """
+        from .....ops.kv_cache import cached_attention_step
+        self._require_decode_layout(params)
+        nh = self.n_head
+        d = self.hidden_size // nh
+        b = state.lengths.shape[0]
+        pos = jnp.minimum(state.lengths, self.seq_len - 1)
+        x = jnp.take(params["tok_emb"], tokens.astype(jnp.int32),
+                     axis=0)[:, None]
+        x = x + jnp.take(params["pos_emb"], pos, axis=0)[:, None]
+        k_caches, v_caches = [], []
+        for i in range(self.n_block):
+            p = params[f"block{i}"]
+            qkv = jnp.matmul(x, p["qkv_w"].astype(x.dtype)) + \
+                p["qkv_b"].astype(x.dtype)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            o, kc, vc, _ = cached_attention_step(
+                q.reshape(b, 1, nh, d), k.reshape(b, 1, nh, d),
+                v.reshape(b, 1, nh, d), state.k_cache[i],
+                state.v_cache[i], state.lengths)
+            k_caches.append(kc)
+            v_caches.append(vc)
+            a = jnp.matmul(o.reshape(b, 1, self.hidden_size),
+                           p["proj_w"].astype(x.dtype)) + \
+                p["proj_b"].astype(x.dtype)
+            n = _dp_dropout_add_ln(a, x, p["ln1_g"], p["ln1_b"], None,
+                                   0.0, False)
+            m = self._ffn(p, n, False)
+            x = _dp_dropout_add_ln(m, n, p["ln2_g"], p["ln2_b"], None,
+                                   0.0, False)
+        state = state._replace(k_cache=tuple(k_caches),
+                               v_cache=tuple(v_caches),
+                               lengths=state.lengths + 1)
+        return self.lm_logits(params, x[:, 0]), state
 
     def call(self, params, inputs, training=False, rng=None, **kw):
         e, mask_bias = self._embed(params, inputs, rng, training)

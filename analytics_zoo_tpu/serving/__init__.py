@@ -9,12 +9,15 @@ admission control (docs/serving-fleet.md).
 
 from .admission import (AdaptiveBatcher, AdmissionController, SHED_DEADLINE,
                         SHED_EXPIRED)
-from .client import (API, InputQueue, OutputQueue, ServingError,
-                     ServingRejected, ServingResult, ServingTimeout)
+from .client import (API, GenerationResult, InputQueue, OutputQueue,
+                     ServingError, ServingRejected, ServingResult,
+                     ServingTimeout)
 from .cluster_serving import (ClusterServing, ClusterServingHelper,
                               EchoStubModel, RecordMeta, pick_bucket,
                               power_of_two_buckets)
 from .fleet import ServingFleet, fleet_status
+from .generation import (ContinuousBatchScheduler, GenRequest,
+                         StubDecodeEngine, TransformerDecodeEngine)
 from .queue_backend import (FileStreamQueue, InProcessStreamQueue,
                             StreamQueue, get_queue_backend)
 from .registry import (CanaryState, DeployError, ModelRegistry,
@@ -32,4 +35,6 @@ __all__ = ["InputQueue", "OutputQueue", "API", "ServingError",
            "UnknownModelError", "DeployError", "RegistryControlServer",
            "control_request", "RoutedClusterServing",
            "AdmissionController", "AdaptiveBatcher", "SHED_DEADLINE",
-           "SHED_EXPIRED", "ServingFleet", "fleet_status"]
+           "SHED_EXPIRED", "ServingFleet", "fleet_status",
+           "GenerationResult", "ContinuousBatchScheduler", "GenRequest",
+           "StubDecodeEngine", "TransformerDecodeEngine"]

@@ -62,6 +62,7 @@ class AdmissionController:
         self.safety_ms = float(safety_ms)
         self._record_ms = Ewma(alpha)   # per-record service time
         self._batch_ms = Ewma(alpha)    # per-dispatch wall time
+        self._token_ms = Ewma(alpha)    # per-token decode step time
         self._lock = threading.Lock()
         self.shed_deadline = 0
         self.shed_expired = 0
@@ -73,6 +74,13 @@ class AdmissionController:
         self._batch_ms.update(ms)
         self._record_ms.update(ms / max(int(n), 1))
 
+    def observe_tokens(self, n_tokens: int, seconds: float):
+        """One continuous-batching decode step emitted ``n_tokens``
+        (one per in-flight sequence) in ``seconds`` — maintains the
+        per-token service estimate the generate admission path uses."""
+        if n_tokens > 0:
+            self._token_ms.update(float(seconds) * 1e3)
+
     @property
     def record_ms(self) -> float:
         return self._record_ms.value or 0.0
@@ -80,6 +88,12 @@ class AdmissionController:
     @property
     def batch_ms(self) -> float:
         return self._batch_ms.value or 0.0
+
+    @property
+    def token_ms(self) -> float:
+        """EWMA wall time of one decode step (every in-flight sequence
+        advances one token per step, so this is also per-sequence)."""
+        return self._token_ms.value or 0.0
 
     # -- decisions ------------------------------------------------------
     def estimate_wait_ms(self, backlog: int) -> float:
@@ -98,6 +112,44 @@ class AdmissionController:
                 self.shed_deadline += 1
             return False, SHED_DEADLINE
         return True, None
+
+    def admit_generate(self, slack_ms: Optional[float], max_new_tokens: int,
+                       queue_depth: int = 0
+                       ) -> Tuple[bool, Optional[str]]:
+        """Admission for a generate request: the EWMA deadline shed
+        extended with the per-token service estimate. The request is
+        admitted only when prefill (≈ one batch) plus
+        ``max_new_tokens`` decode steps plus the wait for a free cache
+        slot (``queue_depth`` requests ahead, each worth one more
+        token-stream in front of us) fits its slack.  With no token
+        observations yet, only the batch/safety terms apply — never
+        shed on a guess with no data behind it.
+        """
+        if slack_ms is None:
+            return True, None
+        est = (self.batch_ms + self.safety_ms +
+               max(int(max_new_tokens), 1) * self.token_ms +
+               max(int(queue_depth), 0) * self.token_ms)
+        if est > slack_ms:
+            with self._lock:
+                self.shed_deadline += 1
+            return False, SHED_DEADLINE
+        return True, None
+
+    def stream_expired(self, deadline_at_ms: Optional[float],
+                       at_ms: Optional[float] = None) -> bool:
+        """Mid-generation deadline check, one call per emitted token:
+        True when even one more decode step lands past the deadline.
+        The scheduler evicts the sequence and commits a typed
+        ``shed_deadline`` payload carrying the partial tokens."""
+        if deadline_at_ms is None:
+            return False
+        at = now_ms() if at_ms is None else at_ms
+        if at + self.token_ms + self.safety_ms > deadline_at_ms:
+            with self._lock:
+                self.shed_deadline += 1
+            return True
+        return False
 
     def expired(self, deadline_at_ms: Optional[float],
                 at_ms: Optional[float] = None) -> bool:
@@ -119,6 +171,7 @@ class AdmissionController:
                     "shed_expired": self.shed_expired,
                     "est_record_ms": round(self.record_ms, 3),
                     "est_batch_ms": round(self.batch_ms, 3),
+                    "est_token_ms": round(self.token_ms, 3),
                     "safety_ms": self.safety_ms}
 
 

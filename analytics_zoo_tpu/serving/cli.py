@@ -21,6 +21,11 @@ Usage::
     python -m analytics_zoo_tpu.serving.cli stop   [--dir DIR]
     python -m analytics_zoo_tpu.serving.cli restart [--dir DIR]
     python -m analytics_zoo_tpu.serving.cli shutdown [--dir DIR]
+    python -m analytics_zoo_tpu.serving.cli generate [--dir DIR]
+                                                   --prompt "7, 3"
+                                                   [--max-new-tokens N]
+                                                   [--stop-id ID]
+                                                   [--deadline-ms MS]
 
 Model-registry verbs (config has a ``registry:`` section —
 docs/model-registry.md).  Against a *running* server they go through the
@@ -80,6 +85,18 @@ params:
   # default_deadline_ms: 250 # deadline for records that carry none
   # admission_safety_ms: 2.0 # slop subtracted from every slack estimate
   # linger_ms: 0             # max wait to round batches up to a bucket
+
+## generative serving (docs/serving-generate.md): uncomment to serve a
+## `generate` endpoint with KV-cache decode + continuous batching
+# generate:
+#   slots: 4                 # in-flight sequences (cache slots)
+#   continuous: true         # false = static batching (bench baseline)
+#   max_len: 1024            # largest prompt+generation a slab can hold
+#   max_new_tokens: 32       # default token budget per request
+#   stop_id: 0               # default stop token (omit for none)
+#   stub_ms_per_step: 1.0    # deterministic stub engine (smoke/bench);
+#                            # omit and inject a real engine via
+#                            # ClusterServing.set_generate_engine
 
 ## model registry (docs/model-registry.md): uncomment to serve many
 ## named, versioned models with hot-swap + canary rollout
@@ -435,6 +452,51 @@ def _registry_op(workdir: str, op: str, **kw) -> int:
     return 0
 
 
+def cmd_generate(workdir: str, prompt: str, max_new_tokens=None,
+                 stop_id=None, temperature=None, deadline_ms=None,
+                 timeout: float = 30.0) -> int:
+    """Submit one generate request against the running server's
+    transport and print the token stream as JSON (the client-side smoke
+    for docs/serving-generate.md)."""
+    cfg = _load_config(workdir)
+    src = (cfg.get("data") or {}).get("src")
+    if not src:
+        print("config has no data.src; `generate` needs a shared "
+              "transport (file:<dir> or redis)", file=sys.stderr)
+        return 1
+    from .client import InputQueue, OutputQueue, ServingError
+
+    try:
+        tokens = [int(t) for t in prompt.replace(",", " ").split()]
+    except ValueError:
+        print(f"--prompt must be int token ids, got {prompt!r}",
+              file=sys.stderr)
+        return 1
+    iq = InputQueue(address=src)
+    oq = OutputQueue(backend=iq.db)
+    uri = f"gen-{os.getpid()}-{time.time_ns()}"
+    iq.enqueue_generate(uri, tokens, max_new_tokens=max_new_tokens,
+                        stop_id=stop_id, temperature=temperature,
+                        deadline_ms=deadline_ms)
+    got = oq.wait_all([uri], timeout=timeout)
+    res = got.get(uri)
+    if res is None:
+        print(f"no result for {uri} within {timeout:.0f}s (is the "
+              f"server running with a generate engine?)", file=sys.stderr)
+        return 1
+    if isinstance(res, ServingError):
+        out = {"uri": uri, "error": res.message,
+               "code": getattr(res, "code", None)}
+        partial = getattr(res, "tokens", None)
+        if partial is not None:
+            out["tokens"] = [int(t) for t in partial]
+        print(json.dumps(out), file=sys.stderr)
+        return 1
+    print(json.dumps({"uri": uri, "tokens": [int(t) for t in res],
+                      "finish": res.finish, "timing": res.timing}))
+    return 0
+
+
 def cmd_stop(workdir: str, timeout: float = 10.0) -> int:
     _, pidfile, _ = _paths(workdir)
     pid = _read_pid(pidfile)
@@ -488,7 +550,8 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="zoo-serving")
     ap.add_argument("command", choices=["init", "start", "fleet", "status",
                                         "stop", "restart", "shutdown",
-                                        "deploy", "promote", "undeploy"])
+                                        "deploy", "promote", "undeploy",
+                                        "generate"])
     ap.add_argument("--dir", default=".", help="serving working directory")
     ap.add_argument("--workers", default=None, type=int,
                     help="fleet: worker process count (default: config "
@@ -525,6 +588,20 @@ def main(argv=None) -> int:
                     help="deploy --quantize: exported calibration-scales "
                          "JSON (defaults to calibration.json inside the "
                          "model directory when present)")
+    ap.add_argument("--prompt", default=None,
+                    help="generate: prompt token ids (comma/space "
+                         "separated ints)")
+    ap.add_argument("--max-new-tokens", default=None, type=int,
+                    help="generate: token budget (default: server config)")
+    ap.add_argument("--stop-id", default=None, type=int,
+                    help="generate: stop token id")
+    ap.add_argument("--temperature", default=None, type=float,
+                    help="generate: sampling temperature (0 = greedy)")
+    ap.add_argument("--deadline-ms", default=None, type=float,
+                    help="generate: end-to-end deadline; unmeetable "
+                         "requests are shed with a typed rejection")
+    ap.add_argument("--timeout", default=30.0, type=float,
+                    help="generate: seconds to wait for the result")
     args = ap.parse_args(argv)
     workdir = os.path.abspath(args.dir)
     if args.trace_dir:
@@ -566,6 +643,16 @@ def main(argv=None) -> int:
             return 1
         return _registry_op(workdir, "undeploy", model=args.model,
                             version=args.version)
+    if args.command == "generate":
+        if not args.prompt:
+            print("generate needs --prompt <token ids>", file=sys.stderr)
+            return 1
+        return cmd_generate(workdir, args.prompt,
+                            max_new_tokens=args.max_new_tokens,
+                            stop_id=args.stop_id,
+                            temperature=args.temperature,
+                            deadline_ms=args.deadline_ms,
+                            timeout=args.timeout)
     return cmd_shutdown(workdir)
 
 

@@ -47,14 +47,20 @@ class ServingRejected(ServingError):
     """Typed load-shed rejection: admission control determined the
     record could not meet its ``deadline_ms`` (``code`` is
     ``shed_deadline`` at intake, ``shed_expired`` when the deadline
-    passed while queued — docs/serving-fleet.md#admission)."""
+    passed while queued — docs/serving-fleet.md#admission).  For a
+    generate request shed *mid-stream* (deadline passed while decoding)
+    ``tokens`` carries the partial token array the budget allowed —
+    docs/serving-generate.md#deadlines."""
 
     def __init__(self, uri: Optional[str], message: str,
                  code: str = "shed_deadline",
                  model: Optional[str] = None,
-                 version: Optional[int] = None):
+                 version: Optional[int] = None,
+                 tokens=None):
         super().__init__(uri, message, model, version)
         self.code = code
+        self.tokens = (np.asarray(tokens, np.int64)
+                       if tokens is not None else None)
 
 
 class ServingTimeout(ServingError):
@@ -91,6 +97,28 @@ class ServingResult(np.ndarray):
     @classmethod
     def wrap(cls, value, timing: Optional[dict]) -> "ServingResult":
         out = np.asarray(value, np.float32).view(cls)
+        out.timing = timing
+        return out
+
+
+class GenerationResult(np.ndarray):
+    """A generated token stream: the int64 token array, plus ``finish``
+    (why the sequence ended: ``stop_id`` / ``max_new_tokens``) and the
+    per-sequence ``timing`` dict (``ttft_ms``, ``decode_ms``,
+    ``tokens_per_s``, ``rtt_ms`` — docs/serving-generate.md)."""
+
+    timing: Optional[dict]
+    finish: Optional[str]
+
+    def __array_finalize__(self, obj):
+        self.timing = getattr(obj, "timing", None)
+        self.finish = getattr(obj, "finish", None)
+
+    @classmethod
+    def wrap(cls, tokens, finish: Optional[str],
+             timing: Optional[dict]) -> "GenerationResult":
+        out = np.asarray(tokens, np.int64).view(cls)
+        out.finish = finish
         out.timing = timing
         return out
 
@@ -148,6 +176,30 @@ class InputQueue(API):
             k: {"shape": list(np.asarray(v).shape),
                 "data": np.asarray(v, np.float32).tobytes()}
             for k, v in tensors.items()}}
+        return self.db.enqueue(
+            self._route_fields(rec, model, version, deadline_ms))
+
+    def enqueue_generate(self, uri: str, prompt,
+                         max_new_tokens: Optional[int] = None,
+                         stop_id: Optional[int] = None,
+                         temperature: Optional[float] = None,
+                         model: Optional[str] = None,
+                         version: Optional[int] = None,
+                         deadline_ms: Optional[float] = None) -> str:
+        """Submit a generate request: ``prompt`` is a 1-D sequence of
+        int token ids; the result (an int64 :class:`GenerationResult`
+        of newly generated tokens) lands under ``uri`` the moment the
+        sequence finishes — sequences in the same continuous batch
+        complete independently (docs/serving-generate.md).  Omitted
+        sampling fields fall back to the server's configured defaults."""
+        gen: dict = {"prompt": [int(t) for t in np.asarray(prompt).ravel()]}
+        if max_new_tokens is not None:
+            gen["max_new_tokens"] = int(max_new_tokens)
+        if stop_id is not None:
+            gen["stop_id"] = int(stop_id)
+        if temperature is not None:
+            gen["temperature"] = float(temperature)
+        rec = {"uri": uri, "generate": gen}
         return self.db.enqueue(
             self._route_fields(rec, model, version, deadline_ms))
 
@@ -222,10 +274,12 @@ class OutputQueue(API):
         obj = json.loads(value.decode("utf-8"))
         if isinstance(obj, dict) and "error" in obj:
             code = obj.get("code")
-            if code in ("shed_deadline", "shed_expired"):
+            if code in ("shed_deadline", "shed_expired",
+                        "shed_capacity", "cancelled"):
                 return ServingRejected(uri, obj["error"], code,
                                        obj.get("model"),
-                                       obj.get("version"))
+                                       obj.get("version"),
+                                       tokens=obj.get("tokens"))
             return ServingError(uri, obj["error"], obj.get("model"),
                                 obj.get("version"))
         timing = obj.get("timing")
@@ -241,4 +295,7 @@ class OutputQueue(API):
                 if server_ms is not None:
                     timing["transport_ms"] = round(
                         max(timing["rtt_ms"] - server_ms, 0.0), 3)
+        if "tokens" in obj and "value" not in obj:
+            return GenerationResult.wrap(obj["tokens"],
+                                         obj.get("finish"), timing)
         return ServingResult.wrap(obj["value"], timing)

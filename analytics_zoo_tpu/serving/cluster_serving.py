@@ -198,6 +198,19 @@ class ClusterServingHelper:
         # metrics.json; the CLI --trace-dir flag overrides trace_dir
         self.telemetry = _parse_bool(params.get("telemetry"), False)
         self.trace_dir = params.get("trace_dir")
+        # -- generative serving (docs/serving-generate.md) --------------
+        gen = config.get("generate") or {}
+        self.generate_slots = int(gen.get("slots") or 4)
+        self.generate_continuous = _parse_bool(gen.get("continuous"), True)
+        self.generate_max_len = int(gen.get("max_len") or 1024)
+        self.generate_max_new_tokens = int(gen.get("max_new_tokens") or 32)
+        raw_gstop = gen.get("stop_id")
+        self.generate_stop_id = None if raw_gstop is None else int(raw_gstop)
+        # deterministic stub decode engine (StubDecodeEngine) — fleet
+        # smoke / bench workers, mirrors model.stub_ms_per_batch
+        raw_gstub = gen.get("stub_ms_per_step")
+        self.generate_stub_ms_per_step = \
+            None if raw_gstub is None else float(raw_gstub)
         # -- model registry (docs/model-registry.md) --------------------
         reg = config.get("registry") or {}
         self.registry_root = reg.get("root")
@@ -260,6 +273,12 @@ class ClusterServing:
         # intake backlog sources, populated by _serve_pipelined (admission
         # reads live queue depths instead of guessing from counters)
         self._backlog_queues: List[queue.Queue] = []
+        # generative serving (serving/generation.py): engine injected via
+        # set_generate_engine or built from config; scheduler starts
+        # lazily on the first generate record
+        self._gen_engine = None
+        self._gen_sched = None
+        self._gen_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -319,6 +338,8 @@ class ClusterServing:
                    "batches": self.batches,
                    "buckets": dict(self.bucket_counts)}
         out["admission"] = self.admission.stats()
+        if self._gen_sched is not None:
+            out["generation"] = self._gen_sched.stats()
         if hasattr(self.db, "consumer_stats"):
             out["queue"] = self.db.consumer_stats()
         out.update(self.summary.snapshot())
@@ -388,6 +409,100 @@ class ClusterServing:
             self.summary.record_stage("queue_wait", timing["queue_ms"] / 1e3)
 
     # ------------------------------------------------------------------
+    # generative serving (docs/serving-generate.md)
+    # ------------------------------------------------------------------
+    def set_generate_engine(self, engine):
+        """Inject a gang-decode engine (TransformerDecodeEngine or any
+        object with the alloc/grow/join/step/evict protocol) before the
+        first generate record arrives."""
+        self._gen_engine = engine
+        return self
+
+    def _generate_engine(self):
+        if self._gen_engine is None and \
+                getattr(self.helper, "generate_stub_ms_per_step",
+                        None) is not None:
+            from .generation import StubDecodeEngine
+            from ..ops.kv_cache import cache_length_buckets
+
+            self._gen_engine = StubDecodeEngine(
+                ms_per_step=self.helper.generate_stub_ms_per_step,
+                stop_id=self.helper.generate_stop_id or 0,
+                capacity_buckets=cache_length_buckets(
+                    self.helper.generate_max_len))
+        return self._gen_engine
+
+    def _gen_scheduler(self):
+        """The continuous-batching scheduler, started on first use (its
+        loop thread only exists when the workload includes generation)."""
+        with self._gen_lock:
+            if self._gen_sched is None:
+                engine = self._generate_engine()
+                if engine is None:
+                    return None
+                from .generation import ContinuousBatchScheduler
+
+                slots = int(getattr(self.helper, "generate_slots", 4))
+                batcher = AdaptiveBatcher(
+                    power_of_two_buckets(slots), self.admission,
+                    linger_ms=float(getattr(self.helper, "linger_ms", 0.0)))
+                self._gen_sched = ContinuousBatchScheduler(
+                    engine, commit=self._gen_commit, max_slots=slots,
+                    continuous=bool(getattr(self.helper,
+                                            "generate_continuous", True)),
+                    admission=self.admission, batcher=batcher).start()
+            return self._gen_sched
+
+    def _gen_commit(self, uri: str, payload: dict):
+        """Scheduler results land in the same results map as
+        predictions; sequences finish at different steps, so each commit
+        is a single-uri write the moment its sequence evicts."""
+        if "error" in payload:
+            self._count(shed=1)
+        else:
+            self._count(results_out=1)
+        self.db.put_results({uri: json.dumps(payload).encode()})
+
+    def _maybe_generate(self, rid: str, rec: dict,
+                        t_in: float) -> bool:
+        """Divert a generate record to the continuous-batching
+        scheduler; True when the record was one (handled), False when
+        it belongs to the predict pipeline."""
+        gen = rec.get("generate") or rec.get(b"generate")
+        if gen is None:
+            return False
+        meta = self._meta_for(rid, rec, t_in)
+        if isinstance(gen, (bytes, bytearray)):
+            # redis transports msgpack non-scalar fields
+            import msgpack
+
+            gen = msgpack.unpackb(gen, raw=False)
+        sched = self._gen_scheduler()
+        if sched is None:
+            self.db.put_results({meta.uri: json.dumps(
+                {"error": "no generate engine configured",
+                 "code": "no_engine"}).encode()})
+            self._count(dead_letters=1)
+            return True
+        from .generation import GenRequest
+
+        stop_id = gen.get("stop_id")
+        if stop_id is None:
+            stop_id = getattr(self.helper, "generate_stop_id", None)
+        sched.submit(GenRequest(
+            uri=meta.uri,
+            prompt=np.asarray(gen.get("prompt") or [], np.int64),
+            max_new_tokens=int(gen.get("max_new_tokens") or
+                               getattr(self.helper,
+                                       "generate_max_new_tokens", 32)),
+            stop_id=None if stop_id is None else int(stop_id),
+            temperature=float(gen.get("temperature") or 0.0),
+            deadline_at_ms=meta.deadline_at_ms,
+            enqueue_ts_ms=meta.enqueue_ts_ms,
+            t_in=t_in))
+        return True
+
+    # ------------------------------------------------------------------
     # synchronous loop (the pre-pipeline baseline, pipelined=False)
     # ------------------------------------------------------------------
     def _process_batch(self, items, t_in: Optional[float] = None):
@@ -400,6 +515,9 @@ class ClusterServing:
     def _process_chunk(self, items, t_in: Optional[float] = None):
         metas, arrays = [], []
         for rid, rec in items:
+            if self._maybe_generate(rid, rec,
+                                    t_in or time.perf_counter()):
+                continue
             try:
                 arrays.append(self._decode_record(rec))
                 metas.append(self._meta_for(rid, rec,
@@ -620,6 +738,11 @@ class ClusterServing:
                 if items:
                     now = time.perf_counter()
                     for rid, rec in items:
+                        # generate records divert to the continuous-
+                        # batching scheduler (their admission happens at
+                        # slot-refill time, with the per-token estimate)
+                        if self._maybe_generate(rid, rec, now):
+                            continue
                         meta = self._meta_for(rid, rec, now)
                         # first shed point: admission control against the
                         # measured service time + live backlog
@@ -698,6 +821,12 @@ class ClusterServing:
             self._serve_pipelined(poll_timeout)
         else:
             self._serve_sync(poll_timeout)
+        # drain the generation gang last: in-flight sequences finish (or
+        # shed) and every submitted request gets exactly one result
+        with self._gen_lock:
+            sched = self._gen_sched
+        if sched is not None:
+            sched.stop(drain=True, timeout=30)
 
     def start(self):
         self._stop.clear()

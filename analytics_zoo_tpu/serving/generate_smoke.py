@@ -1,0 +1,135 @@
+"""Generate-serving smoke: continuous batching through the full wire
+path, asserting the three scheduler invariants CI cares about.
+
+CI/tooling entry (``scripts/generate-smoke``): a live
+:class:`ClusterServing` with the stub decode engine serves two
+overlapping generate requests over the in-process transport —
+
+- **join-mid-generation**: request B is submitted after request A's
+  generation is underway and must *finish and commit while A is still
+  decoding* (iteration-level scheduling; static batching would hold B's
+  result until A drained);
+- **stop-token eviction**: B's scripted stream emits the stop token
+  early; its result must carry ``finish == "stop_id"`` with the stream
+  cut at the stop token;
+- **exactly-once results**: every submitted request produces exactly
+  one committed payload (queried twice: present once, absent after the
+  pop) and the scheduler counts zero duplicate commits.
+
+Exit 0 on success, 1 on any violated invariant, printing one JSON line
+of pipeline stats either way.
+
+Usage::
+
+    python -m analytics_zoo_tpu.serving.generate_smoke [--step-ms 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="generate-smoke")
+    ap.add_argument("--step-ms", type=float, default=20.0,
+                    help="stub decode-step wall time (gang-wide)")
+    ap.add_argument("--timeout", type=float, default=30.0)
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from .client import GenerationResult, InputQueue, OutputQueue
+    from .cluster_serving import ClusterServing, ClusterServingHelper
+    from .queue_backend import InProcessStreamQueue
+
+    helper = ClusterServingHelper(config={
+        "data": {},
+        "params": {"batch_size": 4},
+        "generate": {"slots": 2, "continuous": True,
+                     "stub_ms_per_step": args.step_ms, "stop_id": 0}})
+    backend = InProcessStreamQueue()
+    serving = ClusterServing(model=None, helper=helper,
+                             backend=backend).start()
+    in_q = InputQueue(backend=backend)
+    out_q = OutputQueue(backend=backend)
+    failures = []
+
+    try:
+        # A: long stream — 30 tokens at step_ms each keeps the gang busy
+        in_q.enqueue_generate("gen-A", [10], max_new_tokens=30)
+        # wait until A's generation is underway before submitting B
+        deadline = time.time() + args.timeout
+        while time.time() < deadline:
+            if serving.pipeline_stats().get(
+                    "generation", {}).get("joins", 0) >= 1:
+                break
+            time.sleep(0.005)
+        else:
+            failures.append("request A never joined the gang")
+        # B: scripted to emit the stop token at position 3 (prompt[1])
+        in_q.enqueue_generate("gen-B", [50, 3], max_new_tokens=20,
+                              stop_id=0)
+        # join-mid-generation: B's result must land while A still decodes
+        b_res, a_still_running = None, False
+        deadline = time.time() + args.timeout
+        while time.time() < deadline:
+            b_res = out_q.query("gen-B")
+            if b_res is not None:
+                a_still_running = out_q.query("gen-A") is None
+                break
+            time.sleep(args.step_ms / 4e3)
+        if b_res is None:
+            failures.append("no result for gen-B")
+        elif not a_still_running:
+            failures.append("gen-B did not commit while gen-A was "
+                            "still generating (continuous batching "
+                            "not engaged)")
+        got = out_q.wait_all(["gen-A", "gen-B"], timeout=args.timeout)
+    finally:
+        serving.stop()
+
+    stats = serving.pipeline_stats()
+    gen = stats.get("generation", {})
+    a, b = got.get("gen-A"), got.get("gen-B")
+    if not isinstance(a, GenerationResult):
+        failures.append(f"gen-A result wrong type: {type(a).__name__}")
+    else:
+        if a.tolist() != list(range(11, 41)):
+            failures.append(f"gen-A tokens wrong: {a.tolist()}")
+        if a.finish != "max_new_tokens":
+            failures.append(f"gen-A finish={a.finish}")
+    if not isinstance(b, GenerationResult):
+        failures.append(f"gen-B result wrong type: {type(b).__name__}")
+    else:
+        # stop-token eviction: stream cut at the scripted stop position
+        if b.tolist() != [51, 52, 0]:
+            failures.append(f"gen-B tokens wrong: {b.tolist()}")
+        if b.finish != "stop_id":
+            failures.append(f"gen-B finish={b.finish}")
+    # exactly-once: wait_all popped both; a second read must find nothing
+    for uri in ("gen-A", "gen-B"):
+        if out_q.query(uri) is not None:
+            failures.append(f"{uri} result still present after pop "
+                            f"(committed more than once?)")
+    if gen.get("duplicate_commits", 0):
+        failures.append(f"{gen['duplicate_commits']} duplicate commits")
+    if gen.get("committed") != gen.get("submitted"):
+        failures.append(f"committed={gen.get('committed')} != "
+                        f"submitted={gen.get('submitted')}")
+
+    print(json.dumps(stats))
+    if failures:
+        print("SMOKE FAILED: " + "; ".join(failures), file=sys.stderr)
+        return 1
+    print(f"SMOKE OK: 2 sequences, {gen.get('tokens', 0)} tokens, "
+          f"join-mid-generation + stop-token eviction + exactly-once "
+          f"all held", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,538 @@
+"""Continuous-batching generative serving: decode engines + scheduler.
+
+The serving layer's autoregressive workload front. Classification
+serving dispatches a batch and is done; generation holds a sequence in
+flight for tens-to-thousands of decode steps. Batching at *request*
+granularity (static batching) means the whole gang waits for its
+slowest member — short answers pay for long ones. Iteration-level
+scheduling (Orca, OSDI '22) rebatches at every token boundary instead:
+
+- the in-flight batch is a set of **cache slots** over preallocated
+  power-of-two KV slabs (``ops/kv_cache.py``);
+- a finished sequence (stop token / max_new_tokens / deadline) is
+  **evicted at the very step it finishes** and its result committed
+  immediately;
+- the freed slot is **refilled from the admission queue
+  mid-generation** — joiners prefill into the running gang without
+  stalling it;
+- admission reuses the padding-bucket + linger machinery, with the
+  EWMA deadline shed extended by a per-token service estimate
+  (:meth:`AdmissionController.admit_generate`), and a mid-stream shed
+  (:meth:`AdmissionController.stream_expired`) that evicts a sequence
+  whose deadline passes while decoding, committing a typed
+  ``shed_deadline`` payload that carries the partial tokens.
+
+Two engines implement the gang interface: ``TransformerDecodeEngine``
+(the real KV-cache decode path through ``TransformerLayer``) and
+``StubDecodeEngine`` (a deterministic CPU stand-in whose decode step
+costs a flat ``ms_per_step`` regardless of gang width — the
+MXU-amortization property that makes continuous batching pay; the
+bench ``generation`` leg and the fast-tier smoke run on it).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..ops.kv_cache import cache_length_buckets, pick_cache_bucket
+from ..utils import telemetry
+from ..utils.telemetry import span
+from .admission import (SHED_DEADLINE, AdaptiveBatcher, AdmissionController,
+                        now_ms)
+
+logger = logging.getLogger(__name__)
+
+#: eviction reasons — the "reason" label on zoo_generate_evict_total and
+#: the "finish" field of committed results
+FINISH_STOP = "stop_id"
+FINISH_MAX_TOKENS = "max_new_tokens"
+FINISH_DEADLINE = "shed_deadline"
+FINISH_CANCELLED = "cancelled"
+
+#: typed shed code for prompts no cache bucket can hold
+SHED_CAPACITY = "shed_capacity"
+
+
+@dataclass
+class GenRequest:
+    """One generate request as it leaves the wire decoder."""
+
+    uri: str
+    prompt: np.ndarray                  # 1-D int token ids
+    max_new_tokens: int = 32
+    stop_id: Optional[int] = None
+    temperature: float = 0.0            # 0 = greedy
+    deadline_at_ms: Optional[float] = None
+    enqueue_ts_ms: Optional[float] = None
+    t_in: float = field(default_factory=time.perf_counter)
+
+    def __post_init__(self):
+        self.prompt = np.asarray(self.prompt).astype(np.int64).ravel()
+        self.max_new_tokens = max(int(self.max_new_tokens), 1)
+
+
+@dataclass
+class _Slot:
+    """Scheduler-side tracker for one in-flight sequence."""
+
+    req: GenRequest
+    tokens: List[int] = field(default_factory=list)
+    last: int = 0
+    t_join: float = 0.0
+    t_first_token: Optional[float] = None
+    finish: Optional[str] = None
+
+
+# ---------------------------------------------------------------------------
+# engines
+# ---------------------------------------------------------------------------
+
+class StubDecodeEngine:
+    """Deterministic gang-decode stand-in (the generate analogue of
+    ``EchoStubModel``).
+
+    Token stream for a prompt ``p``: token i (1-based) is ``p[0] + i``,
+    except that when the prompt has a second element ``p[1] > 0`` the
+    stream emits ``stop_id`` at position ``p[1]`` — letting tests
+    script stop-token eviction per request. ``step()`` sleeps a flat
+    ``ms_per_step`` for the *whole gang* (device-like cost: one MXU
+    pass per token boundary, amortized over every active slot) and
+    ``join()`` sleeps ``ms_per_prefill`` once.
+    """
+
+    def __init__(self, ms_per_step: float = 1.0,
+                 ms_per_prefill: float = 0.0, stop_id: int = 0,
+                 capacity_buckets: Optional[Sequence[int]] = None):
+        self.ms_per_step = float(ms_per_step)
+        self.ms_per_prefill = float(ms_per_prefill)
+        self.stop_id = int(stop_id)
+        self.buckets = list(capacity_buckets or cache_length_buckets(1024))
+
+    def alloc(self, nslots: int, capacity: int):
+        # per-slot [base, emitted, stop_at]; None = free
+        return [None] * nslots
+
+    def grow(self, state, capacity: int):
+        return state
+
+    def join(self, state, slot: int, req: GenRequest):
+        if self.ms_per_prefill > 0:
+            time.sleep(self.ms_per_prefill / 1e3)
+        p = req.prompt
+        base = int(p[0]) if p.size else 0
+        stop_at = int(p[1]) if p.size > 1 and int(p[1]) > 0 else None
+        state[slot] = [base, 1, stop_at]
+        first = self.stop_id if stop_at == 1 else base + 1
+        return state, first
+
+    def step(self, state, feeds: Dict[int, int],
+             temps: Dict[int, float]):
+        """Advance every fed slot one token; flat gang-wide cost."""
+        if self.ms_per_step > 0:
+            time.sleep(self.ms_per_step / 1e3)
+        out = {}
+        for slot in feeds:
+            base, emitted, stop_at = state[slot]
+            emitted += 1
+            state[slot][1] = emitted
+            out[slot] = self.stop_id if stop_at == emitted else base + emitted
+        return state, out
+
+    def evict(self, state, slot: int):
+        state[slot] = None
+        return state
+
+
+class TransformerDecodeEngine:
+    """Gang decode over a causal ``TransformerLayer`` via its KV-cache
+    API (``prefill`` / ``decode_step`` on ops/kv_cache.py slabs).
+
+    A join prefills the prompt on a batch-1 state of the gang's
+    capacity and splices the resulting slabs into the joiner's slot —
+    the running gang never recomputes. Freed slots sit at length 0:
+    their rows are masked out of every step, and whatever the dead slot
+    keeps emitting is discarded by the scheduler.
+    """
+
+    def __init__(self, layer, params, max_len: Optional[int] = None,
+                 rng=None):
+        import jax
+        import jax.numpy as jnp
+
+        self.layer = layer
+        self.params = params
+        self.buckets = cache_length_buckets(
+            max_len or layer.seq_len, min_bucket=min(128, layer.seq_len))
+        self._jnp = jnp
+        self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+        self._step_fn = jax.jit(lambda p, s, t: layer.decode_step(p, s, t))
+
+    def alloc(self, nslots: int, capacity: int):
+        return self.layer.init_decode_state(nslots, capacity)
+
+    def grow(self, state, capacity: int):
+        jnp = self._jnp
+        if capacity <= state.capacity:
+            return state
+        pad = [(0, 0), (0, capacity - state.capacity), (0, 0), (0, 0)]
+        return state._replace(
+            k_cache=tuple(jnp.pad(k, pad) for k in state.k_cache),
+            v_cache=tuple(jnp.pad(v, pad) for v in state.v_cache))
+
+    def _pick(self, logits, temperature: float) -> int:
+        import jax
+
+        if temperature and temperature > 0.0:
+            self._rng, sub = jax.random.split(self._rng)
+            return int(jax.random.categorical(
+                sub, logits.astype(self._jnp.float32) / temperature))
+        return int(self._jnp.argmax(logits))
+
+    def join(self, state, slot: int, req: GenRequest):
+        from ..ops.kv_cache import place_slot
+
+        jnp = self._jnp
+        st1 = self.layer.init_decode_state(1, state.capacity,
+                                           dtype=state.k_cache[0].dtype)
+        logits, st1 = self.layer.prefill(
+            self.params, jnp.asarray(req.prompt, jnp.int32)[None],
+            jnp.array([req.prompt.size], jnp.int32), st1)
+        state = state._replace(
+            k_cache=tuple(place_slot(k, slot, s1[0])
+                          for k, s1 in zip(state.k_cache, st1.k_cache)),
+            v_cache=tuple(place_slot(v, slot, s1[0])
+                          for v, s1 in zip(state.v_cache, st1.v_cache)),
+            lengths=state.lengths.at[slot].set(int(req.prompt.size)))
+        return state, self._pick(logits[0], req.temperature)
+
+    def step(self, state, feeds: Dict[int, int],
+             temps: Dict[int, float]):
+        jnp = self._jnp
+        tokens = np.zeros((state.batch,), np.int32)
+        for slot, tok in feeds.items():
+            tokens[slot] = tok
+        logits, state = self._step_fn(self.params, state,
+                                      jnp.asarray(tokens))
+        out = {slot: self._pick(logits[slot], temps.get(slot, 0.0))
+               for slot in feeds}
+        return state, out
+
+    def evict(self, state, slot: int):
+        from ..ops.kv_cache import evict_slot
+
+        return state._replace(lengths=evict_slot(state.lengths, slot))
+
+
+# ---------------------------------------------------------------------------
+# scheduler
+# ---------------------------------------------------------------------------
+
+class ContinuousBatchScheduler:
+    """Iteration-level scheduler over a gang-decode engine.
+
+    Loop body (one token boundary): **evict** finished sequences and
+    commit their results immediately → **refill** the freed cache
+    slots from the admission queue (``admit_generate`` sheds requests
+    whose deadline cannot survive the queue depth; joiners prefill
+    into the running gang) → **step** the gang one token
+    (``observe_tokens`` feeds the per-token EWMA back to admission).
+
+    ``continuous=False`` degrades to static batching — the gang only
+    refills once *every* slot has drained — which is the baseline leg
+    of the bench comparison, not a recommended mode.
+
+    Results leave through ``commit(uri, payload)`` exactly once per
+    submitted request: a finished sequence commits ``{"tokens",
+    "finish", "timing"}``; a shed one commits ``{"error", "code",
+    "tokens"}`` where ``tokens`` carries whatever partial stream the
+    deadline allowed.
+    """
+
+    def __init__(self, engine, commit: Callable[[str, dict], None],
+                 max_slots: int = 8, continuous: bool = True,
+                 admission: Optional[AdmissionController] = None,
+                 batcher: Optional[AdaptiveBatcher] = None,
+                 idle_poll_s: float = 0.02):
+        self.engine = engine
+        self._commit_cb = commit
+        self.max_slots = max(int(max_slots), 1)
+        self.continuous = bool(continuous)
+        self.admission = admission
+        self.batcher = batcher
+        self.idle_poll_s = float(idle_poll_s)
+
+        self._queue: "queue.Queue[GenRequest]" = queue.Queue()
+        self._slots: List[Optional[_Slot]] = [None] * self.max_slots
+        self._state = None
+        self._capacity = 0
+        self._committed = set()
+        self._lock = threading.Lock()
+        self._stop_evt = threading.Event()
+        self._drain = True
+        self._thread: Optional[threading.Thread] = None
+        self.counts = {"submitted": 0, "committed": 0, "tokens": 0,
+                       "joins": 0, "evictions": 0, "shed": 0,
+                       "duplicate_commits": 0}
+
+    # -- public surface -------------------------------------------------
+    def submit(self, req: GenRequest):
+        with self._lock:
+            self.counts["submitted"] += 1
+        self._queue.put(req)
+
+    def start(self):
+        if self._thread is not None:
+            raise RuntimeError("scheduler already started")
+        self._thread = threading.Thread(target=self.run,
+                                        name="zoo-generate-scheduler",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout: Optional[float] = None):
+        self._drain = bool(drain)
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self.counts)
+        out["queue_depth"] = self._queue.qsize()
+        out["active_slots"] = sum(s is not None for s in self._slots)
+        out["capacity"] = self._capacity
+        return out
+
+    # -- commit (exactly once) ------------------------------------------
+    def _commit(self, uri: str, payload: dict):
+        with self._lock:
+            if uri in self._committed:
+                self.counts["duplicate_commits"] += 1
+                logger.error("duplicate commit suppressed for %r", uri)
+                return
+            self._committed.add(uri)
+            self.counts["committed"] += 1
+        self._commit_cb(uri, payload)
+
+    def _shed(self, req: GenRequest, code: str, msg: str,
+              tokens: Optional[List[int]] = None):
+        with self._lock:
+            self.counts["shed"] += 1
+        telemetry.counter("zoo_generate_shed_total", code=code).inc()
+        self._commit(req.uri, {"error": msg, "code": code,
+                               "tokens": list(tokens or [])})
+
+    # -- slot lifecycle --------------------------------------------------
+    def _slack_ms(self, req: GenRequest) -> Optional[float]:
+        if req.deadline_at_ms is None:
+            return None
+        return req.deadline_at_ms - now_ms()
+
+    def _admit(self, req: GenRequest) -> bool:
+        """Admission-time shed; True when the request may join."""
+        if self.admission is not None:
+            ok, code = self.admission.admit_generate(
+                self._slack_ms(req), req.max_new_tokens,
+                queue_depth=self._queue.qsize())
+            if not ok:
+                self._shed(req, code, "deadline unmeetable at admission")
+                return False
+        try:
+            need = pick_cache_bucket(
+                int(req.prompt.size) + req.max_new_tokens,
+                self.engine.buckets)
+        except ValueError:
+            self._shed(req, SHED_CAPACITY,
+                       "prompt + max_new_tokens exceeds the largest "
+                       "cache bucket")
+            return False
+        if self._state is None:
+            self._capacity = need
+            self._state = self.engine.alloc(self.max_slots, need)
+        elif need > self._capacity:
+            self._state = self.engine.grow(self._state, need)
+            self._capacity = need
+        return True
+
+    def _join(self, slot: int, req: GenRequest):
+        with span("generate/prefill", uri=req.uri, slot=slot,
+                  prompt_len=int(req.prompt.size)):
+            self._state, first = self.engine.join(self._state, slot, req)
+        s = _Slot(req=req, t_join=time.perf_counter())
+        self._slots[slot] = s
+        with self._lock:
+            self.counts["joins"] += 1
+        telemetry.counter("zoo_generate_join_total").inc()
+        telemetry.event("generate_join", uri=req.uri, slot=slot)
+        self._note_token(slot, int(first))
+
+    def _note_token(self, slot: int, tok: int):
+        """Record one emitted token; set the slot's finish reason when
+        this token ends the sequence (checked in priority order: stop
+        token, token budget, deadline)."""
+        s = self._slots[slot]
+        t_now = time.perf_counter()
+        if s.t_first_token is None:
+            s.t_first_token = t_now
+            telemetry.summary("zoo_generate_ttft_ms").record(
+                (t_now - s.req.t_in) * 1e3)
+        s.tokens.append(tok)
+        s.last = tok
+        with self._lock:
+            self.counts["tokens"] += 1
+        if s.req.stop_id is not None and tok == s.req.stop_id:
+            s.finish = FINISH_STOP
+        elif len(s.tokens) >= s.req.max_new_tokens:
+            s.finish = FINISH_MAX_TOKENS
+        elif self.admission is not None and self.admission.stream_expired(
+                s.req.deadline_at_ms):
+            s.finish = FINISH_DEADLINE
+
+    def _evict(self, slot: int):
+        s = self._slots[slot]
+        self._state = self.engine.evict(self._state, slot)
+        self._slots[slot] = None
+        with self._lock:
+            self.counts["evictions"] += 1
+        telemetry.counter("zoo_generate_evict_total",
+                          reason=s.finish).inc()
+        telemetry.event("generate_evict", uri=s.req.uri, slot=slot,
+                        reason=s.finish, n_tokens=len(s.tokens))
+        if s.finish == FINISH_DEADLINE:
+            self._shed(s.req, SHED_DEADLINE,
+                       "deadline exceeded mid-generation",
+                       tokens=s.tokens)
+            return
+        t_done = time.perf_counter()
+        decode_s = max(t_done - s.t_join, 1e-9)
+        tokens_per_s = len(s.tokens) / decode_s
+        telemetry.summary("zoo_generate_tokens_per_s").record(tokens_per_s)
+        timing = {
+            "ttft_ms": round((s.t_first_token - s.req.t_in) * 1e3, 3),
+            "decode_ms": round(decode_s * 1e3, 3),
+            "n_tokens": len(s.tokens),
+            "tokens_per_s": round(tokens_per_s, 3),
+        }
+        if s.req.enqueue_ts_ms is not None:
+            # lets the client complete the rtt/transport decomposition
+            timing["enqueue_ts_ms"] = s.req.enqueue_ts_ms
+            timing["server_ms"] = timing["ttft_ms"] + timing["decode_ms"]
+        self._commit(s.req.uri, {"tokens": list(s.tokens),
+                                 "finish": s.finish, "timing": timing})
+
+    # -- loop stages -----------------------------------------------------
+    def _evict_finished(self):
+        for i, s in enumerate(self._slots):
+            if s is not None and s.finish is not None:
+                self._evict(i)
+
+    def _oldest_active_deadline(self) -> Optional[float]:
+        ds = [s.req.deadline_at_ms for s in self._slots
+              if s is not None and s.req.deadline_at_ms is not None]
+        return min(ds) if ds else None
+
+    def _refill(self):
+        """Fill free slots from the queue.  Static mode refills only
+        when the gang is fully drained; continuous mode refills at
+        every token boundary.  At empty-gang assembly the adaptive
+        batcher may linger a bounded moment to round the gang up to
+        the next padding-bucket boundary."""
+        active = sum(s is not None for s in self._slots)
+        if not self.continuous and active > 0:
+            return
+        gang_was_empty = active == 0
+        free = [i for i, s in enumerate(self._slots) if s is None]
+        while free:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                n_have = self.max_slots - len(free)
+                if not (gang_was_empty and n_have > 0
+                        and self.batcher is not None):
+                    break
+                budget = self.batcher.linger_budget_s(
+                    n_have, self._oldest_active_deadline())
+                if budget <= 0:
+                    break
+                try:
+                    req = self._queue.get(timeout=budget)
+                except queue.Empty:
+                    break
+            if not self._admit(req):
+                continue
+            slot = free.pop(0)
+            self._join(slot, req)
+
+    def _step(self):
+        feeds = {i: s.last for i, s in enumerate(self._slots)
+                 if s is not None and s.finish is None}
+        if not feeds:
+            return
+        temps = {i: self._slots[i].req.temperature for i in feeds}
+        t0 = time.perf_counter()
+        self._state, out = self.engine.step(self._state, feeds, temps)
+        dt = time.perf_counter() - t0
+        if self.admission is not None:
+            self.admission.observe_tokens(len(feeds), dt)
+        telemetry.counter("zoo_generate_tokens_total").inc(len(feeds))
+        telemetry.summary("zoo_generate_step_ms").record(dt * 1e3)
+        for slot, tok in out.items():
+            self._note_token(slot, int(tok))
+        self._publish_occupancy()
+
+    def _publish_occupancy(self):
+        active = [s for s in self._slots if s is not None]
+        telemetry.gauge("zoo_generate_active_slots").set(len(active))
+        if self._capacity > 0:
+            used = sum(int(s.req.prompt.size) + len(s.tokens)
+                       for s in active)
+            telemetry.gauge("zoo_generate_cache_occupancy").set(
+                used / (self.max_slots * self._capacity))
+
+    # -- main loop -------------------------------------------------------
+    def run(self):
+        """Process until :meth:`stop`.  ``stop(drain=True)`` lets the
+        queue and gang empty first; ``drain=False`` cancels in-flight
+        sequences (committed with ``code="cancelled"``)."""
+        while True:
+            self._evict_finished()
+            self._refill()
+            active = sum(s is not None for s in self._slots)
+            if self._stop_evt.is_set():
+                if not self._drain:
+                    break
+                if active == 0 and self._queue.empty():
+                    break
+            if active == 0:
+                # idle: block briefly for the next request
+                try:
+                    req = self._queue.get(timeout=self.idle_poll_s)
+                except queue.Empty:
+                    continue
+                self._queue.put(req)   # re-enter through _refill
+                continue
+            self._step()
+        if not self._drain:
+            for i, s in enumerate(self._slots):
+                if s is not None:
+                    s.finish = FINISH_CANCELLED
+                    self._state = self.engine.evict(self._state, i)
+                    self._slots[i] = None
+                    self._shed(s.req, FINISH_CANCELLED,
+                               "generation cancelled at shutdown",
+                               tokens=s.tokens)
+            while True:
+                try:
+                    req = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                self._shed(req, FINISH_CANCELLED,
+                           "generation cancelled at shutdown")

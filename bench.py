@@ -1482,6 +1482,95 @@ def bench_serving_fleet(n_records=320, stub_ms=16.0):
     return out
 
 
+def bench_generation(n_requests=48, slots=8, step_ms=2.0):
+    """Generative-serving leg (docs/serving-generate.md): the identical
+    skewed request mix (1 in 4 requests wants 32 tokens, the rest 4 —
+    the short-answers-pay-for-long-ones regime) through the
+    continuous-batching scheduler twice over the stub decode engine,
+    whose step costs a flat ``step_ms`` gang-wide (the MXU amortization
+    property):
+
+    - **static** — the gang only refills once every slot has drained,
+      so each round lasts as long as its longest sequence;
+    - **continuous** — finished sequences evict at their final token
+      and freed slots refill mid-generation.
+
+    Reports aggregate tokens/s and p99 TTFT per mode; the acceptance
+    gate is continuous >= 2x static tokens/s at equal-or-better p99
+    TTFT.  Also runs the jaxpr probe over the real TransformerLayer
+    decode step — the cached step must carry **no full-sequence (LxL)
+    attention contraction** (decode_step_is_cached) — registered as a
+    bench gate, since an accidental fallback to recompute-from-scratch
+    would silently turn O(L) steps into O(L^2).
+    """
+    from analytics_zoo_tpu.serving.admission import AdmissionController
+    from analytics_zoo_tpu.serving.generation import (
+        ContinuousBatchScheduler, GenRequest, StubDecodeEngine)
+
+    def _run(continuous):
+        results = {}
+        sched = ContinuousBatchScheduler(
+            StubDecodeEngine(ms_per_step=step_ms, stop_id=0),
+            commit=lambda u, p: results.__setitem__(u, p),
+            max_slots=slots, continuous=continuous,
+            admission=AdmissionController()).start()
+        t0 = time.perf_counter()
+        for i in range(n_requests):
+            sched.submit(GenRequest(
+                f"g-{i}", np.array([i % 50 + 1]),
+                max_new_tokens=32 if i % 4 == 0 else 4))
+        sched.stop(drain=True, timeout=600)
+        wall = time.perf_counter() - t0
+        toks = sum(len(p.get("tokens", [])) for p in results.values())
+        ttft = np.asarray([p["timing"]["ttft_ms"]
+                           for p in results.values() if "timing" in p])
+        mode = "continuous" if continuous else "static"
+        return {f"generation_{mode}_tokens_per_s": round(toks / wall, 1),
+                f"generation_{mode}_p99_ttft_ms": round(
+                    float(np.percentile(ttft, 99)), 2),
+                f"generation_{mode}_served": len(results)}
+
+    out = {}
+    for continuous in (False, True):
+        out.update(_run(continuous))
+    ratio = (out["generation_continuous_tokens_per_s"] /
+             max(out["generation_static_tokens_per_s"], 1e-9))
+    out["generation_continuous_vs_static"] = round(ratio, 2)
+    ttft_ok = (out["generation_continuous_p99_ttft_ms"] <=
+               out["generation_static_p99_ttft_ms"] * 1.1)
+    _gate("generation_continuous_ge_2x_at_equal_ttft",
+          ratio >= 2.0 and ttft_ok,
+          f"ratio={ratio:.2f}, "
+          f"cont p99 TTFT={out['generation_continuous_p99_ttft_ms']}ms "
+          f"vs static {out['generation_static_p99_ttft_ms']}ms")
+
+    # jaxpr/HLO probe: the cached decode step of the real transformer
+    # trunk must contain no (S, S) contraction
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.ops.kv_cache import decode_step_is_cached
+    from analytics_zoo_tpu.pipeline.api.keras.layers.self_attention \
+        import TransformerLayer
+
+    cap = 256
+    layer = TransformerLayer(n_block=1, n_head=2, hidden_size=8,
+                             vocab=16, seq_len=cap, intermediate_size=16,
+                             hidden_p_drop=0.0, attn_p_drop=0.0,
+                             bidirectional=False)
+    params = layer.build(jax.random.PRNGKey(0), (None, cap))
+    st = layer.init_decode_state(2, cap)
+    st = st._replace(lengths=jnp.array([3, 5], jnp.int32))
+    cached = decode_step_is_cached(
+        lambda p, s, t: layer.decode_step(p, s, t)[0],
+        params, st, jnp.array([1, 2], jnp.int32), capacity=cap)
+    out["generation_decode_step_cached"] = bool(cached)
+    _gate("generation_decode_step_no_LxL_contraction", cached,
+          f"decode_step jaxpr materializes a >= ({cap}, {cap}) "
+          f"attention contraction")
+    return out
+
+
 def bench_infeed(n_images=480, batch_size=32):
     """Image input-pipeline leg (SURVEY §7 hard-part (c)) — CPU-provable.
 
@@ -2102,6 +2191,24 @@ def main():
             RESULT["fleet_error"] = (str(e).splitlines()[0][:500]
                                      if str(e) else repr(e)[:500])
         _stamp_leg_artifacts("fleet")
+        emit()
+
+    # Generative-serving leg: continuous vs static batching tokens/s +
+    # p99 TTFT over the stub decode engine (>= 2x gate at equal TTFT),
+    # plus the jaxpr probe proving the cached transformer decode step
+    # carries no full-sequence attention contraction
+    # (docs/serving-generate.md). Host-side, CPU-provable.
+    if time.time() - T_START < TOTAL_BUDGET_S * 0.9:
+        try:
+            RESULT.update(bench_generation())
+        except Exception as e:  # noqa: BLE001
+            import traceback
+            traceback.print_exc()
+            RESULT["generation_error"] = (str(e).splitlines()[0][:500]
+                                          if str(e) else repr(e)[:500])
+            _gate("generation_measured", False,
+                  RESULT["generation_error"])
+        _stamp_leg_artifacts("generation")
         emit()
 
     # Input-pipeline leg — platform-independent (decode is host-side work

@@ -1,0 +1,84 @@
+"""Seq2seq cached infer: parity with the reference per-token loop."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.models.seq2seq.seq2seq import (Bridge, RNNDecoder,
+                                                      RNNEncoder, Seq2seq)
+from analytics_zoo_tpu.pipeline.api.keras.layers.core import Dense
+
+FEAT, HIDDEN = 4, 8
+
+
+def _model(rnn="lstm", nlayers=1, generator=True):
+    enc = RNNEncoder.initialize(rnn, nlayers, HIDDEN)
+    dec = RNNDecoder.initialize(rnn, nlayers, HIDDEN)
+    gen = Dense(FEAT) if generator else None
+    feat = FEAT if generator else HIDDEN
+    return Seq2seq(enc, dec, [5, feat], [3, feat],
+                   bridge=Bridge("dense", HIDDEN), generator=gen)
+
+
+@pytest.mark.parametrize("rnn", ["lstm", "gru", "simplernn"])
+def test_cached_infer_matches_reference_loop(rnn):
+    """infer (states carried, one decoder step per token) must equal
+    infer_reference (full model re-predict per token) bit-for-bit up to
+    f32 noise — same tokens, same shape, start sign included."""
+    m = _model(rnn)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((5, FEAT)).astype(np.float32)
+    start = rng.standard_normal((FEAT,)).astype(np.float32)
+    old = m.infer_reference(x, start, max_seq_len=6)
+    new = m.infer(x, start, max_seq_len=6)
+    assert old.shape == new.shape
+    assert float(np.abs(old - new).max()) < 1e-5
+
+
+def test_cached_infer_stop_sign_parity():
+    """Early stop: feed the reference loop's third emitted token back as
+    stop_sign; both loops must cut at the same step with the stop token
+    included, per the reference's break-after-append semantics."""
+    m = _model("lstm", nlayers=2, generator=False)
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((5, HIDDEN)).astype(np.float32)
+    start = rng.standard_normal((HIDDEN,)).astype(np.float32)
+    stop = m.infer_reference(x, start, max_seq_len=4)[0, 2]
+    old = m.infer_reference(x, start, max_seq_len=8, stop_sign=stop)
+    new = m.infer(x, start, max_seq_len=8, stop_sign=stop)
+    assert old.shape == new.shape == (1, 3, HIDDEN)
+    assert float(np.abs(old - new).max()) < 1e-5
+
+
+def test_cached_infer_build_output_parity():
+    m = _model("gru", generator=False)
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((5, HIDDEN)).astype(np.float32)
+    start = rng.standard_normal((HIDDEN,)).astype(np.float32)
+
+    def build_output(seq):
+        return np.tanh(np.asarray(seq)) * 0.5
+
+    old = m.infer_reference(x, start, max_seq_len=4,
+                            build_output=build_output)
+    new = m.infer(x, start, max_seq_len=4, build_output=build_output)
+    assert old.shape == new.shape
+    assert float(np.abs(old - new).max()) < 1e-5
+
+
+def test_cached_infer_batched_stop_freezes_rows():
+    """B > 1 with stop_sign: a finished row repeats its stop token while
+    the other row keeps decoding (the reference loop is batch-1 only, so
+    this pins the new batched semantics)."""
+    m = _model("lstm", generator=False)
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((2, 5, HIDDEN)).astype(np.float32)
+    start = rng.standard_normal((HIDDEN,)).astype(np.float32)
+    free = m.infer(x, start, max_seq_len=5)
+    # row 0's second emission as the stop: row 0 freezes from there on,
+    # row 1 is untouched
+    stop = free[0, 2]
+    out = m.infer(x, start, max_seq_len=5, stop_sign=stop)
+    assert out.shape == free.shape
+    assert np.abs(out[1] - free[1]).max() < 1e-6
+    for t in range(2, out.shape[1]):
+        assert np.abs(out[0, t] - stop).max() < 1e-6
