@@ -17,7 +17,10 @@ Usage::
     python -m analytics_zoo_tpu.serving.cli start  [--dir DIR] [--foreground]
                                                    [--warmup]
     python -m analytics_zoo_tpu.serving.cli fleet  [--dir DIR] [--workers N]
-    python -m analytics_zoo_tpu.serving.cli status [--dir DIR]
+    python -m analytics_zoo_tpu.serving.cli status [--dir DIR] [--watch SEC]
+    python -m analytics_zoo_tpu.serving.cli top    [--dir DIR]
+                                                   [--interval SEC]
+    python -m analytics_zoo_tpu.serving.cli trace  TRACE_ID [--dir DIR]
     python -m analytics_zoo_tpu.serving.cli stop   [--dir DIR]
     python -m analytics_zoo_tpu.serving.cli restart [--dir DIR]
     python -m analytics_zoo_tpu.serving.cli shutdown [--dir DIR]
@@ -106,6 +109,19 @@ params:
 #   canary_error_threshold: 0.5  # canary error rate that triggers rollback
 #   canary_min_requests: 20      # observations before rollback can fire
 #   drain_timeout: 10.0          # seconds to drain a retiring version
+
+## SLO engine (docs/observability.md#slo): declarative objectives with
+## multi-window error-budget burn-rate alerts, rendered by
+## `zoo-serving top` and gated by the bench soak leg
+# slo:
+#   fast_window_s: 10            # detection window
+#   slow_window_s: 60            # blip-immunity window
+#   burn_threshold: 2.0          # alert when burn exceeds this in BOTH
+#   objectives:
+#     - name: latency
+#       p99_ms: 250              # 99% of requests within 250ms
+#     - name: sheds
+#       shed_fraction: 0.05      # at most 5% of requests shed
 """
 
 
@@ -153,6 +169,10 @@ def _build_serving(cfg: str, workdir: str):
     helper = ClusterServingHelper(config_path=cfg)
     if not helper.stats_path:
         helper.stats_path = os.path.join(workdir, STATSFILE)
+    if not helper.request_log and (helper.telemetry or telemetry.enabled()):
+        # committed per-request timings — `zoo-serving trace <id>` scans
+        # every requests*.jsonl under the workdir for its waterfall
+        helper.request_log = os.path.join(workdir, "requests.jsonl")
     if not helper.registry_root:
         return ClusterServing(helper=helper), None
     from .registry import ModelRegistry, RegistryControlServer
@@ -337,10 +357,12 @@ def _print_fleet(workdir: str) -> bool:
             state = "STALE"
         age = (f"{r['health_age_s']:.1f}s"
                if r.get("health_age_s") is not None else "-")
+        dump = (f" flight_dump={r['flight_dump']}"
+                if r.get("flight_dump") else "")
         print(f"  worker {r['worker_id']}: pid={r['pid']} {state:4s} "
               f"health_age={age} "
               f"served={r['records_served']} shed={r['shed']} "
-              f"restarts={r['restarts']}")
+              f"restarts={r['restarts']}{dump}")
     return bool(rows)
 
 
@@ -361,7 +383,44 @@ def _print_fleet_metrics(workdir: str):
         print(f"    {m['name']}{lbl} = {m['value']:g}")
 
 
-def cmd_status(workdir: str) -> int:
+def _print_slo(stats: dict):
+    """Per-objective burn-rate/budget lines (present when the config has
+    an ``slo:`` section — utils/slo.py)."""
+    slo = stats.get("slo") or {}
+    for name in sorted(slo):
+        o = slo[name]
+        mark = "ALERT" if o.get("alerting") else "ok"
+        print(f"  slo {name:12s} [{o.get('kind')} <= {o.get('bound'):g}] "
+              f"burn fast={o.get('burn_fast', 0):.2f} "
+              f"slow={o.get('burn_slow', 0):.2f} "
+              f"budget={o.get('budget_remaining', 0) * 100:.1f}% "
+              f"alerts={o.get('alerts_fired', 0)} {mark}")
+
+
+def _read_stats_files(workdir: str):
+    """Every live pipeline_stats() snapshot under the workdir:
+    ``stats.json`` (single process) plus ``stats-worker-N.json`` (fleet)
+    — (source_name, stats_dict) pairs, unreadable files skipped."""
+    names = [STATSFILE]
+    try:
+        names += sorted(n for n in os.listdir(workdir)
+                        if n.startswith("stats-worker-")
+                        and n.endswith(".json"))
+    except FileNotFoundError:
+        pass
+    out = []
+    for name in names:
+        try:
+            with open(os.path.join(workdir, name)) as f:
+                out.append((name, json.load(f)))
+        except (OSError, ValueError):
+            continue
+    return out
+
+
+def _render_status(workdir: str) -> int:
+    """One status frame — the shared render path of ``status``,
+    ``status --watch`` and ``top``."""
     _, pidfile, _ = _paths(workdir)
     pid = _read_pid(pidfile)
     if pid is not None:
@@ -387,9 +446,15 @@ def cmd_status(workdir: str) -> int:
               f"dead_letters={stats.get('dead_letters', 0)} "
               f"batches={stats.get('batches', 0)}")
         _print_stage_percentiles(stats)
+        _print_slo(stats)
         if stats.get("models"):
             _print_models(stats["models"])
             return 0
+    elif fleet_rows:
+        for name, st in _read_stats_files(workdir):
+            if name == STATSFILE:
+                continue
+            _print_slo(st)
     # registry mode but no stats dump yet: fall back to the manifest
     root = _registry_root(workdir)
     if root:
@@ -397,6 +462,160 @@ def cmd_status(workdir: str) -> int:
 
         reg = ModelRegistry(root=root).recover(load=False)
         _print_models(reg.stats()["models"])
+    return 0
+
+
+def cmd_status(workdir: str, watch: float = None) -> int:
+    if watch is None:
+        return _render_status(workdir)
+    try:
+        while True:
+            if sys.stdout.isatty():
+                sys.stdout.write("\x1b[2J\x1b[H")
+            print(f"zoo-serving status  {time.strftime('%H:%M:%S')}  "
+                  f"(refresh {watch:g}s, Ctrl-C to exit)")
+            _render_status(workdir)
+            sys.stdout.flush()
+            time.sleep(watch)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def cmd_top(workdir: str, interval: float = 2.0,
+            iterations: int = None) -> int:
+    """Live fleet view (docs/observability.md#slo): qps (delta of
+    results_out between refreshes), stage percentiles, per-objective SLO
+    budget, per-worker health — refreshed every ``interval`` seconds.
+    ``iterations`` bounds the loop (tests / one-shot snapshots)."""
+    prev = {}
+    done = 0
+    try:
+        while iterations is None or done < iterations:
+            frames = _read_stats_files(workdir)
+            now = time.time()
+            if sys.stdout.isatty():
+                sys.stdout.write("\x1b[2J\x1b[H")
+            print(f"zoo-serving top  {time.strftime('%H:%M:%S')}  "
+                  f"(refresh {interval:g}s, Ctrl-C to exit)")
+            total_qps = 0.0
+            for name, st in frames:
+                out = st.get("results_out", 0)
+                qps = None
+                if name in prev:
+                    p_out, p_t = prev[name]
+                    if now > p_t:
+                        qps = max(out - p_out, 0) / (now - p_t)
+                        total_qps += qps
+                prev[name] = (out, now)
+                e2e = (st.get("stages") or {}).get("e2e") or {}
+                qps_s = f"{qps:7.1f}" if qps is not None else "      -"
+                print(f"  {name:24s} qps={qps_s} served={out} "
+                      f"shed={st.get('shed', 0)} "
+                      f"p50={e2e.get('p50', 0):.1f}ms "
+                      f"p99={e2e.get('p99', 0):.1f}ms")
+                _print_slo(st)
+            if len(frames) > 1:
+                print(f"  fleet qps={total_qps:.1f}")
+            _print_fleet(workdir)
+            sys.stdout.flush()
+            done += 1
+            if iterations is None or done < iterations:
+                time.sleep(interval)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def _request_log_rows(workdir: str):
+    """Committed timing payloads from every request log under the
+    workdir (``requests.jsonl`` single process, ``requests-worker-N.jsonl``
+    fleet, plus their rotated ``.1`` generations) as (source, row)."""
+    try:
+        names = sorted(n for n in os.listdir(workdir)
+                       if n.startswith("requests")
+                       and (n.endswith(".jsonl") or n.endswith(".jsonl.1")))
+    except FileNotFoundError:
+        return
+    for name in names:
+        try:
+            with open(os.path.join(workdir, name)) as f:
+                for line in f:
+                    try:
+                        yield name, json.loads(line)
+                    except ValueError:
+                        continue
+        except OSError:
+            continue
+
+
+def _print_waterfall(row: dict, src: str, width: int = 36):
+    """One request's committed timing as an offset bar chart."""
+    kind = row.get("kind", "predict")
+    print(f"{row.get('trace_id', '?')}  {kind}  uri={row.get('uri')}  "
+          f"[{src}]")
+    if row.get("error"):
+        print(f"  error: {row['error']}")
+    if kind == "generate":
+        ttft = row.get("ttft_ms")
+        decode = row.get("decode_ms")
+        stages = [("ttft", 0.0, ttft), ("decode", ttft or 0.0, decode)]
+    else:
+        transport = row.get("transport_in_ms")
+        queue_ms = row.get("queue_ms")
+        device = row.get("device_ms")
+        server = row.get("server_ms")
+        # the writer tail: everything of server_ms not accounted for by
+        # queue wait + device time (host transfer already in device_ms)
+        write = None
+        if server is not None:
+            write = max(server - (queue_ms or 0.0) - (device or 0.0), 0.0)
+        off = 0.0
+        stages = []
+        for nm, v in (("transport", transport), ("queue", queue_ms),
+                      ("device", device), ("write", write)):
+            stages.append((nm, off, v))
+            off += v or 0.0
+    total = max((off + (v or 0.0)) for _, off, v in stages) or 1.0
+    for nm, off, v in stages:
+        if v is None:
+            continue
+        pad = " " * int(width * off / total)
+        bar = "#" * max(int(width * v / total), 1)
+        print(f"  {nm:10s} {v:9.3f}ms  {pad}{bar}")
+    if row.get("server_ms") is not None:
+        print(f"  {'server':10s} {row['server_ms']:9.3f}ms")
+    if kind == "generate":
+        n = row.get("n_tokens")
+        tps = row.get("tokens_per_s")
+        print(f"  tokens: {n} @ {tps:g} tok/s" if tps is not None
+              else f"  tokens: {n}")
+        toks = row.get("token_ms") or []
+        if toks:
+            shown = ", ".join(f"{t:.1f}" for t in toks[:16])
+            more = f", … +{len(toks) - 16}" if len(toks) > 16 else ""
+            print(f"  token boundaries (ms after join): [{shown}{more}]")
+
+
+def cmd_trace(workdir: str, trace_id: str) -> int:
+    """Render the per-request waterfall for one trace id from the
+    committed request logs.  (The full cross-process span tree — every
+    queue/decode/dispatch slice with flow arrows — comes from
+    ``zoo-trace show <id> --dir <trace-dir>``.)"""
+    if not trace_id:
+        print("trace needs a trace id (clients print it at enqueue; "
+              "`zoo-trace ls --dir <trace-dir>` lists them)",
+              file=sys.stderr)
+        return 1
+    hits = [(src, row) for src, row in _request_log_rows(workdir)
+            if row.get("trace_id") == trace_id]
+    if not hits:
+        print(f"trace id {trace_id!r} not found in any requests*.jsonl "
+              f"under {workdir} (was the run telemetry-enabled?)",
+              file=sys.stderr)
+        return 1
+    for src, row in hits:
+        _print_waterfall(row, src)
     return 0
 
 
@@ -478,6 +697,8 @@ def cmd_generate(workdir: str, prompt: str, max_new_tokens=None,
     iq.enqueue_generate(uri, tokens, max_new_tokens=max_new_tokens,
                         stop_id=stop_id, temperature=temperature,
                         deadline_ms=deadline_ms)
+    if iq.last_trace_id:
+        print(f"trace_id: {iq.last_trace_id}", file=sys.stderr)
     got = oq.wait_all([uri], timeout=timeout)
     res = got.get(uri)
     if res is None:
@@ -551,8 +772,17 @@ def main(argv=None) -> int:
     ap.add_argument("command", choices=["init", "start", "fleet", "status",
                                         "stop", "restart", "shutdown",
                                         "deploy", "promote", "undeploy",
-                                        "generate"])
+                                        "generate", "trace", "top"])
+    ap.add_argument("trace_id", nargs="?", default=None,
+                    help="trace: the request's trace id (clients print "
+                         "it at enqueue)")
     ap.add_argument("--dir", default=".", help="serving working directory")
+    ap.add_argument("--watch", default=None, type=float, metavar="SEC",
+                    help="status: refresh every SEC seconds until Ctrl-C")
+    ap.add_argument("--interval", default=2.0, type=float,
+                    help="top: refresh period in seconds")
+    ap.add_argument("--iterations", default=None, type=int,
+                    help="top: stop after N refreshes (default: forever)")
     ap.add_argument("--workers", default=None, type=int,
                     help="fleet: worker process count (default: config "
                          "params.workers)")
@@ -617,7 +847,12 @@ def main(argv=None) -> int:
     if args.command == "fleet":
         return cmd_fleet(workdir, workers=args.workers)
     if args.command == "status":
-        return cmd_status(workdir)
+        return cmd_status(workdir, watch=args.watch)
+    if args.command == "trace":
+        return cmd_trace(workdir, args.trace_id)
+    if args.command == "top":
+        return cmd_top(workdir, interval=args.interval,
+                       iterations=args.iterations)
     if args.command == "stop":
         return cmd_stop(workdir)
     if args.command == "restart":

@@ -25,6 +25,7 @@ from typing import Dict, Iterable, Optional
 
 import numpy as np
 
+from ..utils import telemetry
 from .queue_backend import StreamQueue, get_queue_backend
 
 
@@ -133,10 +134,14 @@ class API:
 
 
 class InputQueue(API):
-    @staticmethod
-    def _route_fields(rec: dict, model: Optional[str],
+    #: trace id stamped on the most recent enqueue (the handle for
+    #: `zoo-serving trace <id>` / `zoo-trace show <id>`)
+    last_trace_id: Optional[str] = None
+
+    def _route_fields(self, rec: dict, model: Optional[str],
                       version: Optional[int],
-                      deadline_ms: Optional[float] = None) -> dict:
+                      deadline_ms: Optional[float] = None,
+                      trace_id: Optional[str] = None) -> dict:
         # optional on the wire: absent fields route to the server's
         # default model, so pre-registry clients keep working unchanged
         if model is not None:
@@ -146,7 +151,24 @@ class InputQueue(API):
         if deadline_ms is not None:
             rec["deadline_ms"] = float(deadline_ms)
         rec["enqueue_ts_ms"] = time.time() * 1e3
+        # Dapper-style trace context: every wire record carries a
+        # client-stamped trace id + the client's span name as parent;
+        # each downstream hop (queue delivery, admission, pipeline
+        # stages, device dispatch, write) tags its spans with the same
+        # id, so one request merges into one causal tree across
+        # processes (docs/observability.md#tracing)
+        rec["trace_id"] = trace_id or telemetry.new_trace_id()
+        rec["parent_span"] = "client/enqueue"
+        self.last_trace_id = rec["trace_id"]
         return rec
+
+    def _traced_enqueue(self, rec: dict) -> str:
+        """Enqueue inside a client span tagged with the record's trace
+        id, opening the flow arrow the server's intake span closes."""
+        with telemetry.span("client/enqueue", trace_id=rec["trace_id"],
+                            uri=rec.get("uri")):
+            telemetry.flow("serving/request", rec["trace_id"], "s")
+            return self.db.enqueue(rec)
 
     def enqueue_image(self, uri: str, img, model: Optional[str] = None,
                       version: Optional[int] = None,
@@ -165,7 +187,7 @@ class InputQueue(API):
         else:
             data = bytes(img)
         rec = {"uri": uri, "image": self.base64_encode_image(data)}
-        return self.db.enqueue(
+        return self._traced_enqueue(
             self._route_fields(rec, model, version, deadline_ms))
 
     def enqueue(self, uri: str, model: Optional[str] = None,
@@ -176,7 +198,7 @@ class InputQueue(API):
             k: {"shape": list(np.asarray(v).shape),
                 "data": np.asarray(v, np.float32).tobytes()}
             for k, v in tensors.items()}}
-        return self.db.enqueue(
+        return self._traced_enqueue(
             self._route_fields(rec, model, version, deadline_ms))
 
     def enqueue_generate(self, uri: str, prompt,
@@ -200,7 +222,7 @@ class InputQueue(API):
         if temperature is not None:
             gen["temperature"] = float(temperature)
         rec = {"uri": uri, "generate": gen}
-        return self.db.enqueue(
+        return self._traced_enqueue(
             self._route_fields(rec, model, version, deadline_ms))
 
     @staticmethod
@@ -295,6 +317,10 @@ class OutputQueue(API):
                 if server_ms is not None:
                     timing["transport_ms"] = round(
                         max(timing["rtt_ms"] - server_ms, 0.0), 3)
+            if timing.get("trace_id"):
+                telemetry.event("client/result", uri=uri,
+                                trace_id=timing["trace_id"],
+                                rtt_ms=timing.get("rtt_ms"))
         if "tokens" in obj and "value" not in obj:
             return GenerationResult.wrap(obj["tokens"],
                                          obj.get("finish"), timing)

@@ -51,6 +51,7 @@ from __future__ import annotations
 import base64
 import json
 import logging
+import os
 import queue
 import threading
 import time
@@ -63,6 +64,7 @@ from ..pipeline.inference import InferenceModel
 from ..pipeline.inference.inference_model import AbstractModel
 from ..pipeline.inference.inference_summary import InferenceSummary
 from ..utils import telemetry
+from ..utils.slo import SloEngine, parse_slo_config
 from ..utils.telemetry import span
 from .admission import (AdaptiveBatcher, AdmissionController, SHED_DEADLINE,
                         SHED_EXPIRED, now_ms)
@@ -84,6 +86,46 @@ class RecordMeta(NamedTuple):
     enqueue_ts_ms: Optional[float]   # stamped by the client
     dequeue_ts_ms: Optional[float]   # stamped by the queue backend
     deadline_at_ms: Optional[float]  # absolute deadline; None = no deadline
+    trace_id: Optional[str] = None   # client-stamped request trace context
+
+
+class _RequestLog:
+    """Append-only jsonl of committed request timings keyed by trace id
+    — the data source `zoo-serving trace <id>` renders its waterfall
+    from.  Size-rotated (one ``.1`` generation) so a long-running worker
+    cannot fill the disk; writes never raise into the serve path."""
+
+    def __init__(self, path: str, max_bytes: int = 16 << 20):
+        self.path = os.path.abspath(path)
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        self._f = None
+        self._written = 0
+
+    def append(self, obj: dict):
+        try:
+            line = json.dumps(obj) + "\n"
+            with self._lock:
+                if self._f is None:
+                    os.makedirs(os.path.dirname(self.path), exist_ok=True)
+                    self._f = open(self.path, "a")
+                    self._written = self._f.tell()
+                self._f.write(line)
+                self._f.flush()
+                self._written += len(line)
+                if self._written > self.max_bytes:
+                    self._f.close()
+                    os.replace(self.path, self.path + ".1")
+                    self._f = open(self.path, "a")
+                    self._written = 0
+        except OSError:
+            pass
+
+    def close(self):
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
 
 
 class EchoStubModel(AbstractModel):
@@ -198,6 +240,12 @@ class ClusterServingHelper:
         # metrics.json; the CLI --trace-dir flag overrides trace_dir
         self.telemetry = _parse_bool(params.get("telemetry"), False)
         self.trace_dir = params.get("trace_dir")
+        # committed request timings (jsonl) for `zoo-serving trace <id>`;
+        # the CLI/fleet default this under the workdir when telemetry is on
+        self.request_log = params.get("request_log")
+        # -- SLO objectives (utils/slo.py, docs/observability.md#slo) ----
+        self.slo_config = config.get("slo") or {}
+        self.slo_objectives = parse_slo_config(self.slo_config)
         # -- generative serving (docs/serving-generate.md) --------------
         gen = config.get("generate") or {}
         self.generate_slots = int(gen.get("slots") or 4)
@@ -270,6 +318,16 @@ class ClusterServing:
             self.buckets, self.admission,
             linger_ms=float(getattr(h, "linger_ms", 0.0)))
         self.default_deadline_ms = getattr(h, "default_deadline_ms", None)
+        # SLO engine (utils/slo.py): armed when the config declares
+        # objectives; evaluated live by the stats loop, fed by the
+        # writer/shed/dead-letter paths through _count/_record_row_timing
+        self.slo: Optional[SloEngine] = None
+        if getattr(h, "slo_objectives", None):
+            self.slo = SloEngine(h.slo_objectives)
+        # committed-timing jsonl for `zoo-serving trace <id>`
+        self._request_log: Optional[_RequestLog] = None
+        if getattr(h, "request_log", None):
+            self._request_log = _RequestLog(h.request_log)
         # intake backlog sources, populated by _serve_pipelined (admission
         # reads live queue depths instead of guessing from counters)
         self._backlog_queues: List[queue.Queue] = []
@@ -325,6 +383,13 @@ class ClusterServing:
         with self._ctr_lock:
             for name, d in deltas.items():
                 setattr(self, name, getattr(self, name) + d)
+        # every shed / dead letter is one bad event in the SLO stream
+        # (served rows enter through _record_row_timing with a latency)
+        if self.slo is not None:
+            for _ in range(int(deltas.get("shed", 0))):
+                self.slo.record(shed=True)
+            for _ in range(int(deltas.get("dead_letters", 0))):
+                self.slo.record(error=True)
 
     def pipeline_stats(self) -> dict:
         """Counters + per-stage percentiles + queue depths — the payload
@@ -338,6 +403,8 @@ class ClusterServing:
                    "batches": self.batches,
                    "buckets": dict(self.bucket_counts)}
         out["admission"] = self.admission.stats()
+        if self.slo is not None:
+            out["slo"] = self.slo.status()
         if self._gen_sched is not None:
             out["generation"] = self._gen_sched.stats()
         if hasattr(self.db, "consumer_stats"):
@@ -354,8 +421,11 @@ class ClusterServing:
             # relative to the client stamp when present, else to arrival
             deadline_at = (enq if enq is not None else now_ms()) \
                 + float(deadline_ms)
+        trace_id = rec.get("trace_id") or rec.get(b"trace_id")
+        if isinstance(trace_id, (bytes, bytearray)):
+            trace_id = trace_id.decode()
         return RecordMeta(t_in, rec.get("uri", rid), enq,
-                          rec.get("dequeue_ts_ms"), deadline_at)
+                          rec.get("dequeue_ts_ms"), deadline_at, trace_id)
 
     def _backlog(self) -> int:
         return sum(q.qsize() for q in self._backlog_queues)
@@ -371,9 +441,12 @@ class ClusterServing:
         for m in metas:
             payload[m.uri] = json.dumps(
                 {"error": msg, "code": code}).encode()
+            # typed shed tagged with the request's trace context, so a
+            # rejected request still shows its (truncated) causal tree
+            telemetry.event("serving/shed", code=code, uri=m.uri,
+                            trace_id=m.trace_id)
         self.db.put_results(payload)
         self._count(shed=len(metas))
-        telemetry.event("serving/shed", code=code, n=len(metas))
         telemetry.counter("zoo_serving_shed_total", code=code).inc(len(metas))
 
     @staticmethod
@@ -384,7 +457,10 @@ class ClusterServing:
         (dequeue → dispatch), device_ms (dispatch → host transfer done),
         server_ms (dequeue → result committed).  The client adds
         rtt_ms/transport_ms from its own receive stamp."""
-        t = {"device_ms": round(device_ms, 3), "done_ts_ms": round(done_ms, 3)}
+        t = {"device_ms": round(device_ms, 3), "done_ts_ms": round(done_ms, 3),
+             "uri": meta.uri}
+        if meta.trace_id:
+            t["trace_id"] = meta.trace_id
         if meta.enqueue_ts_ms is not None:
             t["enqueue_ts_ms"] = meta.enqueue_ts_ms
         if meta.dequeue_ts_ms is not None:
@@ -400,13 +476,23 @@ class ClusterServing:
 
     def _record_row_timing(self, timing: dict):
         """Feed the decomposition into the summary so percentiles for
-        the new stages ride the existing snapshot machinery."""
+        the new stages ride the existing snapshot machinery — plus the
+        SLO stream (one good/bad event per served row) and the
+        committed-timing request log (`zoo-serving trace <id>`)."""
         self.summary.record_stage("device", timing["device_ms"] / 1e3)
         if "transport_in_ms" in timing:
             self.summary.record_stage("transport",
                                       timing["transport_in_ms"] / 1e3)
         if "queue_ms" in timing:
             self.summary.record_stage("queue_wait", timing["queue_ms"] / 1e3)
+        if self.slo is not None:
+            if timing.get("enqueue_ts_ms") is not None:
+                lat = timing["done_ts_ms"] - timing["enqueue_ts_ms"]
+            else:
+                lat = timing.get("server_ms", timing["device_ms"])
+            self.slo.record(latency_ms=lat)
+        if self._request_log is not None:
+            self._request_log.append(dict(timing, kind="predict"))
 
     # ------------------------------------------------------------------
     # generative serving (docs/serving-generate.md)
@@ -457,10 +543,24 @@ class ClusterServing:
         """Scheduler results land in the same results map as
         predictions; sequences finish at different steps, so each commit
         is a single-uri write the moment its sequence evicts."""
+        timing = payload.get("timing") or {}
         if "error" in payload:
             self._count(shed=1)
+            if self.slo is not None:
+                self.slo.record(shed=True)
         else:
             self._count(results_out=1)
+            if self.slo is not None:
+                lat = timing.get("server_ms")
+                if timing.get("enqueue_ts_ms") is not None and \
+                        timing.get("done_ts_ms") is not None:
+                    lat = timing["done_ts_ms"] - timing["enqueue_ts_ms"]
+                self.slo.record(latency_ms=lat)
+        if self._request_log is not None:
+            row = dict(timing, kind="generate", uri=uri)
+            if "error" in payload:
+                row["error"] = payload.get("code") or payload["error"]
+            self._request_log.append(row)
         self.db.put_results({uri: json.dumps(payload).encode()})
 
     def _maybe_generate(self, rid: str, rec: dict,
@@ -472,6 +572,12 @@ class ClusterServing:
         if gen is None:
             return False
         meta = self._meta_for(rid, rec, t_in)
+        if meta.trace_id:
+            # step the client's flow arrow at the intake hop; the
+            # scheduler's prefill span finishes it (same trace_id)
+            telemetry.flow("serving/request", meta.trace_id, "t")
+            telemetry.event("generate/intake", uri=meta.uri,
+                            trace_id=meta.trace_id)
         if isinstance(gen, (bytes, bytearray)):
             # redis transports msgpack non-scalar fields
             import msgpack
@@ -499,7 +605,8 @@ class ClusterServing:
             temperature=float(gen.get("temperature") or 0.0),
             deadline_at_ms=meta.deadline_at_ms,
             enqueue_ts_ms=meta.enqueue_ts_ms,
-            t_in=t_in))
+            t_in=t_in,
+            trace_id=meta.trace_id))
         return True
 
     # ------------------------------------------------------------------
@@ -519,9 +626,14 @@ class ClusterServing:
                                     t_in or time.perf_counter()):
                 continue
             try:
-                arrays.append(self._decode_record(rec))
-                metas.append(self._meta_for(rid, rec,
-                                            t_in or time.perf_counter()))
+                meta = self._meta_for(rid, rec,
+                                      t_in or time.perf_counter())
+                with span("serving/decode", trace_id=meta.trace_id,
+                          uri=meta.uri):
+                    if meta.trace_id:
+                        telemetry.flow("serving/request", meta.trace_id, "f")
+                    arrays.append(self._decode_record(rec))
+                metas.append(meta)
             except Exception as e:  # bad record: report, keep serving
                 logger.warning("skipping record %s: %s", rid, e)
                 self._count(dropped=1)
@@ -588,7 +700,11 @@ class ClusterServing:
             meta, rid, rec = item
             t0 = time.perf_counter()
             try:
-                with span("serving/decode"):
+                with span("serving/decode", trace_id=meta.trace_id,
+                          uri=meta.uri):
+                    if meta.trace_id:
+                        # bind the client's flow arrow to this slice
+                        telemetry.flow("serving/request", meta.trace_id, "f")
                     arr = self._decode_record(rec)
             except Exception as e:  # bad record: report, keep serving
                 self._on_decode_error(rid, rec, e)
@@ -656,8 +772,10 @@ class ClusterServing:
         arrays = [it[1] for it in live]
         n = len(arrays)
         bucket = pick_bucket(n, self.buckets)
+        trace_ids = [m.trace_id for m in metas if m.trace_id]
         try:
-            with span("serving/dispatch", n=n, bucket=bucket):
+            with span("serving/dispatch", n=n, bucket=bucket,
+                      trace_ids=trace_ids):
                 batch = np.stack(arrays)
                 if n < bucket:
                     pad = np.repeat(batch[-1:], bucket - n, axis=0)
@@ -684,8 +802,9 @@ class ClusterServing:
             if item is _SENTINEL:
                 return
             metas, n, t_disp, disp_ts_ms, out = item
+            trace_ids = [m.trace_id for m in metas if m.trace_id]
             try:
-                with span("serving/device_sync", n=n):
+                with span("serving/device_sync", n=n, trace_ids=trace_ids):
                     preds = np.asarray(out)[:n]  # host transfer sync point
             except Exception as e:
                 logger.warning("dropping results for %d records (%s)",
@@ -699,7 +818,7 @@ class ClusterServing:
             self.admission.observe_batch(n, dt)
             done_ms = now_ms()
             t0 = time.perf_counter()
-            with span("serving/write", n=n):
+            with span("serving/write", n=n, trace_ids=trace_ids):
                 results = {}
                 for meta, p in zip(metas, preds):
                     obj = self._format_result(p)
@@ -796,16 +915,24 @@ class ClusterServing:
     def _stats_dump_loop(self, interval: float = 2.0):
         """Periodically snapshot pipeline_stats() to ``stats_path`` (atomic
         rename) so `zoo-serving status` can report live percentiles from
-        outside the process."""
+        outside the process — and, when SLO objectives are armed, run one
+        burn-rate evaluation pass per tick (gauges + edge-triggered
+        alerts; utils/slo.py)."""
         from ..utils import file_io
 
         while True:
-            try:
-                file_io.write_bytes_atomic(
-                    self.stats_path,
-                    json.dumps(self.pipeline_stats()).encode())
-            except Exception as e:  # noqa: BLE001 - observability only
-                logger.debug("stats dump failed: %s", e)
+            if self.slo is not None:
+                try:
+                    self.slo.evaluate()
+                except Exception as e:  # noqa: BLE001 - observability only
+                    logger.debug("slo evaluate failed: %s", e)
+            if self.stats_path:
+                try:
+                    file_io.write_bytes_atomic(
+                        self.stats_path,
+                        json.dumps(self.pipeline_stats()).encode())
+                except Exception as e:  # noqa: BLE001 - observability only
+                    logger.debug("stats dump failed: %s", e)
             if self._stop.wait(interval):
                 return
 
@@ -814,7 +941,7 @@ class ClusterServing:
                     self.helper.batch_size,
                     "pipelined" if self.pipelined else "synchronous",
                     self.buckets if self.pipelined else "n/a")
-        if self.stats_path:
+        if self.stats_path or self.slo is not None:
             threading.Thread(target=self._stats_dump_loop, daemon=True,
                              name="serving-stats").start()
         if self.pipelined:
@@ -840,3 +967,5 @@ class ClusterServing:
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
+        if self._request_log is not None:
+            self._request_log.close()

@@ -38,7 +38,7 @@ from typing import Dict, List, Optional
 
 from ..launcher.supervisor import (SupervisedProc, inject_pythonpath,
                                    spawn_supervised, terminate_all)
-from ..utils import file_io
+from ..utils import file_io, telemetry
 
 logger = logging.getLogger("analytics_zoo_tpu.serving.fleet")
 
@@ -148,6 +148,7 @@ def fleet_status(workdir: str,
             "restarts": s.get("restarts", h.get("restarts", 0)),
             "backoff_until": s.get("backoff_until", 0.0),
             "crash_looped": s.get("crash_looped", False),
+            "flight_dump": h.get("flight_dump") or s.get("flight_dump"),
         })
     # workers the supervisor is tracking that never (re)wrote a
     # heartbeat — dead in backoff, or crash-looped before first beat
@@ -161,6 +162,7 @@ def fleet_status(workdir: str,
             "restarts": s.get("restarts", 0),
             "backoff_until": s.get("backoff_until", 0.0),
             "crash_looped": s.get("crash_looped", False),
+            "flight_dump": s.get("flight_dump"),
         })
     rows.sort(key=lambda r: (r["worker_id"] is None, r["worker_id"]))
     return rows
@@ -261,6 +263,7 @@ class ServingFleet:
         self.restarts: Dict[int, int] = {}
         self.backoff_until: Dict[int, float] = {}
         self.crash_looped: set = set()
+        self.flight_dumps: Dict[int, str] = {}
         self._stop = threading.Event()
         os.makedirs(os.path.join(self.workdir, HEALTH_DIR), exist_ok=True)
 
@@ -307,6 +310,8 @@ class ServingFleet:
                 "backoff_until": self.backoff_until.get(wid, 0.0),
                 "crash_looped": wid in self.crash_looped,
             }
+            if wid in self.flight_dumps:
+                state[str(wid)]["flight_dump"] = self.flight_dumps[wid]
         file_io.write_bytes_atomic(supervisor_path(self.workdir),
                                    json.dumps(state).encode())
 
@@ -350,10 +355,21 @@ class ServingFleet:
             del self._procs[wid]
             if self.restarts[wid] > self.max_restarts:
                 self.crash_looped.add(wid)
+                # post-mortem: dump the supervisor's own flight recorder
+                # (it saw every restart event) and stamp the path into
+                # supervisor.json so `zoo-serving status` can point at it
+                telemetry.event("fleet/crash_loop", worker_id=wid,
+                                restarts=self.restarts[wid], reason=reason)
+                dump = telemetry.dump_flight(
+                    f"fleet worker-{wid} crash loop ({reason})")
+                if dump:
+                    self.flight_dumps[wid] = dump
                 with self._lock:
                     self.stream.write(
                         f"[fleet] worker-{wid} {reason}; crash loop "
-                        f"(> {self.max_restarts} restarts), giving up\n")
+                        f"(> {self.max_restarts} restarts), giving up"
+                        + (f" (flight recorder: {dump})" if dump else "")
+                        + "\n")
                     self.stream.flush()
                 self._write_supervisor_state()
                 continue

@@ -45,6 +45,11 @@ def _build_serving(cfg: str, workdir: str, worker_id: int):
     helper = ClusterServingHelper(config_path=cfg)
     helper.stats_path = os.path.join(workdir,
                                      f"stats-worker-{worker_id}.json")
+    if not helper.request_log and (helper.telemetry or telemetry.enabled()):
+        # committed timings per worker — `zoo-serving trace <id>` scans
+        # every requests*.jsonl under the workdir for the waterfall
+        helper.request_log = os.path.join(
+            workdir, f"requests-worker-{worker_id}.jsonl")
     if not helper.registry_root:
         return ClusterServing(helper=helper), None
     from .registry import ModelRegistry, RegistryControlServer
@@ -95,13 +100,17 @@ def _heartbeat(serving, workdir: str, worker_id: int,
     while True:
         with serving._ctr_lock:
             served, shed = serving.results_out, serving.shed
-        write_health(workdir, worker_id, {
+        payload = {
             "pid": os.getpid(),
             "started_at": started,
             "records_served": served,
             "shed": shed,
             "restarts": restarts,
-        })
+        }
+        dump = getattr(serving, "_flight_dump_path", None)
+        if dump:
+            payload["flight_dump"] = dump
+        write_health(workdir, worker_id, payload)
         if stop.wait(interval):
             return
 
@@ -139,18 +148,29 @@ def main(argv=None) -> int:
     if serving.helper.warmup:
         serving.warmup()
     stop = threading.Event()
+    restarts = int(os.environ.get("ZOO_SERVING_WORKER_RESTARTS", "0"))
 
     def _term(sig, _frm):
         telemetry.event("serving/drain", signal=sig,
                         worker=args.worker_id)
-        telemetry.dump_flight(
+        dump = telemetry.dump_flight(
             f"serving worker {args.worker_id} draining on signal {sig}")
+        if dump:
+            # stamp the post-mortem path into the heartbeat file so
+            # `zoo-serving status` can point an operator straight at it
+            serving._flight_dump_path = dump
+            with serving._ctr_lock:
+                served, shed = serving.results_out, serving.shed
+            write_health(workdir, args.worker_id, {
+                "pid": os.getpid(), "records_served": served,
+                "shed": shed, "restarts": restarts,
+                "flight_dump": dump, "draining": True,
+            })
         stop.set()
         serving._stop.set()
 
     signal.signal(signal.SIGTERM, _term)
     signal.signal(signal.SIGINT, _term)
-    restarts = int(os.environ.get("ZOO_SERVING_WORKER_RESTARTS", "0"))
     hb = threading.Thread(
         target=_heartbeat,
         args=(serving, workdir, args.worker_id, stop,

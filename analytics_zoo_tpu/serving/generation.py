@@ -72,6 +72,7 @@ class GenRequest:
     deadline_at_ms: Optional[float] = None
     enqueue_ts_ms: Optional[float] = None
     t_in: float = field(default_factory=time.perf_counter)
+    trace_id: Optional[str] = None      # client-stamped trace context
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt).astype(np.int64).ravel()
@@ -87,6 +88,7 @@ class _Slot:
     last: int = 0
     t_join: float = 0.0
     t_first_token: Optional[float] = None
+    t_tokens: List[float] = field(default_factory=list)
     finish: Optional[str] = None
 
 
@@ -364,14 +366,18 @@ class ContinuousBatchScheduler:
 
     def _join(self, slot: int, req: GenRequest):
         with span("generate/prefill", uri=req.uri, slot=slot,
-                  prompt_len=int(req.prompt.size)):
+                  prompt_len=int(req.prompt.size),
+                  trace_id=req.trace_id):
+            if req.trace_id:
+                telemetry.flow("serving/request", req.trace_id, "f")
             self._state, first = self.engine.join(self._state, slot, req)
         s = _Slot(req=req, t_join=time.perf_counter())
         self._slots[slot] = s
         with self._lock:
             self.counts["joins"] += 1
         telemetry.counter("zoo_generate_join_total").inc()
-        telemetry.event("generate_join", uri=req.uri, slot=slot)
+        telemetry.event("generate_join", uri=req.uri, slot=slot,
+                        trace_id=req.trace_id)
         self._note_token(slot, int(first))
 
     def _note_token(self, slot: int, tok: int):
@@ -384,6 +390,8 @@ class ContinuousBatchScheduler:
             s.t_first_token = t_now
             telemetry.summary("zoo_generate_ttft_ms").record(
                 (t_now - s.req.t_in) * 1e3)
+        if telemetry.enabled():
+            s.t_tokens.append(t_now)
         s.tokens.append(tok)
         s.last = tok
         with self._lock:
@@ -405,7 +413,8 @@ class ContinuousBatchScheduler:
         telemetry.counter("zoo_generate_evict_total",
                           reason=s.finish).inc()
         telemetry.event("generate_evict", uri=s.req.uri, slot=slot,
-                        reason=s.finish, n_tokens=len(s.tokens))
+                        reason=s.finish, n_tokens=len(s.tokens),
+                        trace_id=s.req.trace_id)
         if s.finish == FINISH_DEADLINE:
             self._shed(s.req, SHED_DEADLINE,
                        "deadline exceeded mid-generation",
@@ -421,10 +430,19 @@ class ContinuousBatchScheduler:
             "n_tokens": len(s.tokens),
             "tokens_per_s": round(tokens_per_s, 3),
         }
+        if s.req.trace_id:
+            timing["trace_id"] = s.req.trace_id
+        if s.t_tokens:
+            # per-token boundaries relative to join — the waterfall's
+            # token ruler (`zoo-serving trace <id>`); recorded only
+            # while telemetry is enabled to keep the hot path flat
+            timing["token_ms"] = [round((t - s.t_join) * 1e3, 3)
+                                  for t in s.t_tokens]
         if s.req.enqueue_ts_ms is not None:
             # lets the client complete the rtt/transport decomposition
             timing["enqueue_ts_ms"] = s.req.enqueue_ts_ms
             timing["server_ms"] = timing["ttft_ms"] + timing["decode_ms"]
+            timing["done_ts_ms"] = now_ms()
         self._commit(s.req.uri, {"tokens": list(s.tokens),
                                  "finish": s.finish, "timing": timing})
 

@@ -41,6 +41,8 @@ from typing import Dict, List, Optional, Tuple
 
 import msgpack
 
+from ..utils import telemetry
+
 
 class StreamQueue:
     """Interface: a named input stream + a results map."""
@@ -79,11 +81,21 @@ class StreamQueue:
     def _stamp_dequeue(items: List[Tuple[str, dict]]
                        ) -> List[Tuple[str, dict]]:
         """Stamp delivery time (epoch ms) on every record so the server
-        can report transport vs device latency per row."""
+        can report transport vs device latency per row; with telemetry
+        on, each delivery is an instant event tagged with the record's
+        trace id — the queue hop in the merged request tree."""
         ts = time.time() * 1e3
+        traced = telemetry.enabled()
         for _rid, rec in items:
             if isinstance(rec, dict):
                 rec.setdefault("dequeue_ts_ms", ts)
+                if traced:
+                    tid = rec.get("trace_id") or rec.get(b"trace_id")
+                    if tid:
+                        if isinstance(tid, (bytes, bytearray)):
+                            tid = tid.decode()
+                        telemetry.event("queue/deliver", trace_id=tid,
+                                        uri=rec.get("uri"))
         return items
 
 

@@ -166,10 +166,13 @@ class InfeedMonitor:
                 out["infeed_workers"] = float(len(snap))
                 out["infeed_worker_utilization"] = min(
                     1.0, sum(busy) / (len(busy) * wall_s))
-        for key in ("input_bound_fraction", "step_time_ms",
-                    "infeed_worker_utilization"):
+        for key, metric in (
+                ("input_bound_fraction", "zoo_input_bound_fraction"),
+                ("step_time_ms", "zoo_step_time_ms"),
+                ("infeed_worker_utilization",
+                 "zoo_infeed_worker_utilization")):
             if key in out:
-                telemetry.gauge(f"zoo_{key}", scope=self.scope).set(out[key])
+                telemetry.gauge(metric, scope=self.scope).set(out[key])
         return out
 
 

@@ -50,7 +50,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 __all__ = [
     "MetricsRegistry", "Counter", "Gauge", "Histogram", "Summary",
     "get_registry", "counter", "gauge", "histogram", "summary",
-    "span", "event", "enabled", "set_enabled", "configure",
+    "span", "event", "flow", "new_trace_id",
+    "enabled", "set_enabled", "configure",
     "enable_forwarding", "drain_events", "ingest_events",
     "write_trace", "dump_flight", "flight_events",
     "snapshot_metrics", "render_prometheus",
@@ -407,7 +408,9 @@ _atexit_armed = False
 
 # Event wire format (tuple keeps the hot path + pickling cheap):
 #   (ph, name, ts_us, tid, args_or_None)
-# ph: "B" span begin, "E" span end, "i" instant event.
+# ph: "B" span begin, "E" span end, "i" instant event,
+#     "s"/"t"/"f" flow start/step/finish (args carries the flow "id" —
+#     cross-process arrows in the merged trace, docs/observability.md).
 
 
 def _now_us() -> int:
@@ -476,6 +479,29 @@ def event(name: str, **args):
     _record(("i", name, _now_us(), threading.get_ident(), args or None))
 
 
+def new_trace_id() -> str:
+    """A fresh 16-hex trace id (Dapper-style request identity —
+    clients stamp it onto wire records, every downstream span carries
+    it in its args, docs/observability.md#tracing)."""
+    return os.urandom(8).hex()
+
+
+def flow(name: str, flow_id: str, phase: str = "s", **args):
+    """Record a Chrome-trace flow event: ``phase`` is ``"s"`` (start),
+    ``"t"`` (step) or ``"f"`` (finish).  Events sharing ``flow_id``
+    render as arrows across pids in the merged timeline — emit the
+    start inside the producer's span and the finish inside the
+    consumer's, and the request becomes one connected tree even when
+    the hops cross processes."""
+    if not _ENABLED:
+        return
+    if phase not in ("s", "t", "f"):
+        raise ValueError(f"flow phase must be s/t/f, got {phase!r}")
+    a = dict(args)
+    a["id"] = str(flow_id)
+    _record((phase, name, _now_us(), threading.get_ident(), a))
+
+
 def enabled() -> bool:
     return _ENABLED
 
@@ -524,6 +550,13 @@ def _ev_json(ev: tuple, pid) -> dict:
            "cat": name.split("/", 1)[0]}
     if ph == "i":
         out["s"] = "t"
+    if ph in ("s", "t", "f"):
+        # flow events carry their binding id at the top level; finishes
+        # bind to the enclosing slice ("bp":"e") so the arrow lands on
+        # the consumer span, not the next slice on the thread
+        out["id"] = (args or {}).get("id", "")
+        if ph == "f":
+            out["bp"] = "e"
     if args:
         out["args"] = args
     return out

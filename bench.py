@@ -1571,6 +1571,180 @@ def bench_generation(n_requests=48, slots=8, step_ms=2.0):
     return out
 
 
+def bench_soak(duration_s=62.0, target_qps=120.0, batch_size=8,
+               stub_ms=2.0, p99_bound_ms=250.0, shed_bound=0.05):
+    """SLO soak leg (docs/observability.md#slo): sustained target-qps
+    traffic through the pipelined server for >= 60s with the SLO engine
+    armed (p99 latency + shed-fraction objectives, multi-window
+    burn-rate evaluation running live in the server's stats loop).
+    Producer thread paces enqueues at ``target_qps``; the stub device
+    keeps capacity comfortably above the offered rate, so the steady
+    state must hold every objective — the gates are literal:
+
+    - served-row server-side p99 <= ``p99_bound_ms``;
+    - shed fraction <= ``shed_bound``;
+    - **zero** burn-rate alerts fired over the whole soak (alerts are
+      edge-triggered, so a healthy service emits none — a single false
+      alert fails the leg).
+    """
+    import threading
+
+    from analytics_zoo_tpu.serving import (ClusterServing,
+                                           ClusterServingHelper,
+                                           InProcessStreamQueue,
+                                           InputQueue, OutputQueue,
+                                           ServingRejected, ServingResult)
+
+    helper = ClusterServingHelper(config={
+        "model": {"stub_ms_per_batch": stub_ms},
+        "data": {"image_shape": "3, 8, 8"},
+        "params": {"batch_size": batch_size, "top_n": 0,
+                   "decode_workers": 2, "pipelined": True},
+        "slo": {"fast_window_s": 5.0, "slow_window_s": 15.0,
+                "burn_threshold": 2.0,
+                "objectives": [
+                    {"name": "latency", "p99_ms": p99_bound_ms},
+                    {"name": "sheds", "shed_fraction": shed_bound}]}})
+    backend = InProcessStreamQueue()
+    serving = ClusterServing(helper=helper, backend=backend)
+    in_q = InputQueue(backend=backend)
+    x = np.full((3, 8, 8), 7, np.float32)
+    uris = []
+    stop_producing = threading.Event()
+
+    def _produce():
+        period = 1.0 / target_qps
+        i = 0
+        t_next = time.perf_counter()
+        while not stop_producing.is_set():
+            in_q.enqueue(f"s-{i}", input=x)
+            uris.append(f"s-{i}")
+            i += 1
+            t_next += period
+            delay = t_next - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+
+    serving.start()
+    producer = threading.Thread(target=_produce, daemon=True)
+    t0 = time.perf_counter()
+    producer.start()
+    time.sleep(duration_s)
+    stop_producing.set()
+    producer.join(timeout=10)
+    got = OutputQueue(backend=backend).wait_all(
+        list(uris), timeout=60, max_poll=0.05)
+    wall = time.perf_counter() - t0
+    slo_status = serving.slo.status()
+    total_alerts = serving.slo.total_alerts()
+    serving.stop()
+
+    served_ms, shed = [], 0
+    for v in got.values():
+        if isinstance(v, ServingRejected):
+            shed += 1
+            continue
+        t = getattr(v, "timing", None) if isinstance(v, ServingResult) \
+            else None
+        if t and t.get("enqueue_ts_ms") and t.get("done_ts_ms"):
+            served_ms.append(t["done_ts_ms"] - t["enqueue_ts_ms"])
+    arr = np.asarray(served_ms if served_ms else [0.0])
+    shed_fraction = shed / max(len(got), 1)
+    out = {
+        "soak_duration_s": round(wall, 1),
+        "soak_offered": len(uris),
+        "soak_served": len(got) - shed,
+        "soak_shed": shed,
+        "soak_qps": round((len(got) - shed) / wall, 1),
+        "soak_p50_ms": round(float(np.percentile(arr, 50)), 2),
+        "soak_p99_ms": round(float(np.percentile(arr, 99)), 2),
+        "soak_shed_fraction": round(shed_fraction, 4),
+        "soak_alerts_fired": total_alerts,
+        "soak_slo": {name: {k: s[k] for k in
+                            ("burn_fast", "burn_slow",
+                             "budget_remaining", "alerting",
+                             "alerts_fired")}
+                     for name, s in slo_status.items()},
+    }
+    _gate("soak_sustained_60s", wall >= 60.0,
+          f"soak ran {wall:.1f}s (need >= 60)")
+    _gate("soak_p99_within_bound", out["soak_p99_ms"] <= p99_bound_ms,
+          f"p99={out['soak_p99_ms']}ms > bound {p99_bound_ms}ms")
+    _gate("soak_shed_fraction_within_bound", shed_fraction <= shed_bound,
+          f"shed_fraction={shed_fraction:.4f} > bound {shed_bound}")
+    _gate("soak_zero_false_alerts", total_alerts == 0,
+          f"{total_alerts} burn-rate alert(s) fired at steady state")
+    return out
+
+
+def bench_telemetry_overhead(n_records=1200, batch_size=8, stub_ms=6.0,
+                             reps=3, max_overhead=0.03):
+    """Telemetry-overhead leg: the identical saturating burst through
+    the pipelined server with the telemetry spine disabled vs enabled
+    (spans + counters + flight-recorder ring, no trace file), ``reps``
+    interleaved repetitions each, medians compared.  The spine's
+    contract is that observability is effectively free on the serve
+    path: ``telemetry_overhead_fraction <= 3%`` is a hard gate.
+    ``stub_ms`` models a realistic accelerator step (multi-ms per
+    batch); per-record host cost is judged against that serve path.
+    """
+    from analytics_zoo_tpu.serving import (ClusterServing,
+                                           ClusterServingHelper,
+                                           InProcessStreamQueue,
+                                           InputQueue, OutputQueue)
+    from analytics_zoo_tpu.utils import telemetry
+
+    x = np.full((3, 8, 8), 7, np.float32)
+
+    def _run():
+        helper = ClusterServingHelper(config={
+            "model": {"stub_ms_per_batch": stub_ms},
+            "data": {"image_shape": "3, 8, 8"},
+            "params": {"batch_size": batch_size, "top_n": 0,
+                       "decode_workers": 2, "pipelined": True}})
+        backend = InProcessStreamQueue()
+        serving = ClusterServing(helper=helper, backend=backend)
+        in_q = InputQueue(backend=backend)
+        uris = [f"t-{i}" for i in range(n_records)]
+        serving.start()
+        t0 = time.perf_counter()
+        for uri in uris:
+            in_q.enqueue(uri, input=x)
+        got = OutputQueue(backend=backend).wait_all(
+            uris, timeout=120, max_poll=0.02)
+        wall = time.perf_counter() - t0
+        serving.stop()
+        if len(got) != n_records:
+            raise RuntimeError(f"only {len(got)}/{n_records} served")
+        return wall
+
+    was_enabled = telemetry.enabled()
+    walls = {False: [], True: []}
+    try:
+        # one unmeasured warm pass absorbs first-call compile/alloc cost
+        telemetry.configure(enabled=False)
+        _run()
+        for _ in range(reps):           # interleaved: noise hits both arms
+            for on in (False, True):
+                telemetry.configure(enabled=on)
+                walls[on].append(_run())
+    finally:
+        telemetry.configure(enabled=was_enabled)
+    off = float(np.median(walls[False]))
+    on = float(np.median(walls[True]))
+    frac = (on - off) / off
+    out = {
+        "telemetry_off_wall_s": round(off, 4),
+        "telemetry_on_wall_s": round(on, 4),
+        "telemetry_off_rec_per_s": round(n_records / off, 1),
+        "telemetry_on_rec_per_s": round(n_records / on, 1),
+        "telemetry_overhead_fraction": round(frac, 4),
+    }
+    _gate("telemetry_overhead_le_3pct", frac <= max_overhead,
+          f"overhead_fraction={frac:.4f} > {max_overhead}")
+    return out
+
+
 def bench_infeed(n_images=480, batch_size=32):
     """Image input-pipeline leg (SURVEY §7 hard-part (c)) — CPU-provable.
 
@@ -2209,6 +2383,38 @@ def main():
             _gate("generation_measured", False,
                   RESULT["generation_error"])
         _stamp_leg_artifacts("generation")
+        emit()
+
+    # SLO soak leg: >= 60s sustained target-qps through the pipelined
+    # server with burn-rate objectives armed — p99/shed-fraction bounds
+    # must hold and zero false alerts may fire
+    # (docs/observability.md#slo). Host-side, CPU-provable.
+    if time.time() - T_START < TOTAL_BUDGET_S * 0.9:
+        try:
+            RESULT.update(bench_soak())
+        except Exception as e:  # noqa: BLE001
+            import traceback
+            traceback.print_exc()
+            RESULT["soak_error"] = (str(e).splitlines()[0][:500]
+                                    if str(e) else repr(e)[:500])
+            _gate("soak_measured", False, RESULT["soak_error"])
+        _stamp_leg_artifacts("soak")
+        emit()
+
+    # Telemetry-overhead leg: identical burst with the spine off vs on,
+    # interleaved medians — observability must cost <= 3% of serve-path
+    # wall time (docs/observability.md). Host-side, CPU-provable.
+    if time.time() - T_START < TOTAL_BUDGET_S * 0.9:
+        try:
+            RESULT.update(bench_telemetry_overhead())
+        except Exception as e:  # noqa: BLE001
+            import traceback
+            traceback.print_exc()
+            RESULT["telemetry_overhead_error"] = (
+                str(e).splitlines()[0][:500] if str(e) else repr(e)[:500])
+            _gate("telemetry_overhead_measured", False,
+                  RESULT["telemetry_overhead_error"])
+        _stamp_leg_artifacts("telemetry_overhead")
         emit()
 
     # Input-pipeline leg — platform-independent (decode is host-side work
