@@ -7,19 +7,21 @@ Parity: ``zoo/.../serving/ClusterServing.scala`` + client
 admission control (docs/serving-fleet.md).
 """
 
-from .admission import (AdaptiveBatcher, AdmissionController, SHED_DEADLINE,
-                        SHED_EXPIRED)
+from .admission import (AdaptiveBatcher, AdmissionController,
+                        BacklogAutoscaler, SHED_DEADLINE, SHED_EXPIRED)
 from .client import (API, GenerationResult, InputQueue, OutputQueue,
                      ServingError, ServingRejected, ServingResult,
                      ServingTimeout)
 from .cluster_serving import (ClusterServing, ClusterServingHelper,
                               EchoStubModel, RecordMeta, pick_bucket,
                               power_of_two_buckets)
-from .fleet import ServingFleet, fleet_status
+from .fleet import ServingFleet, fleet_status, read_autoscale_trace
 from .generation import (ContinuousBatchScheduler, GenRequest,
                          StubDecodeEngine, TransformerDecodeEngine)
-from .queue_backend import (FileStreamQueue, InProcessStreamQueue,
-                            StreamQueue, get_queue_backend)
+from .queue_backend import (DeliveryLedger, FileStreamQueue,
+                            InProcessStreamQueue, StreamQueue,
+                            get_queue_backend)
+from .socket_queue import SocketStreamQueue, StreamQueueBroker
 from .registry import (CanaryState, DeployError, ModelRegistry,
                        ModelVersion, RegistryControlServer, RegistryError,
                        UnknownModelError, control_request)
@@ -34,7 +36,9 @@ __all__ = ["InputQueue", "OutputQueue", "API", "ServingError",
            "ModelVersion", "CanaryState", "RegistryError",
            "UnknownModelError", "DeployError", "RegistryControlServer",
            "control_request", "RoutedClusterServing",
-           "AdmissionController", "AdaptiveBatcher", "SHED_DEADLINE",
-           "SHED_EXPIRED", "ServingFleet", "fleet_status",
+           "AdmissionController", "AdaptiveBatcher", "BacklogAutoscaler",
+           "SHED_DEADLINE", "SHED_EXPIRED", "ServingFleet", "fleet_status",
+           "read_autoscale_trace", "DeliveryLedger", "SocketStreamQueue",
+           "StreamQueueBroker",
            "GenerationResult", "ContinuousBatchScheduler", "GenRequest",
            "StubDecodeEngine", "TransformerDecodeEngine"]

@@ -29,6 +29,7 @@ All decisions are O(1) per record — this sits on the intake hot path.
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 from typing import Optional, Sequence, Tuple
@@ -173,6 +174,90 @@ class AdmissionController:
                     "est_batch_ms": round(self.batch_ms, 3),
                     "est_token_ms": round(self.token_ms, 3),
                     "safety_ms": self.safety_ms}
+
+
+class BacklogAutoscaler:
+    """Backlog-driven worker-count policy for the serving fleet.
+
+    Pure decision logic (no process management — ServingFleet owns
+    that): the supervisor feeds it the shared stream's backlog plus the
+    workers' EWMA service estimates and the current worker count; it
+    answers with the desired count and a reason string for the
+    autoscale trace (docs/serving-network.md#autoscaling).
+
+    - **scale up** when the predicted wait for a record arriving now —
+      backlog drained across the current workers plus one batch —
+      exceeds ``scale_up_fraction`` of ``target_ms`` (the deadline-slack
+      budget scaling defends).  The jump is sized to bring the wait
+      back under the threshold in one step rather than one worker per
+      poll.
+    - **scale down** one worker at a time after ``idle_s`` of
+      sustained-empty backlog (a momentary gap between bursts must not
+      flap the fleet).
+    - ``cooldown_s`` separates consecutive actions so a decision is
+      judged on post-change evidence, not on the backlog it inherited.
+
+    Until the first batch has been observed ``record_ms`` is 0 and the
+    predicted wait is just ``batch_ms`` — the policy never grows the
+    fleet on a guess it has no data for.
+    """
+
+    def __init__(self, min_workers: int, max_workers: int,
+                 target_ms: float = 250.0,
+                 scale_up_fraction: float = 0.5,
+                 idle_s: float = 3.0, cooldown_s: float = 2.0):
+        if min_workers < 1 or max_workers < min_workers:
+            raise ValueError(
+                f"need 1 <= min_workers <= max_workers, got "
+                f"{min_workers}..{max_workers}")
+        self.min_workers = int(min_workers)
+        self.max_workers = int(max_workers)
+        self.target_ms = float(target_ms)
+        self.scale_up_fraction = float(scale_up_fraction)
+        self.idle_s = float(idle_s)
+        self.cooldown_s = float(cooldown_s)
+        self._idle_since: Optional[float] = None
+        self._last_change: float = -1e12
+
+    def predicted_wait_ms(self, backlog: int, record_ms: float,
+                          batch_ms: float, workers: int) -> float:
+        """Expected finish time for a record arriving now, with the
+        backlog drained in parallel across ``workers``."""
+        return (max(int(backlog), 0) * max(record_ms, 0.0)
+                / max(int(workers), 1) + max(batch_ms, 0.0))
+
+    def desired(self, backlog: int, record_ms: float, batch_ms: float,
+                workers: int, now: Optional[float] = None
+                ) -> Tuple[int, Optional[str]]:
+        """(desired_workers, reason) — reason is None when no change."""
+        now = time.time() if now is None else now
+        workers = max(int(workers), 1)
+        wait = self.predicted_wait_ms(backlog, record_ms, batch_ms,
+                                      workers)
+        threshold = self.scale_up_fraction * self.target_ms
+        if backlog > 0:
+            self._idle_since = None
+        elif self._idle_since is None:
+            self._idle_since = now
+        if now - self._last_change < self.cooldown_s:
+            return workers, None
+        if wait > threshold and workers < self.max_workers:
+            # size the jump: workers needed so the drain term fits the
+            # slack left after one batch (>= +1, <= max)
+            slack = max(threshold - batch_ms, 1.0)
+            need = math.ceil(backlog * record_ms / slack) \
+                if record_ms > 0 else workers + 1
+            target = min(self.max_workers, max(workers + 1, need))
+            self._last_change = now
+            self._idle_since = None
+            return target, (f"predicted wait {wait:.0f}ms > "
+                            f"{threshold:.0f}ms at backlog {backlog}")
+        if (workers > self.min_workers and self._idle_since is not None
+                and now - self._idle_since >= self.idle_s):
+            self._last_change = now
+            return workers - 1, (f"idle {now - self._idle_since:.1f}s "
+                                 f">= {self.idle_s:.1f}s")
+        return workers, None
 
 
 class AdaptiveBatcher:

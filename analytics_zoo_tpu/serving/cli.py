@@ -17,6 +17,8 @@ Usage::
     python -m analytics_zoo_tpu.serving.cli start  [--dir DIR] [--foreground]
                                                    [--warmup]
     python -m analytics_zoo_tpu.serving.cli fleet  [--dir DIR] [--workers N]
+                                                   [--transport socket://H:P]
+    python -m analytics_zoo_tpu.serving.cli broker [--transport socket://H:P]
     python -m analytics_zoo_tpu.serving.cli status [--dir DIR] [--watch SEC]
     python -m analytics_zoo_tpu.serving.cli top    [--dir DIR]
                                                    [--interval SEC]
@@ -66,7 +68,9 @@ model:
   path: /opt/work/model
 
 data:
-  # transport: "file:<dir>" | "<redis-host>:<port>" | empty for in-process
+  # transport: "file:<dir>" | "socket://<host>:<port>" (network broker,
+  # docs/serving-network.md) | "<redis-host>:<port>" | empty in-process;
+  # `--transport` on the CLI overrides this without editing the file
   src: file:/tmp/zoo-serving-stream
   # C, H, W of the decoded image tensor
   image_shape: 3, 224, 224
@@ -88,6 +92,15 @@ params:
   # default_deadline_ms: 250 # deadline for records that carry none
   # admission_safety_ms: 2.0 # slop subtracted from every slack estimate
   # linger_ms: 0             # max wait to round batches up to a bucket
+  ## backlog-driven autoscaling (docs/serving-network.md#autoscaling);
+  ## active when min_workers < max_workers:
+  # min_workers: 1           # floor the fleet shrinks to when idle
+  # max_workers: 4           # ceiling the fleet grows to under burst
+  # autoscale_target_ms: 250 # wait budget scaling defends (default:
+  #                          # default_deadline_ms)
+  # scale_up_fraction: 0.5   # grow when predicted wait > fraction*target
+  # scale_down_idle_s: 3.0   # sustained-empty backlog before shrinking
+  # autoscale_interval: 0.5  # supervisor decision period, seconds
 
 ## generative serving (docs/serving-generate.md): uncomment to serve a
 ## `generate` endpoint with KV-cache decode + continuous batching
@@ -272,25 +285,72 @@ def cmd_start(workdir: str, foreground: bool = False,
     os._exit(0)
 
 
+def _maybe_local_broker(src):
+    """When ``data.src`` is socket:// and its port is free locally,
+    start the broker in this process (single-host convenience); a bound
+    port means an external broker owns the address — use it."""
+    if not (src or "").startswith("socket://"):
+        return None
+    from .socket_queue import StreamQueueBroker, parse_socket_spec
+
+    host, port = parse_socket_spec(src)
+    bind = "0.0.0.0" if host not in ("localhost", "127.0.0.1") else host
+    try:
+        broker = StreamQueueBroker(host=bind, port=port).start()
+    except OSError:
+        return None    # address in use: external broker
+    print(f"broker: serving {src} in-process", flush=True)
+    return broker
+
+
+def cmd_broker(src: str) -> int:
+    """Run a standalone stream broker in the foreground
+    (docs/serving-network.md) — the front door fleet workers and
+    clients on other hosts connect to."""
+    from .socket_queue import StreamQueueBroker, parse_socket_spec
+
+    host, port = parse_socket_spec(src or "socket://0.0.0.0:6380")
+    broker = StreamQueueBroker(host=host, port=port)
+    print(f"broker: serving on {broker.address}; Ctrl-C to stop",
+          flush=True)
+    signal.signal(signal.SIGTERM, lambda _s, _f: broker.shutdown())
+    try:
+        broker.run_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        broker.shutdown()
+    return 0
+
+
 def cmd_fleet(workdir: str, workers=None) -> int:
     """Run a supervised multi-worker serving fleet in the foreground
     (docs/serving-fleet.md): N worker processes over the shared
-    transport, heartbeat-watched, dead workers restarted."""
+    transport, heartbeat-watched, dead workers restarted — and, with
+    min_workers < max_workers, autoscaled against the stream backlog
+    (docs/serving-network.md#autoscaling)."""
     cfg, _, _ = _paths(workdir)
     if not os.path.exists(cfg):
         print(f"no {cfg}; run `cluster-serving-init` first",
               file=sys.stderr)
         return 1
+    from .cluster_serving import ClusterServingHelper
     from .fleet import ServingFleet
 
+    broker = _maybe_local_broker(ClusterServingHelper(config_path=cfg).src)
     fleet = ServingFleet(cfg, workdir, workers=workers).start()
-    print(f"fleet: supervising {fleet.workers} worker(s); Ctrl-C to stop",
-          flush=True)
+    band = (f" (autoscale {fleet.min_workers}..{fleet.max_workers})"
+            if fleet.autoscaler else "")
+    print(f"fleet: supervising {fleet.workers} worker(s){band}; "
+          f"Ctrl-C to stop", flush=True)
     signal.signal(signal.SIGTERM, lambda _s, _f: fleet.stop())
     try:
         fleet.supervise()
     except KeyboardInterrupt:
         fleet.shutdown()
+    finally:
+        if broker is not None:
+            broker.shutdown()
     return 0
 
 
@@ -383,6 +443,56 @@ def _print_fleet_metrics(workdir: str):
         print(f"    {m['name']}{lbl} = {m['value']:g}")
 
 
+def _effective_src(workdir: str):
+    return os.environ.get("ZOO_SERVING_TRANSPORT") or \
+        (_load_config(workdir).get("data") or {}).get("src")
+
+
+def _print_transport(workdir: str):
+    """Socket-transport row (docs/serving-network.md): one stats op
+    against the broker — connections, claims outstanding, redeliveries,
+    stream depth.  Non-socket transports print nothing; an unreachable
+    broker prints that instead of hiding the outage."""
+    src = _effective_src(workdir)
+    if not (src or "").startswith("socket://"):
+        return
+    from .socket_queue import SocketStreamQueue, parse_socket_spec
+
+    host, port = parse_socket_spec(src)
+    q = SocketStreamQueue(host, port, connect_timeout=2.0)
+    try:
+        st = q.stats()
+    except (OSError, RuntimeError) as e:
+        print(f"  transport {src}: UNREACHABLE ({e})")
+        return
+    finally:
+        q.close()
+    print(f"  transport {src}: connections={st['connections']} "
+          f"consumers={st['consumers']} stream_len={st['stream_len']} "
+          f"claims_outstanding={st['claims_outstanding']} "
+          f"redelivered={st['redelivered']} "
+          f"results_pending={st['results_pending']}")
+
+
+def _print_autoscale(workdir: str):
+    """Autoscale band + most recent scale events (health/autoscale.json,
+    written by the supervising fleet)."""
+    from .fleet import autoscale_path
+
+    try:
+        with open(autoscale_path(workdir)) as f:
+            state = json.load(f)
+    except (OSError, ValueError):
+        return
+    events = state.get("events", [])
+    print(f"  autoscale: active={state.get('active')} "
+          f"band={state.get('min_workers')}..{state.get('max_workers')} "
+          f"events={len(events)}")
+    for e in events[-3:]:
+        print(f"    {time.strftime('%H:%M:%S', time.localtime(e['ts']))} "
+              f"{e['action']} -> {e['active']} ({e['reason']})")
+
+
 def _print_slo(stats: dict):
     """Per-objective burn-rate/budget lines (present when the config has
     an ``slo:`` section — utils/slo.py)."""
@@ -428,6 +538,8 @@ def _render_status(workdir: str) -> int:
     fleet_rows = _print_fleet(workdir)
     if fleet_rows:
         _print_fleet_metrics(workdir)
+    _print_transport(workdir)
+    _print_autoscale(workdir)
     if pid is None and not fleet_rows:
         print("not running")
         return 3
@@ -769,10 +881,11 @@ def cmd_shutdown(workdir: str) -> int:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="zoo-serving")
-    ap.add_argument("command", choices=["init", "start", "fleet", "status",
-                                        "stop", "restart", "shutdown",
-                                        "deploy", "promote", "undeploy",
-                                        "generate", "trace", "top"])
+    ap.add_argument("command", choices=["init", "start", "fleet", "broker",
+                                        "status", "stop", "restart",
+                                        "shutdown", "deploy", "promote",
+                                        "undeploy", "generate", "trace",
+                                        "top"])
     ap.add_argument("trace_id", nargs="?", default=None,
                     help="trace: the request's trace id (clients print "
                          "it at enqueue)")
@@ -786,6 +899,11 @@ def main(argv=None) -> int:
     ap.add_argument("--workers", default=None, type=int,
                     help="fleet: worker process count (default: config "
                          "params.workers)")
+    ap.add_argument("--transport", default=None, metavar="SRC",
+                    help="override data.src for this invocation — e.g. "
+                         "socket://host:port (the network broker, "
+                         "docs/serving-network.md), file:<dir>, or "
+                         "host:port for redis; fleet workers inherit it")
     ap.add_argument("--foreground", action="store_true",
                     help="start: run in the foreground (containers)")
     ap.add_argument("--warmup", action="store_true",
@@ -834,6 +952,11 @@ def main(argv=None) -> int:
                     help="generate: seconds to wait for the result")
     args = ap.parse_args(argv)
     workdir = os.path.abspath(args.dir)
+    if args.transport:
+        # ClusterServingHelper reads this ahead of data.src; exporting
+        # it (rather than rewriting the yaml) lets daemonized starts
+        # and fleet worker subprocesses inherit the override
+        os.environ["ZOO_SERVING_TRANSPORT"] = args.transport
     if args.trace_dir:
         # exports ZOO_TPU_TELEMETRY / ZOO_TPU_TRACE_DIR so daemonized
         # starts and fleet worker subprocesses inherit the settings
@@ -846,6 +969,8 @@ def main(argv=None) -> int:
                          warmup=args.warmup)
     if args.command == "fleet":
         return cmd_fleet(workdir, workers=args.workers)
+    if args.command == "broker":
+        return cmd_broker(args.transport or _effective_src(workdir))
     if args.command == "status":
         return cmd_status(workdir, watch=args.watch)
     if args.command == "trace":

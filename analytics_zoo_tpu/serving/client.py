@@ -261,15 +261,31 @@ class OutputQueue(API):
         Dead-lettered uris come back as :class:`ServingError` values and
         load-shed uris as :class:`ServingRejected` (structured errors
         instead of a silent timeout); with ``raise_on_error`` the first
-        one raises."""
+        one raises.
+
+        On a transport advertising ``supports_long_poll`` (the socket
+        broker) the wait is server-side — ``wait_any`` blocks until a
+        wanted result lands, popping only *those* uris, so there is no
+        spin-polling and no stealing of other clients' results; every
+        other transport keeps the exponential-backoff poll above."""
         want = set(uris)
         got: Dict[str, np.ndarray] = {}
         budget_s = deadline_ms / 1e3 if deadline_ms is not None else timeout
         deadline = time.time() + budget_s
         interval = poll
+        long_poll = bool(getattr(self.db, "supports_long_poll", False))
         while want and time.time() < deadline:
             progressed = False
-            for uri, v in self.db.all_results(pop=True).items():
+            if long_poll:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    break
+                landed = self.db.wait_any(sorted(want),
+                                          timeout=min(remaining, 5.0),
+                                          pop=True)
+            else:
+                landed = self.db.all_results(pop=True)
+            for uri, v in landed.items():
                 got[uri] = self._decode(v, uri)
                 want.discard(uri)
                 progressed = True
@@ -277,7 +293,7 @@ class OutputQueue(API):
                 for v in got.values():
                     if isinstance(v, ServingError):
                         raise v
-            if want:
+            if want and not long_poll:
                 if progressed:
                     interval = poll
                 else:

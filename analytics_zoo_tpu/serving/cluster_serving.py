@@ -195,7 +195,11 @@ class ClusterServingHelper:
         # model — fleet smoke / bench workers (docs/serving-fleet.md)
         raw_stub = model.get("stub_ms_per_batch")
         self.stub_ms_per_batch = None if raw_stub is None else float(raw_stub)
-        self.src = data.get("src")  # transport spec
+        # transport spec; ZOO_SERVING_TRANSPORT (the CLI's --transport
+        # flag) overrides the config so one yaml serves every wire —
+        # fleet workers inherit the override through their environment
+        self.src = os.environ.get("ZOO_SERVING_TRANSPORT") or \
+            data.get("src")
         shape = data.get("image_shape") or "3, 224, 224"
         if isinstance(shape, str):
             shape = [int(s) for s in shape.split(",")]
@@ -236,6 +240,23 @@ class ClusterServingHelper:
         self.max_restarts = int(params.get("max_restarts") or 10)
         self.restart_backoff_s = float(
             params.get("restart_backoff_s") or 0.5)
+        # backlog-driven autoscaling (serving/admission.BacklogAutoscaler,
+        # docs/serving-network.md#autoscaling): enabled when the
+        # min..max band is wider than a point; the band defaults to the
+        # fixed worker count, i.e. autoscaling off
+        self.min_workers = int(params.get("min_workers") or self.workers)
+        self.max_workers = int(params.get("max_workers") or self.workers)
+        self.autoscale_target_ms = float(
+            params.get("autoscale_target_ms") or
+            (self.default_deadline_ms or 250.0))
+        self.autoscale_interval = float(
+            params.get("autoscale_interval") or 0.5)
+        self.scale_up_fraction = float(
+            params.get("scale_up_fraction") or 0.5)
+        self.scale_down_idle_s = float(
+            params.get("scale_down_idle_s") or 3.0)
+        self.autoscale_cooldown_s = float(
+            params.get("autoscale_cooldown_s") or 2.0)
         # -- telemetry (docs/observability.md): span tracing + per-process
         # metrics.json; the CLI --trace-dir flag overrides trace_dir
         self.telemetry = _parse_bool(params.get("telemetry"), False)

@@ -44,6 +44,7 @@ logger = logging.getLogger("analytics_zoo_tpu.serving.fleet")
 
 HEALTH_DIR = "health"
 SUPERVISOR_FILE = "supervisor.json"
+AUTOSCALE_FILE = "autoscale.json"
 BACKOFF_CAP_S = 30.0
 
 
@@ -63,6 +64,21 @@ def read_supervisor_state(workdir: str) -> Dict[str, dict]:
             return json.load(f)
     except (OSError, ValueError):
         return {}
+
+
+def autoscale_path(workdir: str) -> str:
+    return os.path.join(workdir, HEALTH_DIR, AUTOSCALE_FILE)
+
+
+def read_autoscale_trace(workdir: str) -> List[dict]:
+    """The supervisor's autoscale event trace (scale_up / scale_down
+    rows with backlog, predicted wait, and worker ids) — bench legs and
+    `zoo-serving status` read this."""
+    try:
+        with open(autoscale_path(workdir)) as f:
+            return json.load(f).get("events", [])
+    except (OSError, ValueError):
+        return []
 
 
 def write_health(workdir: str, worker_id: int, payload: dict):
@@ -225,16 +241,46 @@ class ServingFleet:
                  restart_backoff_s: Optional[float] = None,
                  healthy_reset_s: float = 60.0,
                  stream=None, env: Optional[Dict[str, str]] = None,
-                 python: Optional[str] = None):
+                 python: Optional[str] = None,
+                 min_workers: Optional[int] = None,
+                 max_workers: Optional[int] = None,
+                 autoscale_interval: Optional[float] = None):
+        from .admission import BacklogAutoscaler
         from .cluster_serving import ClusterServingHelper
 
         self.config_path = os.path.abspath(config_path)
         self.workdir = os.path.abspath(workdir)
         helper = ClusterServingHelper(config_path=self.config_path)
+        self.helper = helper
         self.workers = int(workers if workers is not None
                            else helper.workers)
         if self.workers < 1:
             raise ValueError(f"need >= 1 worker, got {self.workers}")
+        # backlog-driven autoscaling band (docs/serving-network.md):
+        # active when min < max; the initial worker count is clamped
+        # into the band and then floats with load
+        self.min_workers = int(min_workers if min_workers is not None
+                               else helper.min_workers)
+        self.max_workers = int(max_workers if max_workers is not None
+                               else helper.max_workers)
+        self.max_workers = max(self.max_workers, self.min_workers)
+        self.workers = min(max(self.workers, self.min_workers),
+                           self.max_workers)
+        self.autoscale_interval = float(
+            autoscale_interval if autoscale_interval is not None
+            else helper.autoscale_interval)
+        self.autoscaler = None
+        if self.max_workers > self.min_workers:
+            self.autoscaler = BacklogAutoscaler(
+                self.min_workers, self.max_workers,
+                target_ms=helper.autoscale_target_ms,
+                scale_up_fraction=helper.scale_up_fraction,
+                idle_s=helper.scale_down_idle_s,
+                cooldown_s=helper.autoscale_cooldown_s)
+        self._backlog_q = None       # lazy supervisor-side queue handle
+        self._next_autoscale = 0.0
+        self._draining: Dict[int, float] = {}   # wid -> SIGTERM ts
+        self.autoscale_events: List[dict] = []
         self.health_interval = float(
             health_interval if health_interval is not None
             else helper.health_interval)
@@ -260,6 +306,7 @@ class ServingFleet:
         self._lock = threading.Lock()
         self._procs: Dict[int, SupervisedProc] = {}
         self._spawned_at: Dict[int, float] = {}
+        self._active: set = set(range(self.workers))   # wids desired now
         self.restarts: Dict[int, int] = {}
         self.backoff_until: Dict[int, float] = {}
         self.crash_looped: set = set()
@@ -297,7 +344,8 @@ class ServingFleet:
 
     def start(self) -> "ServingFleet":
         self._stop.clear()
-        for wid in range(self.workers):
+        self._active = set(range(self.workers))
+        for wid in sorted(self._active):
             self._spawn(wid)
         return self
 
@@ -321,9 +369,28 @@ class ServingFleet:
         and a crash-loop cap.  Returns the worker ids respawned."""
         restarted = []
         now = time.time()
+        # reap scaled-down workers: SIGTERM'd workers drain their
+        # pipeline and exit — their death is the *goal*, not a crash
+        for wid, since in list(self._draining.items()):
+            sp = self._procs.get(wid)
+            if sp is None:
+                self._draining.pop(wid, None)
+                continue
+            if sp.proc.poll() is not None:
+                del self._procs[wid]
+                self._draining.pop(wid, None)
+                self._forget_worker(wid)
+                with self._lock:
+                    self.stream.write(
+                        f"[fleet] worker-{wid} drained and stopped "
+                        f"(scale down)\n")
+                    self.stream.flush()
+            elif now - since > max(self.grace_s, 10.0):
+                terminate_all([sp.proc], grace_s=0.0)   # drain overdue
         # phase 2 of a restart: respawn workers whose backoff elapsed
         for wid, until in list(self.backoff_until.items()):
-            if self._stop.is_set() or wid in self._procs:
+            if self._stop.is_set() or wid in self._procs or \
+                    wid not in self._active:
                 continue
             if now >= until:
                 del self.backoff_until[wid]
@@ -332,6 +399,8 @@ class ServingFleet:
         if restarted:
             self._write_supervisor_state()
         for wid, sp in list(self._procs.items()):
+            if wid in self._draining:
+                continue
             rc = sp.proc.poll()
             stale = False
             if rc is None:
@@ -385,11 +454,144 @@ class ServingFleet:
             self._write_supervisor_state()
         return restarted
 
+    # -- backlog-driven autoscaling (docs/serving-network.md) -----------
+    def _forget_worker(self, wid: int):
+        """Scale-down bookkeeping: drop every trace of a retired worker
+        so status/supervisor state don't show ghost rows."""
+        self.restarts.pop(wid, None)
+        self.backoff_until.pop(wid, None)
+        self.crash_looped.discard(wid)
+        self.flight_dumps.pop(wid, None)
+        try:
+            os.remove(health_path(self.workdir, wid))
+        except OSError:
+            pass
+        self._write_supervisor_state()
+
+    def _queue_backlog(self) -> Optional[int]:
+        """stream_len() through a supervisor-side handle on the shared
+        transport; None when the transport is unreadable from here
+        (inproc/redis src, or the broker is down this tick)."""
+        if self._backlog_q is None:
+            src = self.helper.src or ""
+            if not (src.startswith("file:") or src.startswith("socket://")):
+                return None
+            from .queue_backend import get_queue_backend
+
+            self._backlog_q = get_queue_backend(src)
+        try:
+            return int(self._backlog_q.stream_len())
+        except Exception:  # noqa: BLE001 - broker briefly unreachable
+            return None
+
+    def _ewma_estimates(self) -> tuple:
+        """(record_ms, batch_ms): mean of the positive EWMA service
+        estimates the workers publish in their heartbeats."""
+        rec, bat = [], []
+        for wid in list(self._active):
+            adm = (read_health(self.workdir, wid) or {}).get(
+                "admission") or {}
+            r = float(adm.get("est_record_ms") or 0.0)
+            b = float(adm.get("est_batch_ms") or 0.0)
+            if r > 0:
+                rec.append(r)
+            if b > 0:
+                bat.append(b)
+        return (sum(rec) / len(rec) if rec else 0.0,
+                sum(bat) / len(bat) if bat else 0.0)
+
+    def _note_autoscale(self, action: str, wids: List[int], reason: str,
+                        backlog: int, wait_ms: float):
+        event = {"ts": time.time(), "action": action, "workers": wids,
+                 "active": len(self._active), "backlog": backlog,
+                 "predicted_wait_ms": round(wait_ms, 1), "reason": reason}
+        self.autoscale_events.append(event)
+        telemetry.event(f"fleet/{action}", **{k: v for k, v in
+                                              event.items() if k != "ts"})
+        telemetry.gauge("zoo_fleet_workers").set(len(self._active))
+        file_io.write_bytes_atomic(
+            autoscale_path(self.workdir),
+            json.dumps({"min_workers": self.min_workers,
+                        "max_workers": self.max_workers,
+                        "active": len(self._active),
+                        "events": self.autoscale_events}).encode())
+        with self._lock:
+            self.stream.write(
+                f"[fleet] {action} -> {len(self._active)} workers "
+                f"({'+' if action == 'scale_up' else '-'}"
+                f"{wids}): {reason}\n")
+            self.stream.flush()
+
+    def autoscale_once(self, now: Optional[float] = None) -> bool:
+        """One autoscale decision tick (no-op unless min < max): poll
+        the shared stream's backlog + the workers' EWMA estimates, and
+        grow/shrink toward the policy's desired count.  Scale-down is
+        drain-before-kill: the retiring worker gets SIGTERM, finishes
+        its in-flight records, and only then is reaped (poll_once).
+        Returns True when the fleet changed size."""
+        if self.autoscaler is None or self._stop.is_set():
+            return False
+        now = time.time() if now is None else now
+        if now < self._next_autoscale:
+            return False
+        self._next_autoscale = now + self.autoscale_interval
+        backlog = self._queue_backlog()
+        if backlog is None:
+            return False
+        record_ms, batch_ms = self._ewma_estimates()
+        current = len(self._active)
+        desired, reason = self.autoscaler.desired(
+            backlog, record_ms, batch_ms, current, now)
+        if reason is None or desired == current:
+            return False
+        wait_ms = self.autoscaler.predicted_wait_ms(
+            backlog, record_ms, batch_ms, current)
+        if desired > current:
+            added = []
+            for wid in range(self.max_workers):
+                if len(self._active) >= desired:
+                    break
+                if wid in self._active or wid in self._draining:
+                    continue
+                self._active.add(wid)
+                self.restarts.pop(wid, None)
+                self.backoff_until.pop(wid, None)
+                self.crash_looped.discard(wid)
+                self._spawn(wid)
+                added.append(wid)
+            if added:
+                self._note_autoscale("scale_up", added, reason,
+                                     backlog, wait_ms)
+            return bool(added)
+        removed = []
+        for wid in sorted(self._active, reverse=True):
+            if len(self._active) <= desired:
+                break
+            self._active.discard(wid)
+            sp = self._procs.get(wid)
+            if sp is not None and sp.proc.poll() is None:
+                self._draining[wid] = now
+                try:
+                    sp.proc.terminate()   # SIGTERM: drain, then exit
+                except OSError:
+                    pass
+            else:
+                # dead / in backoff: nothing in flight to drain
+                if sp is not None:
+                    self._procs.pop(wid, None)
+                self._forget_worker(wid)
+            removed.append(wid)
+        if removed:
+            self._note_autoscale("scale_down", removed, reason,
+                                 backlog, wait_ms)
+        return bool(removed)
+
     def supervise(self, poll_s: float = 0.25):
         """Block supervising until :meth:`stop` (or KeyboardInterrupt)."""
         try:
             while not self._stop.is_set():
                 self.poll_once()
+                self.autoscale_once()
                 if self._stop.wait(poll_s):
                     break
         finally:
@@ -401,7 +603,7 @@ class ServingFleet:
         deadline = time.time() + timeout
         while time.time() < deadline:
             if all(read_health(self.workdir, w) is not None
-                   for w in range(self.workers)):
+                   for w in sorted(self._active)):
                 return True
             time.sleep(0.05)
         return False
@@ -429,7 +631,7 @@ class ServingFleet:
         """Per-worker pipeline_stats() snapshots (from each worker's
         stats-worker-N.json dump); missing/unreadable files are skipped."""
         out = []
-        for wid in range(self.workers):
+        for wid in range(max(self.workers, self.max_workers)):
             path = os.path.join(self.workdir, f"stats-worker-{wid}.json")
             try:
                 with open(path) as f:

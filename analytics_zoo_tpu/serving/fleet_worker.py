@@ -106,6 +106,10 @@ def _heartbeat(serving, workdir: str, worker_id: int,
             "records_served": served,
             "shed": shed,
             "restarts": restarts,
+            # EWMA service estimates ride the heartbeat so the
+            # supervisor's backlog autoscaler can predict queue wait
+            # without RPC into the worker (docs/serving-network.md)
+            "admission": serving.admission.stats(),
         }
         dump = getattr(serving, "_flight_dump_path", None)
         if dump:

@@ -10,7 +10,11 @@ transport-agnostic:
 - :class:`FileStreamQueue` — directory-backed, multi-process on one host
   (each record one msgpack file, atomic rename), no external service;
 - :class:`RedisStreamQueue` — the reference transport, used when the
-  ``redis`` client package is importable and a server address is given.
+  ``redis`` client package is importable and a server address is given;
+- :class:`~analytics_zoo_tpu.serving.socket_queue.SocketStreamQueue` —
+  the stdlib network transport (``socket://host:port``): a TCP broker
+  with server-side claims, redelivery, and result long-poll
+  (docs/serving-network.md).
 
 All three implement XADD-like ``enqueue``, XREAD-like ``read_batch``, a
 results hash (``put_result``/``get_result``), and the memory-watermark trim
@@ -99,6 +103,66 @@ class StreamQueue:
         return items
 
 
+class DeliveryLedger:
+    """Bounded consumer-side delivery ledger (shared by the file and
+    socket transports): duplicate-redelivery detection over a sliding
+    rid window plus per-producer sequence-gap accounting.
+
+    Both memories are **bounded**: delivered rids beyond ``window`` are
+    evicted oldest-first (duplicate counters stay exact within the
+    window — older redeliveries are indistinguishable from fresh rids,
+    the documented trade), and the per-producer last-seen-seq map is an
+    LRU capped at ``producer_cap`` so a long-lived consumer fed by an
+    endless churn of short-lived producers (every client restart mints a
+    new producer id) cannot leak — the slow growth the PR 13 soak leg
+    exposed."""
+
+    def __init__(self, window: int = 65536, producer_cap: int = 4096):
+        self.window = int(window)
+        self.producer_cap = int(producer_cap)
+        self._delivered: set = set()
+        self._ring: deque = deque()
+        self._producer_seq: "OrderedDict[str, int]" = OrderedDict()
+        self.duplicates = 0
+        self.seq_gaps = 0
+
+    def note(self, rid: str) -> bool:
+        """Record one delivery; False when ``rid`` was already served
+        within the window (duplicate redelivery — skip it)."""
+        if rid in self._delivered:
+            self.duplicates += 1
+            return False
+        self._delivered.add(rid)
+        self._ring.append(rid)
+        while len(self._ring) > self.window:
+            self._delivered.discard(self._ring.popleft())
+        # per-producer sequence continuity (advisory: a gap means a
+        # record this consumer never saw — lost, trimmed, or claimed by
+        # another fleet worker; per-worker gaps are expected in a fleet,
+        # a gap with ONE consumer means loss)
+        parts = rid.rsplit("-", 2)
+        if len(parts) == 3:
+            try:
+                seq = int(parts[2])
+            except ValueError:
+                return True
+            producer = parts[1]
+            last = self._producer_seq.get(producer)
+            if last is not None and seq > last + 1:
+                self.seq_gaps += seq - last - 1
+            if last is None or seq > last:
+                self._producer_seq[producer] = seq
+            self._producer_seq.move_to_end(producer)
+            while len(self._producer_seq) > self.producer_cap:
+                self._producer_seq.popitem(last=False)
+        return True
+
+    def stats(self) -> dict:
+        return {"duplicates": self.duplicates,
+                "seq_gaps": self.seq_gaps,
+                "producers_seen": len(self._producer_seq)}
+
+
 class InProcessStreamQueue(StreamQueue):
     def __init__(self, name: str = "image_stream"):
         self.name = name
@@ -162,9 +226,13 @@ class FileStreamQueue(StreamQueue):
 
     #: delivered-rid memory per consumer (duplicate detection window)
     DELIVERED_WINDOW = 65536
+    #: LRU cap on the per-producer last-seen-seq map (DeliveryLedger)
+    PRODUCER_CAP = 4096
 
     def __init__(self, root: str, name: str = "image_stream",
-                 orphan_tmp_age: float = 60.0):
+                 orphan_tmp_age: float = 60.0,
+                 delivered_window: Optional[int] = None,
+                 producer_cap: Optional[int] = None):
         self.root = root
         self.stream_dir = os.path.join(root, name)
         self.results_dir = os.path.join(root, "results")
@@ -178,14 +246,13 @@ class FileStreamQueue(StreamQueue):
         self._producer = uuid.uuid4().hex[:8]
         self.orphan_tmp_age = orphan_tmp_age
         self._last_gc = 0.0
-        # consumer-side delivery ledger: rids served by THIS instance
-        # (bounded ring), per-producer last-seen seq, and the counters
-        # consumer_stats() reports
-        self._delivered: set = set()
-        self._delivered_ring: deque = deque()
-        self._producer_seq: Dict[str, int] = {}
-        self._duplicates = 0
-        self._seq_gaps = 0
+        # consumer-side delivery ledger: bounded rid window + LRU-capped
+        # per-producer seq map + the counters consumer_stats() reports
+        self._ledger = DeliveryLedger(
+            window=(self.DELIVERED_WINDOW if delivered_window is None
+                    else int(delivered_window)),
+            producer_cap=(self.PRODUCER_CAP if producer_cap is None
+                          else int(producer_cap)))
 
     def enqueue(self, record):
         rid = (f"{time.time_ns():020d}-{self._producer}"
@@ -228,35 +295,11 @@ class FileStreamQueue(StreamQueue):
         """Record one delivery; False when ``rid`` was already served by
         this consumer (duplicate redelivery — e.g. an operator restoring
         ``.claimed`` orphans a second time) and must be skipped."""
-        if rid in self._delivered:
-            self._duplicates += 1
-            return False
-        self._delivered.add(rid)
-        self._delivered_ring.append(rid)
-        while len(self._delivered_ring) > self.DELIVERED_WINDOW:
-            self._delivered.discard(self._delivered_ring.popleft())
-        # per-producer sequence continuity (advisory: a gap means a
-        # record this consumer never saw — lost, trimmed, or claimed by
-        # another fleet worker; per-worker gaps are expected in a fleet,
-        # a gap with ONE consumer means loss)
-        parts = rid.rsplit("-", 2)
-        if len(parts) == 3:
-            try:
-                seq = int(parts[2])
-            except ValueError:
-                return True
-            last = self._producer_seq.get(parts[1])
-            if last is not None and seq > last + 1:
-                self._seq_gaps += seq - last - 1
-            if last is None or seq > last:
-                self._producer_seq[parts[1]] = seq
-        return True
+        return self._ledger.note(rid)
 
     def consumer_stats(self) -> dict:
         """Delivery-integrity counters for THIS consumer instance."""
-        return {"duplicates": self._duplicates,
-                "seq_gaps": self._seq_gaps,
-                "producers_seen": len(self._producer_seq)}
+        return self._ledger.stats()
 
     def read_batch(self, max_items, timeout=1.0):
         self._gc_orphans()
@@ -376,12 +419,25 @@ class RedisStreamQueue(StreamQueue):  # pragma: no cover - needs a server
         return v
 
     def all_results(self, pop=True):
+        # one pipelined round trip for the reads (and one for the
+        # deletes) instead of 2N — the result-poll path is the client
+        # hot loop, N round trips per poll is what wait_all pays
+        keys = self.r.keys("result:*")
+        if not keys:
+            return {}
+        pipe = self.r.pipeline()
+        for key in keys:
+            pipe.hget(key, "value")
+        values = pipe.execute()
         out = {}
-        for key in self.r.keys("result:*"):
-            uri = key.decode()[len("result:"):]
-            v = self.get_result(uri, pop=pop)
-            if v is not None:
-                out[uri] = v
+        hit = []
+        for key, v in zip(keys, values):
+            if v is None:
+                continue
+            out[key.decode()[len("result:"):]] = v
+            hit.append(key)
+        if pop and hit:
+            self.r.delete(*hit)
         return out
 
     def stream_len(self):
@@ -394,7 +450,8 @@ class RedisStreamQueue(StreamQueue):  # pragma: no cover - needs a server
 def get_queue_backend(spec: Optional[str] = None) -> StreamQueue:
     """``None``/'inproc' -> InProcessStreamQueue (also registered as the
     process-wide default so clients and server share it); 'file:<dir>' ->
-    FileStreamQueue; 'host:port' -> RedisStreamQueue."""
+    FileStreamQueue; 'socket://host:port' -> SocketStreamQueue (network
+    broker, serving/socket_queue.py); 'host:port' -> RedisStreamQueue."""
     global _DEFAULT_INPROC
     if spec is None or spec == "inproc":
         if _DEFAULT_INPROC is None:
@@ -402,6 +459,11 @@ def get_queue_backend(spec: Optional[str] = None) -> StreamQueue:
         return _DEFAULT_INPROC
     if spec.startswith("file:"):
         return FileStreamQueue(spec[len("file:"):])
+    if spec.startswith("socket://"):
+        from .socket_queue import SocketStreamQueue, parse_socket_spec
+
+        host, port = parse_socket_spec(spec)
+        return SocketStreamQueue(host, port)
     host, _, port = spec.partition(":")
     return RedisStreamQueue(host, int(port or 6379))
 

@@ -1482,6 +1482,223 @@ def bench_serving_fleet(n_records=320, stub_ms=16.0):
     return out
 
 
+def bench_network_serving(n_records=400, batch_size=8, stub_ms=0.5):
+    """Network-transport leg (docs/serving-network.md): the identical
+    record burst through the pipelined server over the file queue
+    backend vs the socket broker, echo stub model.  The stub is fast
+    (~0.5ms/batch) so *transport* cost dominates: per-record fsync'd
+    files + client poll backoff on one side, length-prefixed frames +
+    server-side blocking reads and result long-poll on the other.
+
+    Two traffic shapes per transport:
+
+    - **burst** (open loop) — all records enqueued up front; reports
+      drain throughput and the server-side enqueue->committed p50/p99,
+      and carries the decomposition gate (every served row must have
+      transport_in/queue/device components on both transports);
+    - **request-response** (closed loop) — one request in flight at a
+      time, the serving shape deadlines actually live in.  Here the
+      transport's round trip IS the throughput, and the acceptance
+      gate applies: socket >= 3x file served-records/s at
+      equal-or-better p99.
+
+    A final phase drives a min=1/max=3 autoscaling socket fleet
+    through a slow-stub burst and records the scale_up-to-max /
+    idle->min trace (zero lost records, zero errors) as a bench
+    artifact.
+    """
+    import io as _io
+    import shutil as _shutil
+    import tempfile as _tempfile
+    import threading
+
+    from analytics_zoo_tpu.serving import (ClusterServing,
+                                           ClusterServingHelper,
+                                           InputQueue, OutputQueue,
+                                           ServingFleet, ServingResult,
+                                           SocketStreamQueue,
+                                           StreamQueueBroker)
+    from analytics_zoo_tpu.serving.fleet import read_autoscale_trace
+    from analytics_zoo_tpu.serving.queue_backend import FileStreamQueue
+
+    out = {}
+    x = np.full((3, 8, 8), 7, np.float32)
+
+    def _serving(mk):
+        helper = ClusterServingHelper(config={
+            "model": {"stub_ms_per_batch": stub_ms},
+            "data": {"image_shape": "3, 8, 8"},
+            "params": {"batch_size": batch_size, "top_n": 0,
+                       "decode_workers": 2, "pipelined": True,
+                       "linger_ms": 2.0}})
+        return ClusterServing(helper=helper, backend=mk())
+
+    def _transport(transport, fn):
+        tmp = _tempfile.mkdtemp(prefix=f"zoo_bench_net_{transport}_")
+        broker = None
+        try:
+            if transport == "file":
+                stream = os.path.join(tmp, "stream")
+                mk = lambda: FileStreamQueue(stream)  # noqa: E731
+            else:
+                broker = StreamQueueBroker().start()
+                mk = lambda: SocketStreamQueue(  # noqa: E731
+                    "127.0.0.1", broker.port)
+            return fn(mk)
+        finally:
+            if broker is not None:
+                broker.shutdown()
+            _shutil.rmtree(tmp, ignore_errors=True)
+
+    def _burst(mk):
+        serving = _serving(mk)
+        in_q, out_q = InputQueue(backend=mk()), OutputQueue(backend=mk())
+        uris = [f"n-{i}" for i in range(n_records)]
+        serving.start()
+        t0 = time.perf_counter()
+        for uri in uris:
+            in_q.enqueue(uri, input=x)
+        got = out_q.wait_all(uris, timeout=240, max_poll=0.02)
+        wall = time.perf_counter() - t0
+        serving.stop()
+        served_ms, decomposed = [], 0
+        for v in got.values():
+            t = getattr(v, "timing", None) \
+                if isinstance(v, ServingResult) else None
+            if t and all(k in t for k in
+                         ("transport_in_ms", "queue_ms", "device_ms")):
+                decomposed += 1
+            if t and t.get("enqueue_ts_ms") and t.get("done_ts_ms"):
+                served_ms.append(t["done_ts_ms"] - t["enqueue_ts_ms"])
+        res = {"burst_served": len(got),
+               "burst_rec_per_s": round(len(got) / wall, 1),
+               "burst_rows_with_decomposition": decomposed}
+        if served_ms:
+            arr = np.asarray(served_ms)
+            res["burst_p50_ms"] = round(float(np.percentile(arr, 50)), 2)
+            res["burst_p99_ms"] = round(float(np.percentile(arr, 99)), 2)
+        return res
+
+    def _request_response(mk, n=150):
+        serving = _serving(mk)
+        in_q, out_q = InputQueue(backend=mk()), OutputQueue(backend=mk())
+        serving.start()
+        lat = []
+        t0 = time.perf_counter()
+        for i in range(n):
+            uri = f"rr-{i}"
+            t1 = time.perf_counter()
+            in_q.enqueue(uri, input=x)
+            got = out_q.wait_all([uri], timeout=60, poll=0.002,
+                                 max_poll=0.01)
+            if uri not in got:
+                raise RuntimeError(f"request-response lost {uri}")
+            lat.append(1e3 * (time.perf_counter() - t1))
+        wall = time.perf_counter() - t0
+        serving.stop()
+        arr = np.asarray(lat)
+        return {"rr_rec_per_s": round(n / wall, 1),
+                "rr_p50_ms": round(float(np.percentile(arr, 50)), 2),
+                "rr_p99_ms": round(float(np.percentile(arr, 99)), 2)}
+
+    for transport in ("file", "socket"):
+        res = _transport(transport, _burst)
+        res.update(_transport(transport, _request_response))
+        for k, v in res.items():
+            out[f"network_{transport}_{k}"] = v
+
+    ratio = (out["network_socket_rr_rec_per_s"] /
+             max(out["network_file_rr_rec_per_s"], 1e-9))
+    out["network_socket_vs_file"] = round(ratio, 2)
+    out["network_socket_ge_3x_file_ok"] = _gate(
+        "network_socket_ge_3x_file", ratio >= 3.0,
+        f"socket {out['network_socket_rr_rec_per_s']} vs file "
+        f"{out['network_file_rr_rec_per_s']} req/s ({ratio:.2f}x < 3x)")
+    sock_p99 = out.get("network_socket_rr_p99_ms", 1e12)
+    file_p99 = out.get("network_file_rr_p99_ms", 0.0)
+    out["network_socket_p99_ok"] = _gate(
+        "network_socket_p99_le_file", sock_p99 <= file_p99 * 1.05,
+        f"socket rr p99 {sock_p99}ms > file rr p99 {file_p99}ms")
+    out["network_decomposition_ok"] = _gate(
+        "network_decomposition_on_every_row",
+        all(out[f"network_{t}_burst_rows_with_decomposition"] ==
+            out[f"network_{t}_burst_served"] == n_records
+            for t in ("file", "socket")),
+        f"served/decomposed: "
+        f"file {out['network_file_burst_served']}/"
+        f"{out['network_file_burst_rows_with_decomposition']}, "
+        f"socket {out['network_socket_burst_served']}/"
+        f"{out['network_socket_burst_rows_with_decomposition']} "
+        f"of {n_records}")
+
+    # -- phase 2: backlog autoscaling trace (burst -> max, idle -> min) ---
+    cfg_tmpl = ("model:\n  stub_ms_per_batch: 30.0\n\n"
+                "data:\n  src: socket://127.0.0.1:{port}\n"
+                "  image_shape: 3, 4, 4\n\n"
+                "params:\n  batch_size: 4\n  top_n: 0\n  workers: 1\n"
+                "  min_workers: 1\n  max_workers: 3\n"
+                "  autoscale_target_ms: 100\n  autoscale_interval: 0.2\n"
+                "  autoscale_cooldown_s: 0.5\n  scale_down_idle_s: 1.5\n"
+                "  health_interval: 0.25\n  health_timeout: 10.0\n")
+    workdir = _tempfile.mkdtemp(prefix="zoo_bench_net_scale_")
+    broker = StreamQueueBroker().start()
+    cfg = os.path.join(workdir, "config.yaml")
+    with open(cfg, "w") as f:
+        f.write(cfg_tmpl.format(port=broker.port))
+    fleet = ServingFleet(cfg, workdir, stream=_io.StringIO(),
+                         env={"JAX_PLATFORMS": "cpu"})
+    sup = threading.Thread(target=fleet.supervise, daemon=True)
+    try:
+        fleet.start()
+        sup.start()
+        if not fleet.wait_healthy(timeout=90.0):
+            raise RuntimeError("autoscale fleet never healthy")
+        in_q = InputQueue(backend=SocketStreamQueue("127.0.0.1",
+                                                    broker.port))
+        out_q = OutputQueue(backend=SocketStreamQueue("127.0.0.1",
+                                                      broker.port))
+        uris = [f"s-{i}" for i in range(160)]
+        xs = np.full((3, 4, 4), 7, np.float32)
+        for uri in uris:
+            in_q.enqueue(uri, input=xs)
+        got = out_q.wait_all(uris, timeout=240)
+        errors = sum(1 for v in got.values() if isinstance(v, Exception))
+        peak = max((e["active"] for e in fleet.autoscale_events
+                    if e["action"] == "scale_up"), default=1)
+        deadline = time.time() + 60.0
+        while len(fleet._active) > fleet.min_workers and \
+                time.time() < deadline:
+            time.sleep(0.1)
+        trace = read_autoscale_trace(workdir)
+        out["network_autoscale_served"] = len(got)
+        out["network_autoscale_errors"] = errors
+        out["network_autoscale_peak_workers"] = peak
+        out["network_autoscale_final_workers"] = len(fleet._active)
+        out["network_autoscale_events"] = [
+            {"action": e["action"], "workers": e["workers"],
+             "active": e["active"], "backlog": e["backlog"],
+             "predicted_wait_ms": e["predicted_wait_ms"]}
+            for e in trace]
+        actions = {e["action"] for e in trace}
+        out["network_autoscale_ok"] = _gate(
+            "network_autoscale_trace",
+            len(got) == len(uris) and errors == 0 and
+            peak == fleet.max_workers and
+            len(fleet._active) == fleet.min_workers and
+            {"scale_up", "scale_down"} <= actions,
+            f"served {len(got)}/{len(uris)} errors={errors} "
+            f"peak={peak}/{fleet.max_workers} "
+            f"final={len(fleet._active)}/{fleet.min_workers} "
+            f"actions={sorted(actions)}")
+    finally:
+        fleet.stop()
+        sup.join(timeout=30.0)
+        fleet.shutdown()
+        broker.shutdown()
+        _shutil.rmtree(workdir, ignore_errors=True)
+    return out
+
+
 def bench_generation(n_requests=48, slots=8, step_ms=2.0):
     """Generative-serving leg (docs/serving-generate.md): the identical
     skewed request mix (1 in 4 requests wants 32 tokens, the rest 4 —
@@ -2365,6 +2582,23 @@ def main():
             RESULT["fleet_error"] = (str(e).splitlines()[0][:500]
                                      if str(e) else repr(e)[:500])
         _stamp_leg_artifacts("fleet")
+        emit()
+
+    # Network-transport leg: identical burst over the file queue vs the
+    # socket broker (socket must serve >= 3x rec/s at equal-or-better
+    # p99, full timing decomposition on every row), plus the backlog
+    # autoscaler's burst->max / idle->min trace over a socket fleet
+    # (docs/serving-network.md).
+    if time.time() - T_START < TOTAL_BUDGET_S * 0.9:
+        try:
+            RESULT.update(bench_network_serving())
+        except Exception as e:  # noqa: BLE001
+            import traceback
+            traceback.print_exc()
+            RESULT["network_error"] = (str(e).splitlines()[0][:500]
+                                       if str(e) else repr(e)[:500])
+            _gate("network_measured", False, RESULT["network_error"])
+        _stamp_leg_artifacts("network")
         emit()
 
     # Generative-serving leg: continuous vs static batching tokens/s +

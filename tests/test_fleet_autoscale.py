@@ -1,0 +1,239 @@
+"""Backlog autoscaler tests: the pure policy (BacklogAutoscaler) and
+the fleet's grow/drain bookkeeping around it, without real worker
+subprocesses (docs/serving-network.md)."""
+
+import json
+import os
+import time
+
+import pytest
+
+from analytics_zoo_tpu.serving import BacklogAutoscaler, ServingFleet
+from analytics_zoo_tpu.serving.fleet import (autoscale_path,
+                                             read_autoscale_trace)
+
+# ---------------------------------------------------------------------------
+# policy
+# ---------------------------------------------------------------------------
+
+
+def test_policy_validates_band():
+    with pytest.raises(ValueError):
+        BacklogAutoscaler(0, 3)
+    with pytest.raises(ValueError):
+        BacklogAutoscaler(4, 3)
+    BacklogAutoscaler(2, 2)  # degenerate band is fine
+
+
+def test_predicted_wait_is_backlog_times_service_over_workers():
+    a = BacklogAutoscaler(1, 4, target_ms=200.0)
+    # 100 records * 2ms each / 2 workers + 10ms current batch
+    assert a.predicted_wait_ms(100, 2.0, 10.0, 2) == pytest.approx(110.0)
+    assert a.predicted_wait_ms(0, 2.0, 10.0, 2) == pytest.approx(10.0)
+
+
+def test_scale_up_jumps_to_fit_backlog():
+    a = BacklogAutoscaler(1, 8, target_ms=100.0, scale_up_fraction=0.5,
+                          cooldown_s=0.0)
+    # wait = 400*2/1 + 5 = 805ms >> 50ms threshold; need ~= ceil(800/45)
+    desired, reason = a.desired(400, 2.0, 5.0, 1, now=100.0)
+    assert desired == 8  # clamped to max
+    assert "scale_up" in reason or "backlog" in reason
+
+
+def test_scale_up_is_stepwise_without_estimates():
+    # record_ms unknown (cold fleet): only batch_ms can cross the
+    # threshold, and growth is a single +1 step, never a blind jump
+    a = BacklogAutoscaler(1, 8, target_ms=100.0, scale_up_fraction=0.5,
+                          cooldown_s=0.0)
+    desired, reason = a.desired(500, 0.0, 80.0, 2, now=100.0)
+    assert desired == 3
+    assert reason is not None
+
+
+def test_no_scale_up_below_threshold_or_at_max():
+    a = BacklogAutoscaler(1, 4, target_ms=200.0, scale_up_fraction=0.5,
+                          cooldown_s=0.0)
+    assert a.desired(10, 1.0, 1.0, 2, now=0.0) == (2, None)  # 6ms wait
+    # saturated: over threshold but already at max
+    desired, reason = a.desired(1000, 2.0, 5.0, 4, now=1.0)
+    assert (desired, reason) == (4, None)
+
+
+def test_scale_down_needs_sustained_idle_and_floor():
+    a = BacklogAutoscaler(1, 4, target_ms=100.0, idle_s=2.0,
+                          cooldown_s=0.0)
+    assert a.desired(0, 1.0, 1.0, 3, now=0.0) == (3, None)   # idle starts
+    assert a.desired(0, 1.0, 1.0, 3, now=1.0) == (3, None)   # not yet
+    desired, reason = a.desired(0, 1.0, 1.0, 3, now=2.5)
+    assert desired == 2 and "idle" in reason
+    # backlog resets the idle clock
+    a2 = BacklogAutoscaler(1, 4, target_ms=100.0, idle_s=2.0,
+                           cooldown_s=0.0)
+    a2.desired(0, 1.0, 1.0, 3, now=0.0)
+    a2.desired(5, 1.0, 1.0, 3, now=1.9)
+    assert a2.desired(0, 1.0, 1.0, 3, now=2.5) == (3, None)
+    # floor: min_workers never breached even when idle forever
+    a3 = BacklogAutoscaler(2, 4, target_ms=100.0, idle_s=0.5,
+                           cooldown_s=0.0)
+    a3.desired(0, 1.0, 1.0, 2, now=0.0)
+    assert a3.desired(0, 1.0, 1.0, 2, now=10.0) == (2, None)
+
+
+def test_cooldown_separates_actions():
+    a = BacklogAutoscaler(1, 8, target_ms=100.0, scale_up_fraction=0.5,
+                          cooldown_s=5.0)
+    # stepwise growth (no record estimate yet) under sustained pressure
+    desired, reason = a.desired(500, 0.0, 80.0, 2, now=0.0)
+    assert (desired, bool(reason)) == (3, True)
+    # identical pressure 1s later: inside cooldown, hold
+    assert a.desired(500, 0.0, 80.0, 3, now=1.0) == (3, None)
+    d2, r2 = a.desired(500, 0.0, 80.0, 3, now=6.0)
+    assert (d2, bool(r2)) == (4, True)
+
+
+# ---------------------------------------------------------------------------
+# fleet bookkeeping (spawn/terminate stubbed out)
+# ---------------------------------------------------------------------------
+
+_CFG = """\
+model:
+  stub_ms_per_batch: 1
+
+data:
+  src: file:{d}
+  image_shape: 3, 4, 4
+
+params:
+  batch_size: 4
+  workers: 2
+  min_workers: 1
+  max_workers: 4
+  autoscale_target_ms: 100
+  autoscale_interval: 0
+  autoscale_cooldown_s: 0
+  scale_down_idle_s: 0.5
+"""
+
+
+class _FakeProc:
+    def __init__(self):
+        self.terminated = False
+
+    def poll(self):
+        return 0 if self.terminated else None
+
+    def terminate(self):
+        self.terminated = True
+
+    def send_signal(self, _sig):
+        self.terminated = True
+
+    def kill(self):
+        self.terminated = True
+
+    def wait(self, timeout=None):
+        return 0
+
+
+class _FakeSupervised:
+    def __init__(self):
+        self.proc = _FakeProc()
+
+
+@pytest.fixture
+def fleet(tmp_path, monkeypatch):
+    stream = tmp_path / "stream"
+    stream.mkdir()
+    cfg = tmp_path / "config.yaml"
+    cfg.write_text(_CFG.format(d=stream))
+    fl = ServingFleet(str(cfg), str(tmp_path))
+    spawned = []
+
+    def fake_spawn(wid):
+        spawned.append(wid)
+        fl._procs[wid] = _FakeSupervised()
+        fl._spawned_at[wid] = time.time()
+    monkeypatch.setattr(fl, "_spawn", fake_spawn)
+    fl._spawned = spawned
+    yield fl
+
+
+def test_fleet_reads_autoscale_band_from_config(fleet):
+    assert (fleet.min_workers, fleet.workers, fleet.max_workers) == (1, 2, 4)
+    assert fleet.autoscaler is not None
+    assert sorted(fleet._active) == [0, 1]
+
+
+def test_fleet_scales_up_on_backlog_and_persists_trace(fleet, monkeypatch):
+    monkeypatch.setattr(fleet, "_queue_backlog", lambda: 400)
+    monkeypatch.setattr(fleet, "_ewma_estimates", lambda: (2.0, 5.0))
+    assert fleet.autoscale_once(now=100.0)
+    assert sorted(fleet._active) == [0, 1, 2, 3]
+    assert sorted(fleet._spawned) == [2, 3]
+    trace = read_autoscale_trace(fleet.workdir)
+    assert [e["action"] for e in trace] == ["scale_up"]
+    assert trace[0]["workers"] == [2, 3]
+    assert trace[0]["backlog"] == 400
+    assert trace[0]["predicted_wait_ms"] > 100
+    with open(autoscale_path(fleet.workdir)) as f:
+        state = json.load(f)
+    assert state["active"] == 4
+    assert (state["min_workers"], state["max_workers"]) == (1, 4)
+
+
+def test_fleet_drains_before_kill_on_scale_down(fleet, monkeypatch):
+    monkeypatch.setattr(fleet, "_queue_backlog", lambda: 0)
+    monkeypatch.setattr(fleet, "_ewma_estimates", lambda: (1.0, 1.0))
+    for wid in (0, 1):
+        fleet._procs[wid] = _FakeSupervised()
+        fleet._spawned_at[wid] = time.time()
+    fleet.autoscale_once(now=0.0)           # idle clock starts
+    assert fleet.autoscale_once(now=1.0)    # idle_s=0.5 elapsed
+    # highest wid retires first, via SIGTERM -> draining, not removal
+    assert sorted(fleet._active) == [0]
+    assert 1 in fleet._draining
+    assert fleet._procs[1].proc.terminated
+    assert 1 in fleet._procs  # reaped later by poll_once, not here
+    trace = read_autoscale_trace(fleet.workdir)
+    assert trace[-1]["action"] == "scale_down"
+    assert trace[-1]["workers"] == [1]
+
+
+def test_fleet_skips_tick_when_backlog_unreadable(fleet, monkeypatch):
+    monkeypatch.setattr(fleet, "_queue_backlog", lambda: None)
+    assert not fleet.autoscale_once(now=100.0)
+    assert sorted(fleet._active) == [0, 1]
+    assert read_autoscale_trace(fleet.workdir) == []
+
+
+def test_degenerate_band_disables_autoscaler(tmp_path):
+    cfg = tmp_path / "config.yaml"
+    stream = tmp_path / "stream"
+    stream.mkdir()
+    cfg.write_text(_CFG.format(d=stream).replace(
+        "min_workers: 1", "min_workers: 2").replace(
+        "max_workers: 4", "max_workers: 2"))
+    fl = ServingFleet(str(cfg), str(tmp_path))
+    assert fl.autoscaler is None
+    assert not fl.autoscale_once(now=100.0)
+
+
+def test_restart_skips_drained_worker(fleet, monkeypatch):
+    monkeypatch.setattr(fleet, "_queue_backlog", lambda: 0)
+    monkeypatch.setattr(fleet, "_ewma_estimates", lambda: (1.0, 1.0))
+    for wid in (0, 1):
+        fleet._procs[wid] = _FakeSupervised()
+        fleet._spawned_at[wid] = time.time()
+    fleet.autoscale_once(now=0.0)
+    fleet.autoscale_once(now=1.0)
+    assert 1 in fleet._draining
+    # the draining worker has exited: poll_once must reap it silently
+    # instead of restarting it
+    fleet._procs[1].proc.terminated = True
+    fleet.poll_once()
+    assert 1 not in fleet._procs
+    assert 1 not in fleet._draining
+    assert fleet._spawned == []  # no respawn of the retired worker
+    assert not os.path.exists(
+        os.path.join(fleet.workdir, "health", "worker-1.json"))

@@ -1,0 +1,115 @@
+"""Backend-conformance suite: one contract, three transports.
+
+Every StreamQueue backend (in-process, file, socket) must satisfy the
+same observable contract — FIFO delivery, single-assignment claims
+across concurrent consumers, idempotent per-uri results with pop
+semantics, watermark trim, and ``dequeue_ts_ms`` stamping — so that
+``data.src`` in config.yaml is a pure deployment choice
+(docs/serving-network.md)."""
+
+import time
+
+import pytest
+
+from analytics_zoo_tpu.serving import (FileStreamQueue,
+                                       InProcessStreamQueue,
+                                       SocketStreamQueue,
+                                       StreamQueueBroker)
+
+BACKENDS = ["inproc", "file", "socket"]
+
+
+@pytest.fixture
+def broker():
+    """Fresh broker per test: the broker holds ONE stream, so state
+    isolation means a new (ephemeral-port) broker, not a new name."""
+    b = StreamQueueBroker(claim_timeout_s=5.0).start()
+    yield b
+    b.shutdown()
+
+
+@pytest.fixture
+def make_backend(tmp_path, broker):
+    """Factory returning fresh handles onto ONE shared queue per test.
+
+    For inproc the same object is returned each call (it is
+    process-local by construction); file/socket return distinct
+    consumer handles over the shared directory / broker, which is the
+    multi-worker deployment shape."""
+    inproc = InProcessStreamQueue()
+
+    def factory(kind):
+        if kind == "inproc":
+            return inproc
+        if kind == "file":
+            return FileStreamQueue(str(tmp_path))
+        return SocketStreamQueue("127.0.0.1", broker.port)
+    return factory
+
+
+def _rec(i):
+    return {"uri": f"u-{i}", "data": b"x" * 8, "shape": [1]}
+
+
+@pytest.mark.parametrize("kind", BACKENDS)
+def test_fifo_and_dequeue_stamp(kind, make_backend):
+    q = make_backend(kind)
+    before_ms = time.time() * 1000.0 - 1.0
+    for i in range(6):
+        rid = q.enqueue(_rec(i))
+        assert isinstance(rid, str) and rid
+    assert q.stream_len() == 6
+    batch = q.read_batch(4, timeout=2.0)
+    assert [rec["uri"] for _rid, rec in batch] == \
+        ["u-0", "u-1", "u-2", "u-3"]
+    for rid, rec in batch:
+        assert isinstance(rid, str) and rid
+        assert rec["dequeue_ts_ms"] >= before_ms
+    rest = q.read_batch(10, timeout=2.0)
+    assert [rec["uri"] for _rid, rec in rest] == ["u-4", "u-5"]
+
+
+@pytest.mark.parametrize("kind", BACKENDS)
+def test_concurrent_consumers_claims_disjoint(kind, make_backend):
+    if kind == "inproc":
+        pytest.skip("in-process backend is single-consumer by design")
+    a, b = make_backend(kind), make_backend(kind)
+    for i in range(20):
+        a.enqueue(_rec(i))
+    seen_a = [rec["uri"] for _r, rec in a.read_batch(7, timeout=2.0)]
+    seen_b = [rec["uri"] for _r, rec in b.read_batch(7, timeout=2.0)]
+    seen_a += [rec["uri"] for _r, rec in a.read_batch(20, timeout=2.0)]
+    assert not set(seen_a) & set(seen_b), "record claimed twice"
+    assert sorted(seen_a + seen_b) == sorted(f"u-{i}" for i in range(20))
+
+
+@pytest.mark.parametrize("kind", BACKENDS)
+def test_batched_results_and_pop(kind, make_backend):
+    q = make_backend(kind)
+    q.put_results({"r-1": b"one", "r-2": b"two"})
+    q.put_result("r-3", b"three")
+    assert q.get_result("r-1", pop=False) == b"one"
+    assert q.get_result("r-1", pop=True) == b"one"
+    assert q.get_result("r-1") is None
+    rest = q.all_results(pop=True)
+    assert rest == {"r-2": b"two", "r-3": b"three"}
+    assert q.all_results(pop=True) == {}
+
+
+@pytest.mark.parametrize("kind", BACKENDS)
+def test_trim_keeps_newest(kind, make_backend):
+    q = make_backend(kind)
+    for i in range(10):
+        q.enqueue(_rec(i))
+    q.trim(keep_last=3)
+    assert q.stream_len() == 3
+    assert [rec["uri"] for _r, rec in q.read_batch(10, timeout=2.0)] == \
+        ["u-7", "u-8", "u-9"]
+
+
+@pytest.mark.parametrize("kind", BACKENDS)
+def test_empty_read_respects_timeout(kind, make_backend):
+    q = make_backend(kind)
+    t0 = time.time()
+    assert q.read_batch(4, timeout=0.2) == []
+    assert time.time() - t0 < 2.0

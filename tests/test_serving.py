@@ -352,3 +352,77 @@ def test_wait_all_exponential_backoff(monkeypatch):
     assert sleeps[:drop] == sorted(sleeps[:drop])
     assert all(sleeps[i] >= sleeps[i + 1]
                for i in range(drop, len(sleeps) - 1))
+
+
+def test_delivery_ledger_bounds_both_memories():
+    """Satellite contract: the dedup ledger's rid window AND its
+    per-producer seq map are bounded, so a long-lived consumer cannot
+    leak memory however many records / short-lived producers it sees."""
+    from analytics_zoo_tpu.serving import DeliveryLedger
+
+    led = DeliveryLedger(window=8, producer_cap=4)
+    for i in range(32):
+        assert led.note(f"{i:020d}-aaaa-{i:08d}")
+    assert len(led._delivered) == 8 and len(led._ring) == 8
+    # duplicates detected exactly within the window...
+    assert not led.note(f"{31:020d}-aaaa-{31:08d}")
+    assert led.stats()["duplicates"] == 1
+    # ...and an evicted rid is indistinguishable from fresh (the
+    # documented trade for boundedness)
+    assert led.note(f"{0:020d}-aaaa-{0:08d}")
+    # producer-seq map is an LRU capped at producer_cap
+    for p in range(20):
+        led.note(f"{100 + p:020d}-p{p:04x}-{0:08d}")
+    assert led.stats()["producers_seen"] == 4
+    # seq continuity still tracked for live producers
+    led.note(f"200{0:017d}-live-{1:08d}")
+    led.note(f"200{1:017d}-live-{5:08d}")
+    assert led.stats()["seq_gaps"] == 3
+
+
+def test_file_queue_ledger_is_bounded(tmp_path):
+    """FileStreamQueue wires its consumer bookkeeping through the
+    bounded ledger (delivered_window / producer_cap knobs)."""
+    q = FileStreamQueue(str(tmp_path), delivered_window=4, producer_cap=2)
+    assert q._ledger.window == 4 and q._ledger.producer_cap == 2
+    for i in range(12):
+        q.enqueue({"uri": f"u-{i}", "data": b"x"})
+    assert len(q.read_batch(12, timeout=1.0)) == 12
+    assert len(q._ledger._delivered) == 4
+    assert q.consumer_stats()["duplicates"] == 0
+
+
+def test_wait_all_uses_long_poll_when_supported():
+    """Satellite contract: against a transport that advertises
+    ``supports_long_poll`` (the socket backend), wait_all parks in
+    wait_any instead of polling all_results with backoff sleeps."""
+    import json as _json
+
+    class FakeLongPoll(InProcessStreamQueue):
+        supports_long_poll = True
+
+        def __init__(self):
+            super().__init__()
+            self.wait_calls = []
+            self.all_calls = 0
+
+        def wait_any(self, uris, timeout=1.0, pop=True):
+            self.wait_calls.append((tuple(uris), pop))
+            return {u: v for u, v in
+                    [(u, self._results.pop(u, None)) for u in uris]
+                    if v is not None}
+
+        def all_results(self, pop=True):
+            self.all_calls += 1
+            return super().all_results(pop)
+
+    backend = FakeLongPoll()
+    out_q = OutputQueue(backend=backend)
+    for u in ("a", "b"):
+        backend.put_result(u, _json.dumps({"value": [1.0]}).encode())
+    got = out_q.wait_all(["a", "b"], timeout=5.0)
+    assert set(got) == {"a", "b"}
+    assert backend.wait_calls == [(("a", "b"), True)]
+    # the bulk-drain path (which would pop OTHER clients' results) is
+    # never touched on the long-poll transport
+    assert backend.all_calls == 0
