@@ -150,6 +150,33 @@ class ZooConfig:
     # metrics.json per process land here; fault-path flight dumps go to
     # <trace_dir>/debug/. `--trace-dir` on zoo-launch/zoo-serving sets it.
     trace_dir: Optional[str] = None
+    # training health monitor (pipeline/health.py): on-device NaN/Inf
+    # sentinels on loss (and grad norm when L2 clipping already computes
+    # it) + EWMA z-score spike detection per logging window. Off by
+    # default: the sentinel adds one tiny scalar host fetch per dispatch.
+    health_monitor: bool = False
+    # escalate a latched non-finite to checkpoint-and-halt through the
+    # request_preemption() drain (the drain's final save is suppressed —
+    # the live params are poisoned; `latest` keeps the last good step)
+    health_halt: bool = False
+    # |z| above this many moving standard deviations (EwmaStd) flags a
+    # spike on loss / grad_norm / step_time_ms
+    health_z_threshold: float = 6.0
+    # logging windows observed before spike detection arms
+    health_warmup_windows: int = 5
+    # compute a grad-norm sentinel even without L2-norm clipping (adds
+    # the global-norm reduce the r4 cleanup removed — opt-in only)
+    health_grad_sentinel: bool = False
+    # device-memory accountant (utils/memory.py): AOT-compile the step
+    # program once for memory_analysis() (params/opt/activations/transfer
+    # breakdown -> TrainSummary + zoo_hbm_program_* gauges) and poll
+    # device.memory_stats() watermarks each logging window. The AOT
+    # compile is a second XLA compile of the step program.
+    memory_accounting: bool = True
+    # fraction of bytes_limit at which the live HBM watermark latches an
+    # OOM-forensics dump (breakdown + flight recorder + HLO tail);
+    # 0 disables the early-warning dump
+    hbm_watermark_fraction: float = 0.92
     # NNFrames ingest: when the processed samples of a DataFrame would
     # exceed this many bytes, NNEstimator.fit spills them to sharded .npz
     # files and streams (ShardedFileFeatureSet) instead of holding the

@@ -37,7 +37,8 @@ from ..feature.feature_set import (ArrayFeatureSet, FeatureSet, MiniBatch,
                                    minibatch_len, pad_minibatch)
 from ..feature.host_pipeline import (DeviceStagingIterator,
                                      build_host_pipeline)
-from ..utils import faults, file_io, serialization, sharded_checkpoint
+from ..utils import faults, file_io, memory, serialization, \
+    sharded_checkpoint
 from ..utils import telemetry
 from ..utils.crc32c import crc32c
 from ..utils.profiling import (InfeedMonitor, ProfilerHook, inference_window,
@@ -53,6 +54,15 @@ class TrainingPreempted(RuntimeError):
     loop drained the in-flight dispatch and saved a final checkpoint.
     Deliberately NOT retried by the failure-retry policy — the process is
     being evicted; the gang supervisor relaunches and auto-resumes."""
+
+
+class TrainingHalted(TrainingPreempted):
+    """Raised out of ``train()`` when the health monitor escalated a
+    latched non-finite to checkpoint-and-halt (``ZooConfig.health_halt``).
+    Subclasses :class:`TrainingPreempted` so the failure-retry policy
+    never restores-and-retries a diverged run; UNLIKE a preemption the
+    drain does NOT write a final checkpoint — the live params are
+    poisoned, so ``latest`` keeps pointing at the last good step."""
 
 
 # preemption drain: a SIGTERM handler (launcher.worker) flips this event;
@@ -227,6 +237,14 @@ class SPMDTrainer:
         # optional: matmul FLOPs of one train step; enables the MFU scalar
         # in TrainSummary (§5.1)
         self.flops_per_step: Optional[float] = None
+        # device-memory accountant state: the train program's HBM
+        # breakdown from memory_analysis() (utils/memory.py) and the
+        # programs already accounted (one AOT compile each)
+        self.hbm_breakdown: Optional[Dict[str, int]] = None
+        self._mem_accounted: set = set()
+        # training health monitor (pipeline/health.py), built per
+        # train() when ZooConfig.health_monitor is on
+        self._health = None
         # top-level param keys (layer names) excluded from updates
         # (GraphNet freeze/unFreeze parity)
         self.frozen_names: frozenset = frozenset()
@@ -503,7 +521,22 @@ class SPMDTrainer:
         if gnorm is not None and \
                 bool(getattr(self.ctx.config, "log_grad_norm", False)):
             logs["grad_norm"] = gnorm
+        if self._health_sentinel_on():
+            # on-device NaN/Inf sentinel: ONE boolean scalar riding the
+            # step outputs. The grad-norm check piggybacks on the L2-clip
+            # reduction when it already ran; health_grad_sentinel opts
+            # into the extra global-norm reduce otherwise.
+            if gnorm is None and bool(getattr(
+                    self.ctx.config, "health_grad_sentinel", False)):
+                gnorm = optax.global_norm(grads)
+            bad = ~jnp.isfinite(loss)
+            if gnorm is not None:
+                bad = bad | ~jnp.isfinite(gnorm)
+            logs["health_bad"] = bad
         return params, opt_state, new_state, logs
+
+    def _health_sentinel_on(self) -> bool:
+        return bool(getattr(self.ctx.config, "health_monitor", False))
 
     def build_train_step(self):
         if self._train_step is not None:
@@ -537,11 +570,22 @@ class SPMDTrainer:
                 params, opt_state, net_state, step = carry
                 params, opt_state, net_state, logs = self._step_body(
                     params, opt_state, net_state, batch, step)
-                return (params, opt_state, net_state, step + 1), logs["loss"]
+                bad = logs.get("health_bad", jnp.zeros((), jnp.bool_))
+                return (params, opt_state, net_state, step + 1), \
+                    (logs["loss"], bad)
 
-            (params, opt_state, net_state, _), losses = jax.lax.scan(
-                body, (params, opt_state, net_state, step0), batches)
-            return params, opt_state, net_state, {"loss": losses[-1]}
+            (params, opt_state, net_state, _), (losses, bads) = \
+                jax.lax.scan(body, (params, opt_state, net_state, step0),
+                             batches)
+            out = {"loss": losses[-1]}
+            if self._health_sentinel_on():
+                # index of the FIRST bad step within this dispatch (-1 =
+                # clean): k sentinels reduce to one tiny scalar, so the
+                # host still pins the exact step under fused dispatch
+                out["health_first_bad"] = jnp.where(
+                    jnp.any(bads), jnp.argmax(bads),
+                    jnp.asarray(-1, dtype=jnp.int32)).astype(jnp.int32)
+            return params, opt_state, net_state, out
 
         # donate the carried state: amortized over k steps, and the caller
         # always rebinds self.params/... to the returned arrays. Honors
@@ -717,6 +761,13 @@ class SPMDTrainer:
         validation_trigger = validation_trigger or (
             EveryEpoch() if validation_set is not None else None)
         self._maybe_auto_resume()
+        cfg = self.ctx.config
+        if getattr(cfg, "health_monitor", False):
+            from .health import HealthMonitor
+            self._health = HealthMonitor(
+                z_threshold=getattr(cfg, "health_z_threshold", 6.0),
+                warmup_windows=getattr(cfg, "health_warmup_windows", 5),
+                halt=getattr(cfg, "health_halt", False))
         step_fn = self.build_train_step()
         record = TrainRecord(epoch=self.epoch, iteration=self.step)
         retries = 0
@@ -728,14 +779,25 @@ class SPMDTrainer:
                     self._run_epoch(train_set, batch_size, step_fn, record,
                                     checkpoint_trigger, validation_set,
                                     validation_trigger, end_trigger)
-                except TrainingPreempted:
-                    # deliberate exit, final checkpoint already saved —
-                    # never burn failure retries on an eviction notice
+                except TrainingPreempted as e:
+                    # deliberate exit (eviction notice or health halt) —
+                    # never burn failure retries on it. A health halt
+                    # leaves `latest` at the last GOOD step (the drain's
+                    # save is suppressed); clear the drain flag so a
+                    # restore-and-resume in this process isn't instantly
+                    # re-preempted.
+                    if isinstance(e, TrainingHalted):
+                        clear_preemption()
                     self.wait_for_checkpoint()
                     telemetry.dump_flight(
                         f"TrainingPreempted @step {self.step}")
                     raise
                 except (jax.errors.JaxRuntimeError, RuntimeError) as e:
+                    # allocation failures get a memory post-mortem
+                    # (per-program breakdowns + watermarks + HLO tail)
+                    # before the retry policy decides anything
+                    memory.maybe_oom_forensics(
+                        e, out_dir=getattr(cfg, "trace_dir", None))
                     retries += 1
                     # an in-flight async write may be the checkpoint we
                     # need: land it before deciding whether retry is
@@ -865,6 +927,93 @@ class SPMDTrainer:
             logger.debug("flops cost analysis failed", exc_info=True)
             self.flops_per_step = 0.0
 
+    @staticmethod
+    def _abstractify(args):
+        return jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
+            if hasattr(x, "shape") and hasattr(x, "dtype") else x,
+            args, is_leaf=lambda x: x is None)
+
+    def _maybe_account_memory(self, program: str, fn, args):
+        """Device-memory accountant hook (utils/memory.py): AOT-compile
+        the program once with abstract args, record its
+        ``memory_analysis()`` breakdown (params / optimizer state /
+        activations+temp / transfers) into ``zoo_hbm_program_*`` gauges,
+        and keep the HLO tail for OOM forensics. Unlike
+        :meth:`_maybe_record_flops` this is a real second XLA compile of
+        the program — gated by ``ZooConfig.memory_accounting``."""
+        if program in self._mem_accounted or \
+                not getattr(self.ctx.config, "memory_accounting", True):
+            return
+        # only pay the AOT compile when the result has a consumer: a
+        # TrainSummary for the train breakdown, or the telemetry spine
+        # for the zoo_hbm_program_* gauges (mirrors _maybe_record_flops)
+        if not telemetry.enabled() and \
+                not (program == "train" and self.train_summary is not None):
+            return
+        self._mem_accounted.add(program)
+        try:
+            compiled = fn.lower(*self._abstractify(args)).compile()
+            hlo = None
+            try:
+                hlo = compiled.as_text()
+            except Exception:  # noqa: BLE001 - HLO text is best-effort
+                pass
+            bd = memory.account_program(
+                program, compiled, params=self.params,
+                opt_state=self.opt_state if program == "train" else None,
+                hlo_text=hlo)
+            if program == "train" and bd is not None:
+                self.hbm_breakdown = bd
+                logger.info(
+                    "train step HBM breakdown: total %.1f MiB (params "
+                    "%.1f, opt %.1f, act+temp %.1f, transfers %.1f)",
+                    bd["total_bytes"] / 2**20, bd["params_bytes"] / 2**20,
+                    bd["opt_state_bytes"] / 2**20,
+                    bd["activations_temp_bytes"] / 2**20,
+                    bd["transfers_bytes"] / 2**20)
+        except Exception:  # noqa: BLE001 - observability must not kill run
+            logger.debug("memory accounting failed for %s", program,
+                         exc_info=True)
+
+    def _ckpt_allowed(self) -> bool:
+        """Checkpoint writes are refused once the health monitor latched
+        a non-finite halt: the live params are poisoned and must never
+        shadow the last good ``latest``."""
+        return self._health is None or not self._health.halted
+
+    def _maybe_poison_chunk(self, chunk, n_planned: int):
+        """Apply armed ``step:nan@N`` / ``grad:nan@N`` faults to the
+        upcoming dispatch (utils/faults.py): NaN-fill the covered step's
+        input arrays, or one parameter leaf. Inert (two cheap spec
+        lookups) when nothing is armed."""
+        def nan_fill(a, idx=None):
+            if not (hasattr(a, "dtype")
+                    and jnp.issubdtype(a.dtype, jnp.floating)):
+                return a
+            if idx is None:
+                return jnp.full_like(a, jnp.nan)
+            return a.at[idx].set(jnp.nan)
+
+        rel = faults.poison_step(self.step, n_planned)
+        if rel is not None:
+            if chunk.stacked is not None:
+                xs, y, w = chunk.stacked
+                xs = jax.tree.map(lambda a: nan_fill(a, idx=rel), xs)
+                chunk.stacked = (xs, y, w)
+            else:
+                xs, y, w = chunk.singles[rel]
+                chunk.singles[rel] = (jax.tree.map(nan_fill, xs), y, w)
+        if faults.poison_grad(self.step, n_planned):
+            flat, treedef = jax.tree_util.tree_flatten(self.params)
+            for i, leaf in enumerate(flat):
+                if hasattr(leaf, "dtype") and \
+                        jnp.issubdtype(leaf.dtype, jnp.floating):
+                    flat[i] = jnp.full_like(leaf, jnp.nan)
+                    break
+            self.params = jax.tree_util.tree_unflatten(treedef, flat)
+        return chunk
+
     def _epoch_loop(self, staging, step_fn, record, batch_size, t0,
                     checkpoint_trigger, validation_set, validation_trigger,
                     end_trigger, log_every):
@@ -883,6 +1032,16 @@ class SPMDTrainer:
         while True:
             if preemption_requested():
                 telemetry.event("train/preempted", step=self.step)
+                if self._health is not None and self._health.halted:
+                    # health halt: the live params are poisoned — do NOT
+                    # write a final checkpoint; `latest` keeps pointing
+                    # at the last good step
+                    raise TrainingHalted(
+                        f"health monitor halted training at step "
+                        f"{self._health.halt_step}"
+                        + ("" if self.checkpoint_dir is None
+                           else f"; restore the last good step from "
+                                f"{self.checkpoint_dir}"))
                 if self.checkpoint_dir is not None:
                     self.save_checkpoint(self.checkpoint_dir)
                     self.wait_for_checkpoint()
@@ -901,17 +1060,34 @@ class SPMDTrainer:
                 chunk = staging.next_chunk(k)
                 if chunk is None:
                     break
+                # chaos harness: armed step:nan@N / grad:nan@N faults
+                # poison the inputs / a param leaf for the dispatch that
+                # covers step N, driving a REAL non-finite through the
+                # compiled step for the health monitor to catch
+                n_planned = k if chunk.stacked is not None \
+                    else len(chunk.singles)
+                chunk = self._maybe_poison_chunk(chunk, n_planned)
+                bad_step = None
                 if chunk.stacked is not None:
                     multi = self.build_multi_step(k)
                     self._maybe_record_flops(
                         multi, (self.params, self.opt_state,
                                 self.net_state, chunk.stacked, self.step), k)
+                    self._maybe_account_memory(
+                        "train", multi, (self.params, self.opt_state,
+                                         self.net_state, chunk.stacked,
+                                         self.step))
                     with span("train/dispatch", step=self.step, k=k):
                         (self.params, self.opt_state, self.net_state,
                          logs) = multi(self.params, self.opt_state,
                                        self.net_state, chunk.stacked,
                                        self.step)
                     done = k
+                    if self._health is not None and \
+                            "health_first_bad" in logs:
+                        fb = int(np.asarray(logs["health_first_bad"]))
+                        if fb >= 0:
+                            bad_step = self.step + fb + 1
                 else:
                     # single-step path: k == 1, or an epoch tail shorter
                     # than k (reuse the single-step program rather than
@@ -923,12 +1099,20 @@ class SPMDTrainer:
                                 step_fn, (self.params, self.opt_state,
                                           self.net_state, batch,
                                           self.step), 1)
+                            self._maybe_account_memory(
+                                "train", step_fn,
+                                (self.params, self.opt_state,
+                                 self.net_state, batch, self.step))
                         with span("train/dispatch", step=self.step + done):
                             (self.params, self.opt_state, self.net_state,
                              logs) = step_fn(self.params, self.opt_state,
                                              self.net_state, batch,
                                              self.step + done)
                         done += 1
+                        if self._health is not None and bad_step is None \
+                                and "health_bad" in logs and \
+                                bool(np.asarray(logs["health_bad"])):
+                            bad_step = self.step + done
                 self.step += done
                 self.epoch_batches += done
                 n_batches += done
@@ -939,6 +1123,11 @@ class SPMDTrainer:
                 # chaos harness: an armed step:kill@N fault fires here (at
                 # or after N — multi-step dispatch cannot jump over it)
                 faults.check("step", step=self.step)
+                if bad_step is not None:
+                    # escalation ladder: latched event -> flight dump ->
+                    # optional checkpoint-and-halt (the preemption check
+                    # at the top of the next iteration honours it)
+                    self._health.on_nonfinite(bad_step, signal="sentinel")
                 last_loss = logs["loss"]
             if profiler is not None:
                 profiler.step(self.step)
@@ -956,13 +1145,50 @@ class SPMDTrainer:
                     infeed = monitor.window(window_steps, wall)
                 telemetry.gauge("zoo_train_loss").set(loss_v)
                 telemetry.gauge("zoo_train_learning_rate").set(lr)
+                gnorm_v = float(np.asarray(logs["grad_norm"])) \
+                    if "grad_norm" in logs else None
+                if self._health is not None:
+                    # EWMA z-score spike detection on the window scalars
+                    # (also a host-side non-finite backstop)
+                    self._health.observe_window(
+                        self.step, loss=loss_v, grad_norm=gnorm_v,
+                        step_time_ms=infeed["step_time_ms"])
+                if getattr(cfg, "memory_accounting", True):
+                    # live HBM watermarks (None on the CPU stub); latches
+                    # an OOM-forensics dump past hbm_watermark_fraction
+                    memory.poll_device_memory(
+                        self.ctx.devices,
+                        watermark_fraction=getattr(
+                            cfg, "hbm_watermark_fraction", 0.0),
+                        out_dir=getattr(cfg, "trace_dir", None))
                 if self.train_summary is not None:
                     self.train_summary.add_scalar("Loss", loss_v, self.step)
                     self.train_summary.add_scalar("LearningRate", lr,
                                                   self.step)
-                    if "grad_norm" in logs:   # opt-in; single-step path
+                    if gnorm_v is not None:   # opt-in; single-step path
                         self.train_summary.add_scalar(
-                            "GradNorm", float(np.asarray(logs["grad_norm"])),
+                            "GradNorm", gnorm_v, self.step)
+                    if self._health is not None:
+                        self.train_summary.add_scalar(
+                            "HealthState", float(self._health.state),
+                            self.step)
+                    if self.hbm_breakdown is not None:
+                        bd = self.hbm_breakdown
+                        mib = 1.0 / 2**20
+                        self.train_summary.add_scalar(
+                            "HBMTotalMB", bd["total_bytes"] * mib,
+                            self.step)
+                        self.train_summary.add_scalar(
+                            "HBMParamsMB", bd["params_bytes"] * mib,
+                            self.step)
+                        self.train_summary.add_scalar(
+                            "HBMOptStateMB", bd["opt_state_bytes"] * mib,
+                            self.step)
+                        self.train_summary.add_scalar(
+                            "HBMActivationsMB",
+                            bd["activations_temp_bytes"] * mib, self.step)
+                        self.train_summary.add_scalar(
+                            "HBMTransfersMB", bd["transfers_bytes"] * mib,
                             self.step)
                     self.train_summary.add_scalar(
                         "Throughput", window_steps * batch_size / wall,
@@ -993,7 +1219,8 @@ class SPMDTrainer:
                 window_steps = 0
                 logger.info("epoch %d step %d loss %.5f", record.epoch,
                             self.step, loss_v)
-            if checkpoint_trigger is not None and checkpoint_trigger(record):
+            if checkpoint_trigger is not None and checkpoint_trigger(record) \
+                    and self._ckpt_allowed():
                 self.save_checkpoint(self.checkpoint_dir)
             if validation_trigger is not None and validation_trigger(record):
                 self._run_validation(validation_set, batch_size, record)
@@ -1014,7 +1241,8 @@ class SPMDTrainer:
                     n_batches * batch_size / max(dur, 1e-9))
         if validation_trigger is not None and validation_trigger(record):
             self._run_validation(validation_set, batch_size, record)
-        if checkpoint_trigger is not None and checkpoint_trigger(record):
+        if checkpoint_trigger is not None and checkpoint_trigger(record) \
+                and self._ckpt_allowed():
             self.save_checkpoint(self.checkpoint_dir)
 
     def _run_validation(self, validation_set, batch_size, record):
@@ -1080,14 +1308,21 @@ class SPMDTrainer:
                 if chunk is None:
                     break
                 if chunk.stacked is not None:
+                    multi_eval = self.build_multi_eval(chunk.k)
+                    self._maybe_account_memory(
+                        "eval", multi_eval,
+                        (self.params, self.net_state, chunk.stacked))
                     with span("eval/dispatch", k=chunk.k):
-                        stats = self.build_multi_eval(chunk.k)(
+                        stats = multi_eval(
                             self.params, self.net_state, chunk.stacked)
                     fused += 1
                 else:
                     stats = None
                     with span("eval/dispatch", k=len(chunk.singles)):
                         for batch in chunk.singles:
+                            self._maybe_account_memory(
+                                "eval", eval_fn,
+                                (self.params, self.net_state, batch))
                             s = eval_fn(self.params, self.net_state, batch)
                             stats = s if stats is None else jax.tree.map(
                                 jnp.add, stats, s)
@@ -1144,14 +1379,21 @@ class SPMDTrainer:
                     break
                 counts = chunk.real_counts
                 if chunk.stacked is not None:
+                    multi_predict = self.build_multi_predict(chunk.k)
+                    self._maybe_account_memory(
+                        "predict", multi_predict,
+                        (self.params, self.net_state, chunk.stacked[0]))
                     with span("predict/dispatch", k=chunk.k):
-                        preds = self.build_multi_predict(chunk.k)(
+                        preds = multi_predict(
                             self.params, self.net_state, chunk.stacked[0])
                     results.append((True, preds, counts))
                     fused += 1
                 else:
                     with span("predict/dispatch", k=len(chunk.singles)):
                         for batch, c in zip(chunk.singles, counts):
+                            self._maybe_account_memory(
+                                "predict", predict_fn,
+                                (self.params, self.net_state, batch[0]))
                             preds = predict_fn(self.params, self.net_state,
                                                batch[0])
                             results.append((False, preds, [c]))

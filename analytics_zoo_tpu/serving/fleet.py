@@ -506,7 +506,9 @@ class ServingFleet:
                  "active": len(self._active), "backlog": backlog,
                  "predicted_wait_ms": round(wait_ms, 1), "reason": reason}
         self.autoscale_events.append(event)
-        telemetry.event(f"fleet/{action}", **{k: v for k, v in
+        # literal names only (scripts/lint-telemetry): the action rides
+        # as an arg, not in the event name
+        telemetry.event("fleet/autoscale", **{k: v for k, v in
                                               event.items() if k != "ts"})
         telemetry.gauge("zoo_fleet_workers").set(len(self._active))
         file_io.write_bytes_atomic(

@@ -22,6 +22,14 @@ Sites and the specs they accept:
     The first ``N`` ``file_io`` byte reads/writes raise
     :class:`TransientFault` (an ``OSError``), exercising the bounded
     retry in :mod:`utils.file_io`.
+``step:nan@N`` / ``grad:nan@N``
+    Poison the training inputs (``step``) or one parameter leaf
+    (``grad``) with NaN for the dispatch that covers step ``N`` (fires
+    at-or-after ``N``; inside a fused k-step dispatch exactly the
+    covered step's slice is poisoned). The NaN then flows through the
+    REAL compiled step — loss (and grad norm) go non-finite on device —
+    so the health monitor's detect→dump→halt ladder
+    (:mod:`pipeline.health`) is exercised end-to-end. One-shot.
 ``infeed-worker:kill@N``
     SIGKILL an infeed transform worker (ProcessTransformPool) the first
     time its per-process item counter reaches ``N`` — mid-epoch, after
@@ -158,6 +166,18 @@ def _claim_exclusive(spec: _Spec) -> bool:
     return True
 
 
+# literal event name per site: telemetry names must never be built by
+# interpolation (scripts/lint-telemetry enforces this repo-wide — a
+# cardinality-bounded name set is what makes the trace queryable)
+_FLIGHT_EVENTS = {
+    "step": "fault/step",
+    "grad": "fault/grad",
+    "ckpt-write": "fault/ckpt-write",
+    "file-io": "fault/file-io",
+    "infeed-worker": "fault/infeed-worker",
+}
+
+
 def _flight(spec: _Spec, detail: str, **args) -> None:
     """Leave post-mortem evidence before a fatal fault fires: an instant
     event naming the site, then the flight-recorder dump
@@ -165,7 +185,8 @@ def _flight(spec: _Spec, detail: str, **args) -> None:
     when telemetry is disabled; never masks the fault itself."""
     try:
         from . import telemetry
-        telemetry.event(f"fault/{spec.site}", action=spec.action,
+        name = _FLIGHT_EVENTS.get(spec.site, "fault/other")
+        telemetry.event(name, site=spec.site, action=spec.action,
                         arg=spec.arg, **args)
         telemetry.dump_flight(f"ZOO_TPU_FAULT {spec.raw}: {detail}")
     except Exception:  # noqa: BLE001 - the fault must still fire
@@ -185,6 +206,8 @@ def check(site: str, step: Optional[int] = None) -> None:
         if spec.site != site:
             continue
         if site == "step":
+            if spec.action == "nan":
+                continue  # armed via poison_step(), not the post-hook
             if step is not None and step >= spec.arg \
                     and not _already_fired(spec):
                 _record_fired(spec)
@@ -219,6 +242,38 @@ def check(site: str, step: Optional[int] = None) -> None:
                     raise TransientFault(
                         f"injected transient IO error {n}/{spec.arg} "
                         f"({spec.raw})")
+
+
+def _nan_target(site: str, step_before: int, n_steps: int) -> Optional[int]:
+    """Shared arming logic for the ``nan`` poison sites: if a
+    ``<site>:nan@N`` spec covers the dispatch spanning steps
+    ``(step_before, step_before + n_steps]``, claim it one-shot and
+    return the 0-based slice index to poison, else ``None``."""
+    for spec in _specs():
+        if spec.site != site or spec.action != "nan":
+            continue
+        if step_before + n_steps < spec.arg or _already_fired(spec):
+            continue
+        _record_fired(spec)
+        rel = min(max(spec.arg - step_before - 1, 0), n_steps - 1)
+        _flight(spec, f"poisoning {site} for step "
+                      f"{step_before + rel + 1}", step=step_before + rel + 1)
+        return rel
+    return None
+
+
+def poison_step(step_before: int, n_steps: int) -> Optional[int]:
+    """``step:nan@N``: which slice of the upcoming dispatch's inputs to
+    NaN-poison (0-based, ``None`` when unarmed). The engine applies the
+    poison to the batch so the compiled step computes a real NaN loss."""
+    return _nan_target("step", step_before, n_steps)
+
+
+def poison_grad(step_before: int, n_steps: int) -> bool:
+    """``grad:nan@N``: True when the upcoming dispatch should run with a
+    NaN-poisoned parameter leaf (drives grad norm — and loss — non-finite
+    through the real backward pass)."""
+    return _nan_target("grad", step_before, n_steps) is not None
 
 
 def begin_save() -> None:

@@ -1,0 +1,273 @@
+"""Device-memory accountant.
+
+Two complementary views of HBM, both fed into the telemetry spine
+(:mod:`utils.telemetry`) so `zoo-train top`, metrics.json and the
+flight recorder all see the same numbers:
+
+* **Static (per compiled program):** :func:`account_program` wraps an
+  AOT-compiled executable's ``memory_analysis()`` into a per-program
+  breakdown — parameters / optimizer state / activations+temporaries /
+  host↔device transfers — published as ``zoo_hbm_program_*`` gauges and
+  kept for forensics. The engine calls this once per train/eval/predict
+  program (``ZooConfig.memory_accounting``).
+* **Dynamic (per device):** :func:`poll_device_memory` reads
+  ``device.memory_stats()`` (None on the CPU stub, a dict on TPU/GPU)
+  into live ``zoo_hbm_*`` watermark gauges, and latches an OOM-forensics
+  dump when the in-use watermark crosses
+  ``ZooConfig.hbm_watermark_fraction`` of the device limit.
+
+When an allocation actually fails (``RESOURCE_EXHAUSTED`` out of the
+runtime), :func:`maybe_oom_forensics` writes the post-mortem:
+per-program breakdowns + the last device watermarks + the tail of each
+program's HLO, next to the flight-recorder dump under
+``<trace_dir>/debug/``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, Optional, Sequence
+
+from . import telemetry
+
+logger = logging.getLogger("analytics_zoo_tpu.memory")
+
+# last-known per-program breakdowns / HLO tails / device watermarks,
+# composed into the OOM forensics payload
+_LOCK = threading.Lock()
+_PROGRAMS: Dict[str, Dict[str, Any]] = {}
+_HLO: Dict[str, str] = {}
+_LAST_DEVICE: Dict[str, Any] = {}
+_WATERMARK_LATCHED = False
+
+# keep only the tail of each HLO text: the full module for a real model
+# is tens of MB; the closing fusions/allocations are what an OOM
+# post-mortem needs
+HLO_TAIL_BYTES = 65536
+
+_OOM_MARKERS = ("resource_exhausted", "out of memory", "oom",
+                "exceeds the memory", "allocating")
+
+
+def _bytes_of_tree(tree) -> int:
+    """Total bytes of the array leaves of a pytree (params/opt state)."""
+    if tree is None:
+        return 0
+    import jax
+    import numpy as np
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        if hasattr(leaf, "nbytes"):
+            total += int(leaf.nbytes)
+        elif hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            total += int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+    return total
+
+
+def _stat(stats, name) -> int:
+    try:
+        v = getattr(stats, name, None)
+        return int(v) if v is not None else 0
+    except Exception:  # noqa: BLE001 - backend-dependent attribute set
+        return 0
+
+
+def program_breakdown(compiled, params=None, opt_state=None) -> \
+        Optional[Dict[str, int]]:
+    """HBM breakdown of one compiled executable from
+    ``compiled.memory_analysis()`` (works on the CPU stub too).
+
+    ``argument`` covers every input buffer — params and optimizer state
+    live there; ``alias`` is the donated share (input bytes that reuse
+    output buffers, so they are NOT extra traffic); ``temp`` is the
+    scratch the program needs while running (activations, reduction
+    workspaces). ``transfers`` is the non-aliased argument+output
+    traffic — the bytes that actually cross into/out of the program.
+    """
+    try:
+        stats = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001 - not all backends implement it
+        logger.debug("memory_analysis unavailable", exc_info=True)
+        return None
+    if stats is None:
+        return None
+    argument = _stat(stats, "argument_size_in_bytes")
+    output = _stat(stats, "output_size_in_bytes")
+    alias = _stat(stats, "alias_size_in_bytes")
+    temp = _stat(stats, "temp_size_in_bytes")
+    code = _stat(stats, "generated_code_size_in_bytes")
+    params_b = _bytes_of_tree(params)
+    opt_b = _bytes_of_tree(opt_state)
+    return {
+        "params_bytes": params_b,
+        "opt_state_bytes": opt_b,
+        "activations_temp_bytes": temp,
+        "transfers_bytes": max(argument - alias, 0) + max(output - alias, 0),
+        "argument_bytes": argument,
+        "output_bytes": output,
+        "alias_bytes": alias,
+        "generated_code_bytes": code,
+        # peak-footprint approximation: live arguments + non-aliased
+        # outputs + scratch
+        "total_bytes": argument + max(output - alias, 0) + temp,
+    }
+
+
+def account_program(program: str, compiled, params=None, opt_state=None,
+                    hlo_text: Optional[str] = None) -> \
+        Optional[Dict[str, int]]:
+    """Record one compiled program's breakdown: gauges + forensics state.
+
+    ``program`` is a label value ("train"/"eval"/"predict"), never part
+    of a metric name.
+    """
+    bd = program_breakdown(compiled, params=params, opt_state=opt_state)
+    if bd is None:
+        return None
+    with _LOCK:
+        _PROGRAMS[program] = dict(bd)
+    telemetry.gauge("zoo_hbm_program_total_bytes",
+                    program=program).set(bd["total_bytes"])
+    telemetry.gauge("zoo_hbm_program_params_bytes",
+                    program=program).set(bd["params_bytes"])
+    telemetry.gauge("zoo_hbm_program_opt_state_bytes",
+                    program=program).set(bd["opt_state_bytes"])
+    telemetry.gauge("zoo_hbm_program_temp_bytes",
+                    program=program).set(bd["activations_temp_bytes"])
+    telemetry.gauge("zoo_hbm_program_transfer_bytes",
+                    program=program).set(bd["transfers_bytes"])
+    telemetry.event("memory/program_accounted", program=program,
+                    total_bytes=bd["total_bytes"],
+                    temp_bytes=bd["activations_temp_bytes"])
+    if hlo_text:
+        record_hlo(program, hlo_text)
+    return bd
+
+
+def record_hlo(program: str, text: str) -> None:
+    """Keep the tail of a program's HLO for the OOM post-mortem."""
+    if not text:
+        return
+    with _LOCK:
+        _HLO[program] = text[-HLO_TAIL_BYTES:]
+
+
+def program_breakdowns() -> Dict[str, Dict[str, int]]:
+    with _LOCK:
+        return {k: dict(v) for k, v in _PROGRAMS.items()}
+
+
+def poll_device_memory(devices: Optional[Sequence] = None,
+                       watermark_fraction: float = 0.0,
+                       out_dir: Optional[str] = None) -> \
+        Optional[Dict[str, Any]]:
+    """Read live allocator stats into ``zoo_hbm_*`` gauges.
+
+    Returns ``None`` on backends without ``memory_stats()`` (the CPU
+    stub). When ``watermark_fraction`` > 0 and any device's in-use
+    watermark crosses that share of its limit, an OOM-forensics dump is
+    written ONCE (latched for the process) so a run drifting toward OOM
+    leaves evidence before the allocator fails.
+    """
+    global _WATERMARK_LATCHED
+    if devices is None:
+        import jax
+        devices = jax.devices()
+    per_device = []
+    worst = 0.0
+    for d in devices:
+        stats_fn = getattr(d, "memory_stats", None)
+        stats = None
+        if callable(stats_fn):
+            try:
+                stats = stats_fn()
+            except Exception:  # noqa: BLE001 - backend quirk, not fatal
+                stats = None
+        if not stats:
+            continue
+        in_use = int(stats.get("bytes_in_use", 0))
+        peak = int(stats.get("peak_bytes_in_use", in_use))
+        limit = int(stats.get("bytes_limit", 0))
+        dev = str(getattr(d, "id", len(per_device)))
+        telemetry.gauge("zoo_hbm_bytes_in_use", device=dev).set(in_use)
+        telemetry.gauge("zoo_hbm_peak_bytes", device=dev).set(peak)
+        if limit:
+            telemetry.gauge("zoo_hbm_bytes_limit", device=dev).set(limit)
+            worst = max(worst, in_use / limit)
+        per_device.append({"device": dev, "bytes_in_use": in_use,
+                           "peak_bytes_in_use": peak, "bytes_limit": limit})
+    if not per_device:
+        return None
+    snapshot = {"per_device": per_device, "watermark_fraction": worst,
+                "ts": time.time()}
+    with _LOCK:
+        _LAST_DEVICE.clear()
+        _LAST_DEVICE.update(snapshot)
+    telemetry.gauge("zoo_hbm_watermark_fraction").set(worst)
+    if watermark_fraction > 0 and worst >= watermark_fraction \
+            and not _WATERMARK_LATCHED:
+        _WATERMARK_LATCHED = True
+        telemetry.event("memory/watermark_crossed", fraction=worst,
+                        threshold=watermark_fraction)
+        oom_forensics(
+            f"HBM watermark {worst:.3f} >= {watermark_fraction:.3f}",
+            out_dir=out_dir)
+    return snapshot
+
+
+def _looks_like_oom(exc: BaseException) -> bool:
+    msg = f"{type(exc).__name__}: {exc}".lower()
+    return any(m in msg for m in _OOM_MARKERS)
+
+
+def maybe_oom_forensics(exc: BaseException,
+                        out_dir: Optional[str] = None) -> Optional[str]:
+    """If ``exc`` smells like an allocation failure, write the OOM
+    post-mortem and return its path; otherwise do nothing."""
+    if not _looks_like_oom(exc):
+        return None
+    return oom_forensics(f"allocation failed: {type(exc).__name__}: {exc}",
+                         out_dir=out_dir)
+
+
+def oom_forensics(reason: str, out_dir: Optional[str] = None) -> \
+        Optional[str]:
+    """Write the memory post-mortem: per-program breakdowns, the last
+    device watermarks and each program's HLO tail, plus the standard
+    flight-recorder dump. Never raises."""
+    try:
+        telemetry.event("memory/oom_forensics", reason=reason)
+        telemetry.dump_flight(f"memory: {reason}", out_dir=out_dir)
+        base = out_dir or os.environ.get("ZOO_TPU_TRACE_DIR")
+        if base is None:
+            return None
+        debug = os.path.join(base, "debug")
+        os.makedirs(debug, exist_ok=True)
+        path = os.path.join(
+            debug, f"oom-{os.getpid()}-{int(time.time() * 1000)}.json")
+        with _LOCK:
+            payload = {
+                "reason": reason,
+                "ts": time.time(),
+                "programs": {k: dict(v) for k, v in _PROGRAMS.items()},
+                "device_memory": dict(_LAST_DEVICE),
+                "hlo_tail": dict(_HLO),
+            }
+        telemetry._atomic_write_json(path, payload)
+        logger.error("OOM forensics written to %s (%s)", path, reason)
+        return path
+    except Exception:  # noqa: BLE001 - forensics must not mask the OOM
+        logger.debug("oom forensics failed", exc_info=True)
+        return None
+
+
+def reset_for_tests() -> None:
+    global _WATERMARK_LATCHED
+    with _LOCK:
+        _PROGRAMS.clear()
+        _HLO.clear()
+        _LAST_DEVICE.clear()
+    _WATERMARK_LATCHED = False

@@ -94,6 +94,52 @@ class Ewma:
             return self.value
 
 
+class EwmaStd:
+    """Exponential moving mean *and* variance of a scalar stream
+    (West-style incremental moments), for z-score spike detection on
+    loss / grad-norm / step-time (pipeline/health.py).
+
+    ``zscore(x)`` answers "how many moving standard deviations is ``x``
+    from the moving mean", using the estimate BEFORE ``x`` is folded in
+    — an outlier must be scored against history, not against itself.
+    Returns 0.0 until ``min_samples`` observations have landed (cold
+    stream: no meaningful deviation estimate yet).  Thread-safe."""
+
+    def __init__(self, alpha: float = 0.1, min_samples: int = 5):
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError(f"alpha must be in (0, 1], got {alpha}")
+        self.alpha = float(alpha)
+        self.min_samples = int(min_samples)
+        self.mean = None
+        self.var = 0.0
+        self.n = 0
+        self._lock = threading.Lock()
+
+    def zscore(self, x: float) -> float:
+        with self._lock:
+            if self.mean is None or self.n < self.min_samples:
+                return 0.0
+            # floor the deviation estimate: a perfectly flat warmup
+            # (var→0) must not turn an epsilon wobble into a huge z
+            std = max(self.var, 1e-12) ** 0.5
+            std = max(std, 1e-6 * max(abs(self.mean), 1.0))
+            return (float(x) - self.mean) / std
+
+    def update(self, x: float) -> float:
+        with self._lock:
+            x = float(x)
+            self.n += 1
+            if self.mean is None:
+                self.mean = x
+                self.var = 0.0
+            else:
+                delta = x - self.mean
+                incr = self.alpha * delta
+                self.mean += incr
+                self.var = (1.0 - self.alpha) * (self.var + delta * incr)
+            return self.mean
+
+
 class InfeedMonitor:
     """Accumulates host-input wait time and reduces it per logging window.
 

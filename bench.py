@@ -114,6 +114,34 @@ def _gate(name, ok, detail=""):
     return bool(ok)
 
 
+# Bench trajectory: every completed run appends ONE json line here —
+# ts, platform, every scalar metric, and the failed gates — so
+# scripts/bench-compare can diff consecutive runs (or any run against
+# --baseline) and flag >10% regressions. BENCH_*.json snapshots alone
+# were never comparable: no tool read two of them side by side.
+HISTORY_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_HISTORY.jsonl")
+
+
+def _append_history():
+    try:
+        metrics = {k: v for k, v in RESULT.items()
+                   if isinstance(v, (int, float))
+                   and not isinstance(v, bool)}
+        row = {"ts": round(time.time(), 3),
+               "iso_ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+               "platform": RESULT.get("platform"),
+               "device_kind": RESULT.get("device_kind"),
+               "gates_failed": [g["gate"] for g in GATE_FAILURES],
+               "metrics": metrics}
+        with open(HISTORY_PATH, "a") as f:
+            f.write(json.dumps(row, sort_keys=True) + "\n")
+        print(f"# bench history: appended {len(metrics)} metrics to "
+              f"{HISTORY_PATH}", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 - history must not fail the run
+        print(f"# bench history append failed: {e}", file=sys.stderr)
+
+
 def _windows_stats(fn, n=3):
     """Run ``fn`` (one timed measurement window -> value) n times; return
     (median, {min, median, max}) so run-to-run tunnel noise is visible
@@ -1962,6 +1990,77 @@ def bench_telemetry_overhead(n_records=1200, batch_size=8, stub_ms=6.0,
     return out
 
 
+def bench_train_health_overhead(n_steps=48, warm_steps=8, batch=512,
+                                width=768, in_dim=128, reps=3,
+                                max_overhead=0.03):
+    """Training-health-overhead leg: the identical short fit with the
+    health monitor (pipeline/health.py) off vs on — telemetry enabled on
+    BOTH arms, so the delta isolates exactly what the monitor adds: the
+    on-device non-finite sentinel fused into the step, the per-dispatch
+    scalar fetch, and the EWMA window checks.  Interleaved reps, medians,
+    and a hard gate: the detect→dump→halt safety net must cost <= 3% of
+    training wall time (docs/observability.md), or nobody leaves it on.
+    """
+    from analytics_zoo_tpu.common.nncontext import (ZooConfig, ZooContext,
+                                                    set_nncontext)
+    from analytics_zoo_tpu.common.zoo_trigger import MaxIteration
+    from analytics_zoo_tpu.feature.feature_set import ArrayFeatureSet
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    from analytics_zoo_tpu.pipeline.api.keras.models import Sequential
+    from analytics_zoo_tpu.pipeline.estimator.estimator import Estimator
+    from analytics_zoo_tpu.utils import telemetry
+    from analytics_zoo_tpu.utils.profiling import device_sync
+
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((batch * 8, in_dim)).astype(np.float32)
+    y = rng.standard_normal((batch * 8, 1)).astype(np.float32)
+
+    def _run(health_on):
+        set_nncontext(None)
+        set_nncontext(ZooContext(ZooConfig(
+            telemetry=True, health_monitor=health_on,
+            compute_dtype=_bench_dtype())))
+        data = ArrayFeatureSet(x, y)
+        m = Sequential()
+        m.add(Dense(width, activation="relu", input_shape=(in_dim,)))
+        m.add(Dense(width, activation="relu"))
+        m.add(Dense(1))
+        est = Estimator(m, optim_methods="adam")
+        # warmup to absorb compile; sync so it can't leak into the window
+        est.train(data, criterion="mse", end_trigger=MaxIteration(warm_steps),
+                  batch_size=batch)
+        device_sync(est.trainer.params)
+        t0 = time.perf_counter()
+        est.train(data, criterion="mse",
+                  end_trigger=MaxIteration(warm_steps + n_steps),
+                  batch_size=batch)
+        device_sync(est.trainer.params)
+        return time.perf_counter() - t0
+
+    was_enabled = telemetry.enabled()
+    walls = {False: [], True: []}
+    try:
+        for _ in range(reps):           # interleaved: noise hits both arms
+            for on in (False, True):
+                walls[on].append(_run(on))
+    finally:
+        set_nncontext(None)
+        telemetry.configure(enabled=was_enabled)
+    off = float(np.median(walls[False]))
+    on = float(np.median(walls[True]))
+    frac = (on - off) / off
+    out = {
+        "train_health_off_wall_s": round(off, 4),
+        "train_health_on_wall_s": round(on, 4),
+        "train_health_off_steps_per_sec": round(n_steps / off, 2),
+        "train_health_on_steps_per_sec": round(n_steps / on, 2),
+        "train_health_overhead_fraction": round(frac, 4),
+    }
+    _gate("train_health_overhead_le_3pct", frac <= max_overhead,
+          f"overhead_fraction={frac:.4f} > {max_overhead}")
+    return out
+
+
 def bench_infeed(n_images=480, batch_size=32):
     """Image input-pipeline leg (SURVEY §7 hard-part (c)) — CPU-provable.
 
@@ -2651,6 +2750,23 @@ def main():
         _stamp_leg_artifacts("telemetry_overhead")
         emit()
 
+    # Training-health-overhead leg: identical short fit with the health
+    # monitor off vs on (telemetry on both arms), interleaved medians —
+    # the non-finite sentinel + EWMA watchdog must cost <= 3% of
+    # training wall time (docs/observability.md). CPU-provable.
+    if time.time() - T_START < TOTAL_BUDGET_S * 0.9:
+        try:
+            RESULT.update(bench_train_health_overhead())
+        except Exception as e:  # noqa: BLE001
+            import traceback
+            traceback.print_exc()
+            RESULT["train_health_overhead_error"] = (
+                str(e).splitlines()[0][:500] if str(e) else repr(e)[:500])
+            _gate("train_health_overhead_measured", False,
+                  RESULT["train_health_overhead_error"])
+        _stamp_leg_artifacts("train_health_overhead")
+        emit()
+
     # Input-pipeline leg — platform-independent (decode is host-side work
     # wherever the chips are), cheap, and the r5 CPU-provable evidence
     # for SURVEY §7 hard-part (c).
@@ -2726,6 +2842,7 @@ def main():
 
     RESULT["bench_gates_failed"] = GATE_FAILURES
     emit()
+    _append_history()
     print(json.dumps(RESULT))
     if GATE_FAILURES and os.environ.get("ZOO_BENCH_STRICT_GATES") == "1":
         sys.exit(1)

@@ -990,3 +990,63 @@ def test_attn_block_resolution(monkeypatch):
     monkeypatch.delenv("ZOO_TPU_ATTN_BLOCK_Q")
     monkeypatch.delenv("ZOO_TPU_ATTN_BLOCK_K")
     assert _resolve_blocks(640, 640, 512, 512) == (128, 128)
+
+
+# ---------------------------------------------------------------------------
+# compiled-memory property of ring attention (ROADMAP 4b down payment):
+# the point of sequence parallelism is the MEMORY curve, not just parity —
+# pin it with XLA's own memory_analysis() so a rewrite that silently
+# all-gathers K/V (correct output, quadratic memory) fails in CI.
+# ---------------------------------------------------------------------------
+
+
+def _compiled_temp_bytes(fn, *args):
+    """Temp (activation/workspace) bytes of the compiled program from
+    ``memory_analysis()`` — the same XLA accounting utils/memory.py
+    feeds into the HBM breakdown."""
+    compiled = jax.jit(fn).lower(*args).compile()
+    return int(compiled.memory_analysis().temp_size_in_bytes)
+
+
+def _seq_shards(mesh, seq_axis="seq"):
+    """The ring memory property is VACUOUS on a mesh that does not
+    shard the sequence axis — fail loudly rather than let config drift
+    turn the property test into a tautology."""
+    n = int(mesh.shape[seq_axis])
+    if n <= 1:
+        raise AssertionError(
+            f"degenerate mesh: axis {seq_axis!r} has size {n} — ring "
+            "attention degenerates to full attention and the memory "
+            "property asserts nothing")
+    return n
+
+
+def test_ring_attention_memory_scales_with_seq_shards():
+    """Reference attention must materialise the full B,H,L,L score
+    tensor in temp; the ring variant holds only per-shard L/n x L
+    blocks, so its compiled temp footprint stays well under one full
+    score tensor (measured on the CPU stub: ~0.7 MB vs ~33.5 MB at
+    L=1024, n=8)."""
+    mesh = make_mesh(data=1, seq=8)
+    _seq_shards(mesh)   # loud guard: property is vacuous on seq=1
+    b, h, l, d = 1, 4, 1024, 32
+    q, k, v = _qkv(b=b, h=h, l=l, d=d)
+    scores_bytes = b * h * l * l * np.dtype(np.float32).itemsize
+
+    ref_temp = _compiled_temp_bytes(attention_reference, q, k, v)
+    ring_temp = _compiled_temp_bytes(
+        lambda q, k, v: ring_attention_sharded(q, k, v, mesh), q, k, v)
+
+    # the reference really does pay for the quadratic score tensor...
+    assert ref_temp >= scores_bytes, (ref_temp, scores_bytes)
+    # ...and the ring program never materialises even half of one
+    assert ring_temp < scores_bytes // 2, (ring_temp, scores_bytes)
+    assert ring_temp * 8 <= ref_temp, (ring_temp, ref_temp)
+
+
+def test_ring_memory_property_rejects_degenerate_mesh():
+    """A mesh with seq=1 must make the property test fail loudly, not
+    silently compare two identical full-attention programs."""
+    mesh = make_mesh(data=8, seq=1)
+    with pytest.raises(AssertionError, match="degenerate mesh"):
+        _seq_shards(mesh)
