@@ -84,8 +84,15 @@ def _flash_remat_policy() -> str:
 # Reference implementation (also the CPU / short-sequence path)
 # ---------------------------------------------------------------------------
 
-def attention_reference(q, k, v, bias=None, causal=False, sm_scale=None):
-    """q,k,v: (B, H, L, D). bias broadcastable to (B, H, Lq, Lk)."""
+def attention_reference(q, k, v, bias=None, causal=False, sm_scale=None,
+                        q_offset=None):
+    """q,k,v: (B, H, L, D). bias broadcastable to (B, H, Lq, Lk).
+
+    ``q_offset`` places causal query row 0 at absolute key position
+    ``q_offset`` (row i attends keys <= q_offset + i). None keeps the
+    bottom-right alignment ``lk - lq`` — the decode/prefill default.
+    An explicit smaller offset is the chunked-prefill shape: a chunk of
+    rows mid-prompt attending a key buffer that extends past it."""
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
@@ -94,7 +101,8 @@ def attention_reference(q, k, v, bias=None, causal=False, sm_scale=None):
         logits = logits + bias.astype(logits.dtype)
     if causal:
         lq, lk = logits.shape[-2], logits.shape[-1]
-        mask = jnp.tril(jnp.ones((lq, lk), bool), k=lk - lq)
+        off = lk - lq if q_offset is None else int(q_offset)
+        mask = jnp.tril(jnp.ones((lq, lk), bool), k=off)
         logits = jnp.where(mask, logits, DEFAULT_MASK_VALUE)
     probs = jax.nn.softmax(logits, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
@@ -141,7 +149,8 @@ def _bw_bias_block(bias, start, size, axis, full):
     return bb
 
 
-def _blockwise_fwd_impl(q, k, v, bias, causal, sm_scale, block_k):
+def _blockwise_fwd_impl(q, k, v, bias, causal, sm_scale, block_k,
+                        q_offset=None):
     """Returns (o, m, l) with o: (B, H, Lq, d) and the per-row softmax
     max/denominator (B, H, Lq, 1) f32. m and l are kept separate (not
     folded into lse = m + log l): on a fully-masked causal row m is the
@@ -150,7 +159,9 @@ def _blockwise_fwd_impl(q, k, v, bias, causal, sm_scale, block_k):
     b, h, lq, d = q.shape
     lk = k.shape[2]
     nb = lk // block_k
-    offset = lk - lq  # bottom-right-aligned causal, reference semantics
+    # bottom-right-aligned causal by default, reference semantics; an
+    # explicit q_offset pins query row 0 elsewhere (chunked prefill)
+    offset = lk - lq if q_offset is None else int(q_offset)
     slice_k = bias is not None and bias.shape[3] == lk
 
     def step(carry, j):
@@ -189,7 +200,7 @@ def _blockwise_fwd_impl(q, k, v, bias, causal, sm_scale, block_k):
 
 
 def _blockwise_bwd_impl(q, k, v, bias, o, m, l, do, causal, sm_scale,
-                        block_q, block_k):
+                        block_q, block_k, q_offset=None):
     """Single-pass blockwise dq/dk/dv/dbias: ONE scan over key blocks
     rebuilds each (B, H, Lq, block_k) score tile exactly once — with the
     saved row max/denominator (p = exp(s - m) / l, the lse split, see
@@ -204,7 +215,7 @@ def _blockwise_bwd_impl(q, k, v, bias, o, m, l, do, causal, sm_scale,
     b, h, lq, d = q.shape
     lk = k.shape[2]
     nb = lk // block_k
-    offset = lk - lq
+    offset = lk - lq if q_offset is None else int(q_offset)
     # Fold the softmax denominator into the output cotangent once, out
     # here: with dof = do / l, every per-tile term that needed normalized
     # probs p = exp(s - m) / l works off the unnormalized exp(s - m)
@@ -295,33 +306,36 @@ def _blockwise_bwd_impl(q, k, v, bias, o, m, l, do, causal, sm_scale,
             dbias)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
 def _attention_blockwise(q, k, v, bias, causal, sm_scale, block_q,
-                         block_k):
-    return _blockwise_fwd_impl(q, k, v, bias, causal, sm_scale, block_k)[0]
+                         block_k, q_offset):
+    return _blockwise_fwd_impl(q, k, v, bias, causal, sm_scale, block_k,
+                               q_offset)[0]
 
 
-def _blockwise_fwd_rule(q, k, v, bias, causal, sm_scale, block_q, block_k):
+def _blockwise_fwd_rule(q, k, v, bias, causal, sm_scale, block_q, block_k,
+                        q_offset):
     # custom_vjp (not AD through the scan): jax would otherwise save every
     # per-step score block as a residual — O(L^2) again, just chunked.
     # Residuals are the flash set: inputs + (o, m, l).
     o, m, l = _blockwise_fwd_impl(q, k, v, bias, causal, sm_scale,
-                                  block_k)
+                                  block_k, q_offset)
     return o, (q, k, v, bias, o, m, l)
 
 
-def _blockwise_bwd_rule(causal, sm_scale, block_q, block_k, res, do):
+def _blockwise_bwd_rule(causal, sm_scale, block_q, block_k, q_offset, res,
+                        do):
     q, k, v, bias, o, m, l = res
     with jax.named_scope("attn_hot"):
         return _blockwise_bwd_impl(q, k, v, bias, o, m, l, do, causal,
-                                   sm_scale, block_q, block_k)
+                                   sm_scale, block_q, block_k, q_offset)
 
 
 _attention_blockwise.defvjp(_blockwise_fwd_rule, _blockwise_bwd_rule)
 
 
 def attention_blockwise(q, k, v, bias=None, causal=False, sm_scale=None,
-                        block_q=None, block_k=None):
+                        block_q=None, block_k=None, q_offset=None):
     """O(L)-memory XLA attention: q,k,v (B, H, L, D) -> (B, H, L, D).
 
     ``lax.scan`` over key blocks with online softmax in forward and a
@@ -342,9 +356,10 @@ def attention_blockwise(q, k, v, bias=None, causal=False, sm_scale=None,
         bq = block_q
     if block_k and block_k < lk and lk % block_k == 0:
         bk = block_k
+    off = None if q_offset is None else int(q_offset)
     with jax.named_scope("attn_hot"):
         return _attention_blockwise(q, k, v, bias, causal, sm_scale, bq,
-                                    bk)
+                                    bk, off)
 
 
 # ---------------------------------------------------------------------------
@@ -1199,7 +1214,7 @@ def _route_eligible(on_tpu, kb, lq, lk, d, causal) -> bool:
 
 
 def flash_attention_blhd(q, k, v, bias=None, causal=False, sm_scale=None,
-                         block_q=None, block_k=None):
+                         block_q=None, block_k=None, q_offset=None):
     """q,k,v: (B, L, H, D) -> (B, L, H, D) — the layout a fused QKV
     projection's reshape produces with no transpose. Kernel-eligible
     shapes run the blhd Pallas wrappers directly, which kills the
@@ -1217,7 +1232,12 @@ def flash_attention_blhd(q, k, v, bias=None, causal=False, sm_scale=None,
         sm_scale = 1.0 / math.sqrt(d)
     on_tpu = jax.default_backend() == "tpu" or _interpret_mode()
     kb = _as_key_bias(bias, b, lk) if on_tpu else None
-    eligible = (_route_eligible(on_tpu, kb, lq, lk, d, causal) and
+    # a non-default q_offset is the chunked-prefill rectangle; the Pallas
+    # wrappers hardcode the bottom-right alignment, so those shapes take
+    # the blockwise route (which threads the offset explicitly)
+    default_off = q_offset is None or int(q_offset) == lk - lq
+    eligible = (default_off and
+                _route_eligible(on_tpu, kb, lq, lk, d, causal) and
                 os.environ.get("ZOO_TPU_ATTN_LAYOUT", "blhd") != "bhld")
     block_q, block_k = _resolve_blocks(lq, lk, block_q, block_k)
     if eligible and _kernel_ok_for(b, h, lq, lk, d, causal, q.dtype,
@@ -1230,11 +1250,12 @@ def flash_attention_blhd(q, k, v, bias=None, causal=False, sm_scale=None,
 
     return tr(flash_attention(tr(q), tr(k), tr(v), bias=bias,
                               causal=causal, sm_scale=sm_scale,
-                              block_q=block_q, block_k=block_k))
+                              block_q=block_q, block_k=block_k,
+                              q_offset=q_offset))
 
 
 def flash_attention(q, k, v, bias=None, causal=False, sm_scale=None,
-                    block_q=None, block_k=None):
+                    block_q=None, block_k=None, q_offset=None):
     """q,k,v: (B, H, L, D) -> (B, H, L, D).
 
     Sequences of L >= KERNEL_MIN_SEQ (512, retuned r5 — ATTN_TUNE.jsonl)
@@ -1258,7 +1279,9 @@ def flash_attention(q, k, v, bias=None, causal=False, sm_scale=None,
     b, h, lq, d = q.shape
     lk = k.shape[2]
     kb = _as_key_bias(bias, b, lk) if on_tpu else None
-    eligible = _route_eligible(on_tpu, kb, lq, lk, d, causal)
+    default_off = q_offset is None or int(q_offset) == lk - lq
+    eligible = default_off and _route_eligible(on_tpu, kb, lq, lk, d,
+                                               causal)
     block_q, block_k = _resolve_blocks(lq, lk, block_q, block_k)
     use_kernel = eligible and _kernel_ok_for(b, h, lq, lk, d, causal,
                                              q.dtype, block_q, block_k)
@@ -1270,9 +1293,10 @@ def flash_attention(q, k, v, bias=None, causal=False, sm_scale=None,
             # score tile defeats the O(L) contract) — attention_blockwise
             # picks strictly-smaller blocks itself
             return attention_blockwise(q, k, v, bias=bias, causal=causal,
-                                       sm_scale=sm_scale)
+                                       sm_scale=sm_scale,
+                                       q_offset=q_offset)
         ref = functools.partial(attention_reference, causal=causal,
-                                sm_scale=sm_scale)
+                                sm_scale=sm_scale, q_offset=q_offset)
         # Remat only when the saved L^2 probs are big enough to threaten
         # HBM (they are saved once per transformer layer): measured on
         # v5e BERT-base, remat costs ~15% step time, while the saved-probs

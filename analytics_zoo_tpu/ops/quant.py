@@ -45,7 +45,7 @@ import numpy as np
 
 __all__ = ["QuantTensor", "quantize_weight", "matmul", "conv2d",
            "calibrating", "calibration_scales", "out_key",
-           "chain_requant"]
+           "chain_requant", "quantize_rows", "dequantize_rows"]
 
 
 @jax.tree_util.register_pytree_node_class
@@ -138,6 +138,26 @@ def quantize_weight(w, name: str = "") -> QuantTensor:
     scale = np.maximum(scale, 1e-12).astype(np.float32)
     q = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
     return QuantTensor(q, scale, None, name)
+
+
+def quantize_rows(x, axis: int = -1):
+    """Symmetric per-row int8 for *dynamic* tensors (KV-cache rows).
+
+    Unlike ``quantize_weight`` this runs under jit on traced values: each
+    slice along every axis but ``axis`` gets its own max-abs/127 scale, so
+    a single outlier token cannot flatten the resolution of its
+    neighbours. Returns ``(q int8, scale f32)`` with ``axis`` kept as a
+    size-1 dim on the scale so ``q * scale`` broadcasts back."""
+    amax = jnp.max(jnp.abs(x), axis=axis, keepdims=True)
+    scale = jnp.maximum(amax, 1e-12).astype(jnp.float32) / 127.0
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_rows(q, scale):
+    """Inverse of ``quantize_rows``: int8 rows back to f32."""
+    return q.astype(jnp.float32) * scale
 
 
 def chain_requant(act_scale, w_scale, next_act_scale) -> np.ndarray:

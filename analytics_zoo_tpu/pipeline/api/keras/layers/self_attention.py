@@ -490,7 +490,10 @@ class TransformerLayer(KerasLayer):
 
     def init_decode_state(self, batch, capacity, dtype=jnp.float32,
                           rng=None):
-        """Preallocate (B, S, H, D) K/V slabs for every block."""
+        """Preallocate (B, S, H, D) K/V slabs for every block.
+        ``dtype="int8"`` allocates quantized ``Int8KVSlab`` slabs — the
+        cache ops dequantize inside the attention einsums, so prefill /
+        decode_step / decode_chunk below run unchanged."""
         from .....ops.kv_cache import init_decode_state
         return init_decode_state(
             self.n_block, batch, capacity, self.n_head,
@@ -589,6 +592,60 @@ class TransformerLayer(KerasLayer):
                                v_cache=tuple(v_caches),
                                lengths=state.lengths + 1)
         return self.lm_logits(params, x[:, 0]), state
+
+    def decode_chunk(self, params, state, tokens, n_valid=None):
+        """Advance every slot C tokens in ONE rectangular attention step:
+        (B, C) ids -> ((B, C, vocab), state).
+
+        The two decode fast paths share this call. Chunked prefill feeds
+        prompt slices (C = chunk size; ``n_valid`` (B,) masks a ragged
+        final chunk — lengths advance by n_valid and the tail rows land
+        above the watermark, never attended, overwritten by the next
+        write). Speculative verification feeds [last, draft_1..draft_k]
+        (C = k + 1): row i's logits score draft i+1, row k is the bonus
+        token, and rejected suffixes roll back by plain ``lengths``
+        surgery since their rows also sit above the new watermark.
+
+        Row c embeds at position ``lengths + c`` and attends slab keys
+        ``<= lengths + c`` (``cached_attention_chunk``) — the jaxpr still
+        carries no (S, S) contraction, so the cached-decode bench gate
+        holds for any C < S.
+        """
+        from .....ops.kv_cache import cached_attention_chunk
+        self._require_decode_layout(params)
+        nh = self.n_head
+        d = self.hidden_size // nh
+        b, c = tokens.shape
+        pos = jnp.minimum(
+            state.lengths[:, None] + jnp.arange(c)[None, :],
+            self.seq_len - 1)
+        x = jnp.take(params["tok_emb"], tokens.astype(jnp.int32), axis=0)
+        x = x + jnp.take(params["pos_emb"], pos, axis=0)
+        k_caches, v_caches = [], []
+        new_lengths = state.lengths
+        for i in range(self.n_block):
+            p = params[f"block{i}"]
+            qkv = jnp.matmul(x, p["qkv_w"].astype(x.dtype)) + \
+                p["qkv_b"].astype(x.dtype)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            o, kc, vc, new_lengths = cached_attention_chunk(
+                q.reshape(b, c, nh, d), k.reshape(b, c, nh, d),
+                v.reshape(b, c, nh, d), state.k_cache[i],
+                state.v_cache[i], state.lengths, n_valid=n_valid)
+            k_caches.append(kc)
+            v_caches.append(vc)
+            a = jnp.matmul(o.reshape(b, c, self.hidden_size),
+                           p["proj_w"].astype(x.dtype)) + \
+                p["proj_b"].astype(x.dtype)
+            n = _dp_dropout_add_ln(a, x, p["ln1_g"], p["ln1_b"], None,
+                                   0.0, False)
+            m = self._ffn(p, n, False)
+            x = _dp_dropout_add_ln(m, n, p["ln2_g"], p["ln2_b"], None,
+                                   0.0, False)
+        state = state._replace(k_cache=tuple(k_caches),
+                               v_cache=tuple(v_caches),
+                               lengths=new_lengths)
+        return self.lm_logits(params, x), state
 
     def call(self, params, inputs, training=False, rng=None, **kw):
         e, mask_bias = self._embed(params, inputs, rng, training)

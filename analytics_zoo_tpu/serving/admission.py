@@ -64,6 +64,7 @@ class AdmissionController:
         self._record_ms = Ewma(alpha)   # per-record service time
         self._batch_ms = Ewma(alpha)    # per-dispatch wall time
         self._token_ms = Ewma(alpha)    # per-token decode step time
+        self._chunk_ms = Ewma(alpha)    # per-prefill-chunk wall time
         self._lock = threading.Lock()
         self.shed_deadline = 0
         self.shed_expired = 0
@@ -82,6 +83,13 @@ class AdmissionController:
         if n_tokens > 0:
             self._token_ms.update(float(seconds) * 1e3)
 
+    def observe_prefill_chunk(self, seconds: float):
+        """One chunked-prefill step (a fixed-size prompt slice fed
+        between decode steps) took ``seconds`` — maintains the per-chunk
+        estimate that lets ``admit_generate`` budget a long prompt as N
+        interleaved chunk-steps instead of one monolithic stall."""
+        self._chunk_ms.update(float(seconds) * 1e3)
+
     @property
     def record_ms(self) -> float:
         return self._record_ms.value or 0.0
@@ -95,6 +103,13 @@ class AdmissionController:
         """EWMA wall time of one decode step (every in-flight sequence
         advances one token per step, so this is also per-sequence)."""
         return self._token_ms.value or 0.0
+
+    @property
+    def chunk_ms(self) -> float:
+        """EWMA wall time of one prefill chunk; falls back to the batch
+        estimate before the first chunk has been observed (a monolithic
+        prefill is the degenerate one-chunk case)."""
+        return self._chunk_ms.value or self.batch_ms
 
     # -- decisions ------------------------------------------------------
     def estimate_wait_ms(self, backlog: int) -> float:
@@ -115,21 +130,36 @@ class AdmissionController:
         return True, None
 
     def admit_generate(self, slack_ms: Optional[float], max_new_tokens: int,
-                       queue_depth: int = 0
+                       queue_depth: int = 0, prefill_chunks: int = 1,
+                       tokens_per_step: float = 1.0
                        ) -> Tuple[bool, Optional[str]]:
         """Admission for a generate request: the EWMA deadline shed
         extended with the per-token service estimate. The request is
-        admitted only when prefill (≈ one batch) plus
-        ``max_new_tokens`` decode steps plus the wait for a free cache
-        slot (``queue_depth`` requests ahead, each worth one more
-        token-stream in front of us) fits its slack.  With no token
-        observations yet, only the batch/safety terms apply — never
-        shed on a guess with no data behind it.
+        admitted only when prefill plus its decode steps plus the wait
+        for a free cache slot (``queue_depth`` requests ahead, each
+        worth one more token-stream in front of us) fits its slack.
+
+        ``prefill_chunks`` budgets a chunked prompt as N *interleaved*
+        chunk-steps — each chunk shares a token boundary with one gang
+        decode step, so the request's own prefill timeline is
+        ``N * (chunk_ms + token_ms)``, not one monolithic stall.
+        ``tokens_per_step`` (> 1 under speculative decoding: accepted
+        drafts + 1 per verify step) divides the decode-step count — the
+        shed must reflect the real token timeline, or speculation's
+        speedup would be invisible to deadline admission.  With no
+        observations yet only the batch/safety terms apply — never shed
+        on a guess with no data behind it.
         """
         if slack_ms is None:
             return True, None
-        est = (self.batch_ms + self.safety_ms +
-               max(int(max_new_tokens), 1) * self.token_ms +
+        chunks = max(int(prefill_chunks), 1)
+        if chunks > 1:
+            prefill_est = chunks * (self.chunk_ms + self.token_ms)
+        else:
+            prefill_est = self.batch_ms
+        steps = math.ceil(max(int(max_new_tokens), 1) /
+                          max(float(tokens_per_step), 1.0))
+        est = (prefill_est + self.safety_ms + steps * self.token_ms +
                max(int(queue_depth), 0) * self.token_ms)
         if est > slack_ms:
             with self._lock:
@@ -173,6 +203,7 @@ class AdmissionController:
                     "est_record_ms": round(self.record_ms, 3),
                     "est_batch_ms": round(self.batch_ms, 3),
                     "est_token_ms": round(self.token_ms, 3),
+                    "est_chunk_ms": round(self.chunk_ms, 3),
                     "safety_ms": self.safety_ms}
 
 

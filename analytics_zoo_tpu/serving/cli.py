@@ -113,6 +113,16 @@ params:
 #   stub_ms_per_step: 1.0    # deterministic stub engine (smoke/bench);
 #                            # omit and inject a real engine via
 #                            # ClusterServing.set_generate_engine
+#   ## generative fast path (docs/serving-generate.md#fast-path)
+#   prefill_chunk: 0          # >0: long prompts prefill in chunks of
+#                             # this many tokens, interleaved with decode
+#   kv_cache: f32             # int8 = Int8KVSlab storage (0.375x bytes;
+#                             # applied by build_transformer_engine)
+#   prefix_cache_mb: 0        # >0: shared-prefix KV cache budget (MiB)
+#   speculative:              # draft-and-verify decode
+#     k: 0                    # draft tokens per round (0 = off)
+#     draft_ms_per_step: 0.1  # stub draft cost (device drafts are
+#                             # injected via set_generate_engine)
 
 ## model registry (docs/model-registry.md): uncomment to serve many
 ## named, versioned models with hot-swap + canary rollout
@@ -594,6 +604,35 @@ def cmd_status(workdir: str, watch: float = None) -> int:
     return 0
 
 
+def _print_generation(st: dict):
+    """One line per worker summarising the generative fast path:
+    occupancy, prefill dispatches, prefix-cache hit ratio and resident
+    bytes, draft acceptance."""
+    gen = st.get("generation")
+    if not gen:
+        return
+    line = (f"    generate: active={gen.get('active_slots', 0)}"
+            f"/{gen.get('capacity', 0)}cap "
+            f"queue={gen.get('queue_depth', 0)} "
+            f"tokens={gen.get('tokens', 0)} "
+            f"joins={gen.get('joins', 0)} shed={gen.get('shed', 0)}")
+    eng = gen.get("engine") or {}
+    target = eng.get("target") or {}
+    if "prefill_calls" in eng or "prefill_calls" in target:
+        line += (f" prefills="
+                 f"{eng.get('prefill_calls', target.get('prefill_calls'))}")
+    pc = eng.get("prefix_cache") or target.get("prefix_cache")
+    if pc:
+        total = pc.get("hits", 0) + pc.get("misses", 0)
+        ratio = pc.get("hits", 0) / total if total else 0.0
+        line += (f" prefix_hit={ratio:.0%}({pc.get('hits', 0)}/{total})"
+                 f" prefix_mb={pc.get('bytes', 0) / (1 << 20):.1f}")
+    if "acceptance_rate" in eng:
+        line += (f" draft_accept={eng['acceptance_rate']:.0%}"
+                 f" tok/step={eng.get('tokens_per_step', 1.0):.2f}")
+    print(line)
+
+
 def cmd_top(workdir: str, interval: float = 2.0,
             iterations: int = None) -> int:
     """Live fleet view (docs/observability.md#slo): qps (delta of
@@ -626,6 +665,7 @@ def cmd_top(workdir: str, interval: float = 2.0,
                       f"shed={st.get('shed', 0)} "
                       f"p50={e2e.get('p50', 0):.1f}ms "
                       f"p99={e2e.get('p99', 0):.1f}ms")
+                _print_generation(st)
                 _print_slo(st)
             if len(frames) > 1:
                 print(f"  fleet qps={total_qps:.1f}")

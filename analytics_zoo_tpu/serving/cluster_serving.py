@@ -280,6 +280,22 @@ class ClusterServingHelper:
         raw_gstub = gen.get("stub_ms_per_step")
         self.generate_stub_ms_per_step = \
             None if raw_gstub is None else float(raw_gstub)
+        # -- generative fast path (docs/serving-generate.md#fast-path) --
+        # chunked prefill width in tokens; 0 disables interleaving
+        self.generate_prefill_chunk = int(gen.get("prefill_chunk") or 0)
+        # KV slab dtype: "f32" (default) or "int8" (Int8KVSlab storage)
+        self.generate_kv_dtype = str(gen.get("kv_cache") or "f32").lower()
+        # shared-prefix cache budget in MiB; 0 disables the cache
+        self.generate_prefix_cache_mb = float(
+            gen.get("prefix_cache_mb") or 0)
+        # speculative decoding: {"k": 3, "draft_ms_per_step": 0.1}; the
+        # stub path builds a draft stub, the device path needs a draft
+        # engine injected via set_generate_engine
+        spec = gen.get("speculative") or {}
+        self.generate_speculative_k = int(spec.get("k") or 0)
+        raw_draft = spec.get("draft_ms_per_step")
+        self.generate_draft_ms_per_step = \
+            None if raw_draft is None else float(raw_draft)
         # -- model registry (docs/model-registry.md) --------------------
         reg = config.get("registry") or {}
         self.registry_root = reg.get("root")
@@ -525,6 +541,32 @@ class ClusterServing:
         self._gen_engine = engine
         return self
 
+    def build_transformer_engine(self, layer, params, max_len=None):
+        """Construct and inject a ``TransformerDecodeEngine`` honouring
+        the ``generate`` config block: ``kv_cache: int8`` selects
+        ``Int8KVSlab`` storage, ``prefix_cache_mb`` attaches a
+        shared-prefix cache, ``speculative.k`` is NOT applied here (a
+        device draft model must be paired explicitly — wrap with
+        ``SpeculativeDecodeEngine`` before injecting)."""
+        from .generation import TransformerDecodeEngine
+
+        kv = str(getattr(self.helper, "generate_kv_dtype", "f32")).lower()
+        engine = TransformerDecodeEngine(
+            layer, params,
+            max_len=max_len or getattr(self.helper, "generate_max_len",
+                                       None),
+            kv_dtype="int8" if kv == "int8" else None,
+            prefix_cache=self._prefix_cache())
+        return self.set_generate_engine(engine)
+
+    def _prefix_cache(self):
+        mb = float(getattr(self.helper, "generate_prefix_cache_mb", 0))
+        if mb <= 0:
+            return None
+        from .generation import PrefixCache
+
+        return PrefixCache(max_bytes=int(mb * (1 << 20)))
+
     def _generate_engine(self):
         if self._gen_engine is None and \
                 getattr(self.helper, "generate_stub_ms_per_step",
@@ -532,11 +574,26 @@ class ClusterServing:
             from .generation import StubDecodeEngine
             from ..ops.kv_cache import cache_length_buckets
 
+            buckets = cache_length_buckets(self.helper.generate_max_len)
             self._gen_engine = StubDecodeEngine(
                 ms_per_step=self.helper.generate_stub_ms_per_step,
                 stop_id=self.helper.generate_stop_id or 0,
-                capacity_buckets=cache_length_buckets(
-                    self.helper.generate_max_len))
+                capacity_buckets=buckets,
+                prefix_cache=self._prefix_cache())
+            k = int(getattr(self.helper, "generate_speculative_k", 0))
+            if k > 0:
+                from .generation import SpeculativeDecodeEngine
+
+                draft_ms = getattr(self.helper,
+                                   "generate_draft_ms_per_step", None)
+                if draft_ms is None:
+                    draft_ms = self.helper.generate_stub_ms_per_step / 10.0
+                draft = StubDecodeEngine(
+                    ms_per_step=draft_ms,
+                    stop_id=self.helper.generate_stop_id or 0,
+                    capacity_buckets=buckets)
+                self._gen_engine = SpeculativeDecodeEngine(
+                    self._gen_engine, draft, k=k)
         return self._gen_engine
 
     def _gen_scheduler(self):
@@ -557,7 +614,9 @@ class ClusterServing:
                     engine, commit=self._gen_commit, max_slots=slots,
                     continuous=bool(getattr(self.helper,
                                             "generate_continuous", True)),
-                    admission=self.admission, batcher=batcher).start()
+                    admission=self.admission, batcher=batcher,
+                    prefill_chunk=int(getattr(
+                        self.helper, "generate_prefill_chunk", 0))).start()
             return self._gen_sched
 
     def _gen_commit(self, uri: str, payload: dict):

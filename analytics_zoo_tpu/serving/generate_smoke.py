@@ -16,8 +16,15 @@ overlapping generate requests over the in-process transport —
   one committed payload (queried twice: present once, absent after the
   pop) and the scheduler counts zero duplicate commits.
 
+A second phase re-serves with the generative fast path configured
+(``prefill_chunk`` + ``speculative``) and asserts the two config-driven
+legs: a **long prompt** joins through chunked prefill with the exact
+same stream a monolithic join produces, and **speculative decoding**
+emits the exact greedy stream while verifying multiple tokens per
+target step (acceptance rate lands in the stats).
+
 Exit 0 on success, 1 on any violated invariant, printing one JSON line
-of pipeline stats either way.
+of pipeline stats per phase either way.
 
 Usage::
 
@@ -31,6 +38,64 @@ import json
 import os
 import sys
 import time
+
+
+def _fastpath_phase(args, failures):
+    """Chunked-prefill + speculative-decode legs over the wire path."""
+    from .client import GenerationResult, InputQueue, OutputQueue
+    from .cluster_serving import ClusterServing, ClusterServingHelper
+    from .queue_backend import InProcessStreamQueue
+
+    chunk = 16
+    helper = ClusterServingHelper(config={
+        "data": {},
+        "params": {"batch_size": 4},
+        "generate": {"slots": 2, "continuous": True,
+                     "stub_ms_per_step": args.step_ms, "stop_id": 0,
+                     "max_len": 1024,
+                     "prefill_chunk": chunk,
+                     "speculative": {"k": 3,
+                                     "draft_ms_per_step":
+                                         args.step_ms / 20.0}}})
+    backend = InProcessStreamQueue()
+    serving = ClusterServing(model=None, helper=helper,
+                             backend=backend).start()
+    in_q = InputQueue(backend=backend)
+    out_q = OutputQueue(backend=backend)
+    try:
+        # C: 120-token prompt > prefill_chunk — joins in ceil(120/16)
+        # interleaved chunk dispatches; stub stream base = prompt[0]
+        in_q.enqueue_generate("gen-C", [7] + [0] * 119, max_new_tokens=6)
+        # D: short prompt with a scripted stop mid-speculation round
+        in_q.enqueue_generate("gen-D", [50, 3], max_new_tokens=20,
+                              stop_id=0)
+        got = out_q.wait_all(["gen-C", "gen-D"], timeout=args.timeout)
+    finally:
+        serving.stop()
+
+    stats = serving.pipeline_stats()
+    gen = stats.get("generation", {})
+    c, d = got.get("gen-C"), got.get("gen-D")
+    if not isinstance(c, GenerationResult) or \
+            c.tolist() != list(range(8, 14)):
+        failures.append(f"long-prompt chunked stream wrong: "
+                        f"{getattr(c, 'tolist', lambda: c)()}")
+    if not isinstance(d, GenerationResult) or d.tolist() != [51, 52, 0] \
+            or d.finish != "stop_id":
+        failures.append(f"speculative stop stream wrong: "
+                        f"{getattr(d, 'tolist', lambda: d)()}")
+    eng = gen.get("engine") or {}
+    if eng.get("acceptance_rate", 0) < 1.0:
+        failures.append(f"stub draft acceptance {eng.get('acceptance_rate')}"
+                        f" != 1.0")
+    target = eng.get("target") or {}
+    # chunked join dispatches: ceil(120/16) chunks for C + 1 join for D
+    want = -(-120 // chunk) + 1
+    if target.get("prefill_calls") != want:
+        failures.append(f"prefill dispatches {target.get('prefill_calls')}"
+                        f" != {want} (chunked join not engaged?)")
+    print(json.dumps(stats))
+    return gen
 
 
 def main(argv=None) -> int:
@@ -122,12 +187,15 @@ def main(argv=None) -> int:
                         f"submitted={gen.get('submitted')}")
 
     print(json.dumps(stats))
+    gen2 = _fastpath_phase(args, failures)
     if failures:
         print("SMOKE FAILED: " + "; ".join(failures), file=sys.stderr)
         return 1
-    print(f"SMOKE OK: 2 sequences, {gen.get('tokens', 0)} tokens, "
-          f"join-mid-generation + stop-token eviction + exactly-once "
-          f"all held", file=sys.stderr)
+    print(f"SMOKE OK: 4 sequences, "
+          f"{gen.get('tokens', 0) + gen2.get('tokens', 0)} tokens, "
+          f"join-mid-generation + stop-token eviction + exactly-once + "
+          f"chunked long-prompt join + speculative decode all held",
+          file=sys.stderr)
     return 0
 
 

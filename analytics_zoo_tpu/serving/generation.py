@@ -28,16 +28,38 @@ Two engines implement the gang interface: ``TransformerDecodeEngine``
 costs a flat ``ms_per_step`` regardless of gang width — the
 MXU-amortization property that makes continuous batching pay; the
 bench ``generation`` leg and the fast-tier smoke run on it).
+
+On top of the base gang interface the engines expose a **generative
+fast path**, each piece optional and independently degradable:
+
+- **batched joins** (``join_batch``): concurrent arrivals prefill as
+  one padded dispatch instead of N sequential batch-1 prefills;
+- **chunked prefill** (``prefill_chunk``): a long prompt splits into
+  fixed-width chunks interleaved with the running gang's decode steps,
+  bounding the inter-token stall a long joiner inflicts on everyone
+  else (the scheduler advances one chunk per token boundary);
+- **speculative decoding** (``SpeculativeDecodeEngine``): a cheap
+  draft proposes ``k`` tokens per round and the target verifies them
+  in one rectangular ``step_chunk``; greedy output is token-for-token
+  identical to plain decode (Leviathan et al., 2023);
+- **shared-prefix cache** (``PrefixCache``): a content-hash hit
+  splices previously computed KV rows into the joiner's slot —
+  ``prefill_calls`` does not move;
+- **int8 KV slabs** (``kv_dtype="int8"`` on the transformer engine):
+  ``ops/kv_cache.Int8KVSlab`` storage at 0.375x the f32 bytes.
 """
 
 from __future__ import annotations
 
+import hashlib
 import logging
+import math
 import queue
 import threading
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -90,6 +112,81 @@ class _Slot:
     t_first_token: Optional[float] = None
     t_tokens: List[float] = field(default_factory=list)
     finish: Optional[str] = None
+    prefill_next: Optional[int] = None  # next chunk start; None = done
+
+
+# ---------------------------------------------------------------------------
+# shared-prefix cache
+# ---------------------------------------------------------------------------
+
+def prompt_key(prompt: np.ndarray) -> str:
+    """Content hash of a prompt token sequence (the cache key)."""
+    p = np.ascontiguousarray(np.asarray(prompt, np.int64).ravel())
+    return hashlib.sha1(p.tobytes()).hexdigest()
+
+
+class PrefixCache:
+    """LRU map from prompt content-hash to a prefilled-KV payload.
+
+    A hit lets a joiner splice previously computed rows straight into
+    its slot (``place_slot``) instead of re-running prefill — the
+    dominant cost for agent/template workloads where many requests
+    share a long system prompt. Payloads are engine-specific (the
+    transformer engine stores per-layer K/V rows, possibly already
+    int8-quantized, plus the last-token logits row; the stub stores its
+    scripted stream state); the cache only tracks recency and bytes.
+
+    ``lookup`` is the *only* place hit/miss counters move — engines
+    call it exactly once per join attempt, so the telemetry counters
+    are a true hit ratio. Not thread-safe beyond the scheduler-loop
+    single-writer pattern it lives in.
+    """
+
+    def __init__(self, max_bytes: int = 256 << 20):
+        self.max_bytes = int(max_bytes)
+        self._entries: "OrderedDict[str, Tuple[object, int]]" = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def nbytes(self) -> int:
+        return self._bytes
+
+    def lookup(self, prompt: np.ndarray):
+        """Return the cached payload or None; counts the hit/miss."""
+        key = prompt_key(prompt)
+        entry = self._entries.get(key)
+        if entry is None:
+            self.misses += 1
+            telemetry.counter("zoo_generate_prefix_cache_misses_total").inc()
+            return None
+        self._entries.move_to_end(key)
+        self.hits += 1
+        telemetry.counter("zoo_generate_prefix_cache_hits_total").inc()
+        return entry[0]
+
+    def insert(self, prompt: np.ndarray, payload, nbytes: int):
+        key = prompt_key(prompt)
+        if key in self._entries:
+            _, old = self._entries.pop(key)
+            self._bytes -= old
+        nbytes = int(nbytes)
+        if nbytes > self.max_bytes:
+            return
+        self._entries[key] = (payload, nbytes)
+        self._bytes += nbytes
+        while self._bytes > self.max_bytes and len(self._entries) > 1:
+            _, (_, evicted) = self._entries.popitem(last=False)
+            self._bytes -= evicted
+        telemetry.gauge("zoo_generate_prefix_cache_bytes").set(self._bytes)
+
+    def stats(self) -> dict:
+        return {"hits": self.hits, "misses": self.misses,
+                "entries": len(self._entries), "bytes": self._bytes}
 
 
 # ---------------------------------------------------------------------------
@@ -106,16 +203,31 @@ class StubDecodeEngine:
     script stop-token eviction per request. ``step()`` sleeps a flat
     ``ms_per_step`` for the *whole gang* (device-like cost: one MXU
     pass per token boundary, amortized over every active slot) and
-    ``join()`` sleeps ``ms_per_prefill`` once.
+    ``join()`` sleeps ``ms_per_prefill + ms_per_prefill_token * Lp``
+    once.
+
+    Fast-path knobs mirror the device engine's cost shape:
+    ``join_batch`` costs one prefill of the *longest* member (padded
+    batch on the MXU); ``prefill_chunk`` costs only its own tokens;
+    ``step_chunk`` costs one flat gang pass regardless of width. A
+    ``draft_skew > 0`` makes every ``draft_skew``-th stream token come
+    out wrong — an imperfect-draft injector for speculation tests.
     """
 
     def __init__(self, ms_per_step: float = 1.0,
                  ms_per_prefill: float = 0.0, stop_id: int = 0,
-                 capacity_buckets: Optional[Sequence[int]] = None):
+                 capacity_buckets: Optional[Sequence[int]] = None,
+                 ms_per_prefill_token: float = 0.0,
+                 draft_skew: int = 0,
+                 prefix_cache: Optional[PrefixCache] = None):
         self.ms_per_step = float(ms_per_step)
         self.ms_per_prefill = float(ms_per_prefill)
+        self.ms_per_prefill_token = float(ms_per_prefill_token)
         self.stop_id = int(stop_id)
+        self.draft_skew = int(draft_skew)
+        self.prefix_cache = prefix_cache
         self.buckets = list(capacity_buckets or cache_length_buckets(1024))
+        self.prefill_calls = 0
 
     def alloc(self, nslots: int, capacity: int):
         # per-slot [base, emitted, stop_at]; None = free
@@ -124,16 +236,79 @@ class StubDecodeEngine:
     def grow(self, state, capacity: int):
         return state
 
-    def join(self, state, slot: int, req: GenRequest):
-        if self.ms_per_prefill > 0:
-            time.sleep(self.ms_per_prefill / 1e3)
+    # -- stream helpers ---------------------------------------------------
+    @staticmethod
+    def _entry(req: GenRequest):
         p = req.prompt
         base = int(p[0]) if p.size else 0
         stop_at = int(p[1]) if p.size > 1 and int(p[1]) > 0 else None
-        state[slot] = [base, 1, stop_at]
-        first = self.stop_id if stop_at == 1 else base + 1
-        return state, first
+        return [base, 1, stop_at]
 
+    def _stream(self, entry, pos: int) -> int:
+        base, _, stop_at = entry
+        if stop_at == pos:
+            return self.stop_id
+        tok = base + pos
+        if self.draft_skew > 0 and pos % self.draft_skew == 0:
+            tok += 1                     # scripted draft mistake
+        return tok
+
+    def _prefill_sleep(self, n_tokens: int, base: bool = True):
+        ms = (self.ms_per_prefill if base else 0.0) \
+            + self.ms_per_prefill_token * n_tokens
+        if ms > 0:
+            time.sleep(ms / 1e3)
+
+    # -- joins ------------------------------------------------------------
+    def join(self, state, slot: int, req: GenRequest):
+        self._prefill_sleep(int(req.prompt.size))
+        self.prefill_calls += 1
+        state[slot] = self._entry(req)
+        if self.prefix_cache is not None:
+            self.prefix_cache.insert(req.prompt, tuple(state[slot]),
+                                     int(req.prompt.size) * 8)
+        return state, self._stream(state[slot], 1)
+
+    def join_batch(self, state, joins: Sequence[Tuple[int, GenRequest]]):
+        """One fused prefill dispatch: padded-batch cost is the longest
+        member's, not the sum — the batched-join win."""
+        longest = max(int(r.prompt.size) for _, r in joins)
+        self._prefill_sleep(longest)
+        self.prefill_calls += 1
+        out = {}
+        for slot, req in joins:
+            state[slot] = self._entry(req)
+            if self.prefix_cache is not None:
+                self.prefix_cache.insert(req.prompt, tuple(state[slot]),
+                                         int(req.prompt.size) * 8)
+            out[slot] = self._stream(state[slot], 1)
+        return state, out
+
+    def try_cached_join(self, state, slot: int, req: GenRequest):
+        """Prefix-cache hit path: no sleep, no ``prefill_calls``."""
+        if self.prefix_cache is None:
+            return None
+        payload = self.prefix_cache.lookup(req.prompt)
+        if payload is None:
+            return None
+        state[slot] = [payload[0], 1, payload[2]]
+        return state, self._stream(state[slot], 1)
+
+    def prefill_chunk(self, state, slot: int, req: GenRequest,
+                      start: int, end: int, is_last: bool):
+        """Advance one prompt chunk; emits the first token only when
+        the last chunk lands."""
+        self._prefill_sleep(end - start, base=(start == 0))
+        self.prefill_calls += 1          # one dispatch per chunk
+        if not is_last:
+            return state, None
+        state[slot] = self._entry(req)
+        if self.prefix_cache is not None:
+            self.prefix_cache.insert(req.prompt, tuple(state[slot]),
+                                     int(req.prompt.size) * 8)
+        return state, self._stream(state[slot], 1)
+
+    # -- decode -----------------------------------------------------------
     def step(self, state, feeds: Dict[int, int],
              temps: Dict[int, float]):
         """Advance every fed slot one token; flat gang-wide cost."""
@@ -141,15 +316,49 @@ class StubDecodeEngine:
             time.sleep(self.ms_per_step / 1e3)
         out = {}
         for slot in feeds:
-            base, emitted, stop_at = state[slot]
-            emitted += 1
-            state[slot][1] = emitted
-            out[slot] = self.stop_id if stop_at == emitted else base + emitted
+            entry = state[slot]
+            entry[1] += 1
+            out[slot] = self._stream(entry, entry[1])
         return state, out
+
+    def step_chunk(self, state, feeds: Dict[int, List[int]],
+                   temps: Dict[int, float]):
+        """Rectangular gang step: C fed tokens per slot, C predictions
+        back (row i predicts the token after prefix+feeds[:i+1]), one
+        flat gang-wide cost. ``draft_skew`` never applies here — the
+        verifier is the ground-truth stream."""
+        if self.ms_per_step > 0:
+            time.sleep(self.ms_per_step / 1e3)
+        out = {}
+        for slot, toks in feeds.items():
+            entry = state[slot]
+            base, emitted, stop_at = entry
+            preds = []
+            for i in range(len(toks)):
+                pos = emitted + 1 + i
+                preds.append(self.stop_id if stop_at == pos
+                             else base + pos)
+            entry[1] = emitted + len(toks)
+            out[slot] = preds
+        return state, out
+
+    def rollback(self, state, drops: Dict[int, int]):
+        """Drop the trailing ``drops[slot]`` committed rows (the
+        rejected speculative suffix)."""
+        for slot, n in drops.items():
+            if n > 0 and state[slot] is not None:
+                state[slot][1] -= int(n)
+        return state
 
     def evict(self, state, slot: int):
         state[slot] = None
         return state
+
+    def stats(self) -> dict:
+        out = {"prefill_calls": self.prefill_calls}
+        if self.prefix_cache is not None:
+            out["prefix_cache"] = self.prefix_cache.stats()
+        return out
 
 
 class TransformerDecodeEngine:
@@ -161,10 +370,17 @@ class TransformerDecodeEngine:
     the running gang never recomputes. Freed slots sit at length 0:
     their rows are masked out of every step, and whatever the dead slot
     keeps emitting is discarded by the scheduler.
+
+    ``kv_dtype="int8"`` allocates ``Int8KVSlab`` caches (0.375x f32
+    bytes per slot); all fast-path verbs are slab-polymorphic. A
+    ``prefix_cache`` stores per-layer slot rows + the last-token logits
+    row at join time; a hit splices them back via ``place_slot`` with
+    no prefill dispatch (watch ``prefill_calls`` stand still).
     """
 
     def __init__(self, layer, params, max_len: Optional[int] = None,
-                 rng=None):
+                 rng=None, kv_dtype=None,
+                 prefix_cache: Optional[PrefixCache] = None):
         import jax
         import jax.numpy as jnp
 
@@ -174,19 +390,28 @@ class TransformerDecodeEngine:
             max_len or layer.seq_len, min_bucket=min(128, layer.seq_len))
         self._jnp = jnp
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+        self.kv_dtype = "int8" if kv_dtype in ("int8", jnp.int8) \
+            else (kv_dtype or jnp.float32)
+        self.prefix_cache = prefix_cache
+        self.prefill_calls = 0
         self._step_fn = jax.jit(lambda p, s, t: layer.decode_step(p, s, t))
+        self._chunk_fn = jax.jit(
+            lambda p, s, t, nv: layer.decode_chunk(p, s, t, n_valid=nv))
+        # slot -> (batch-1 state, chunk width) for in-flight chunked joins
+        self._pending: Dict[int, tuple] = {}
 
     def alloc(self, nslots: int, capacity: int):
-        return self.layer.init_decode_state(nslots, capacity)
+        return self.layer.init_decode_state(nslots, capacity,
+                                            dtype=self.kv_dtype)
 
     def grow(self, state, capacity: int):
-        jnp = self._jnp
+        from ..ops.kv_cache import grow_slab
+
         if capacity <= state.capacity:
             return state
-        pad = [(0, 0), (0, capacity - state.capacity), (0, 0), (0, 0)]
         return state._replace(
-            k_cache=tuple(jnp.pad(k, pad) for k in state.k_cache),
-            v_cache=tuple(jnp.pad(v, pad) for v in state.v_cache))
+            k_cache=tuple(grow_slab(k, capacity) for k in state.k_cache),
+            v_cache=tuple(grow_slab(v, capacity) for v in state.v_cache))
 
     def _pick(self, logits, temperature: float) -> int:
         import jax
@@ -197,23 +422,127 @@ class TransformerDecodeEngine:
                 sub, logits.astype(self._jnp.float32) / temperature))
         return int(self._jnp.argmax(logits))
 
-    def join(self, state, slot: int, req: GenRequest):
+    # -- join helpers -----------------------------------------------------
+    def _slot_rows(self, slab, b: int, lp: int):
+        """Extract one sequence's first ``lp`` K/V rows from a batch
+        slab — the prefix-cache payload / splice unit."""
+        from ..ops.kv_cache import Int8KVSlab
+
+        if isinstance(slab, Int8KVSlab):
+            return Int8KVSlab(slab.q[b, :lp], slab.scale[b, :lp])
+        return slab[b, :lp]
+
+    def _splice(self, state, slot: int, k_rows, v_rows, lp: int):
         from ..ops.kv_cache import place_slot
 
+        return state._replace(
+            k_cache=tuple(place_slot(k, slot, r)
+                          for k, r in zip(state.k_cache, k_rows)),
+            v_cache=tuple(place_slot(v, slot, r)
+                          for v, r in zip(state.v_cache, v_rows)),
+            lengths=state.lengths.at[slot].set(lp))
+
+    def _cache_insert(self, req: GenRequest, st1, last_logits, b: int = 0):
+        if self.prefix_cache is None:
+            return
+        import jax
+
+        lp = int(req.prompt.size)
+        k_rows = tuple(self._slot_rows(k, b, lp) for k in st1.k_cache)
+        v_rows = tuple(self._slot_rows(v, b, lp) for v in st1.v_cache)
+        payload = (k_rows, v_rows, last_logits)
+        nbytes = sum(x.nbytes for x in jax.tree.leaves(payload))
+        self.prefix_cache.insert(req.prompt, payload, nbytes)
+
+    def join(self, state, slot: int, req: GenRequest):
         jnp = self._jnp
         st1 = self.layer.init_decode_state(1, state.capacity,
-                                           dtype=state.k_cache[0].dtype)
+                                           dtype=self.kv_dtype)
         logits, st1 = self.layer.prefill(
             self.params, jnp.asarray(req.prompt, jnp.int32)[None],
             jnp.array([req.prompt.size], jnp.int32), st1)
-        state = state._replace(
-            k_cache=tuple(place_slot(k, slot, s1[0])
-                          for k, s1 in zip(state.k_cache, st1.k_cache)),
-            v_cache=tuple(place_slot(v, slot, s1[0])
-                          for v, s1 in zip(state.v_cache, st1.v_cache)),
-            lengths=state.lengths.at[slot].set(int(req.prompt.size)))
+        self.prefill_calls += 1
+        lp = int(req.prompt.size)
+        state = self._splice(
+            state, slot,
+            tuple(self._slot_rows(k, 0, lp) for k in st1.k_cache),
+            tuple(self._slot_rows(v, 0, lp) for v in st1.v_cache), lp)
+        self._cache_insert(req, st1, logits[0])
         return state, self._pick(logits[0], req.temperature)
 
+    def join_batch(self, state, joins: Sequence[Tuple[int, GenRequest]]):
+        """Prefill every joiner in ONE padded dispatch, then splice each
+        sequence's rows into its gang slot. One compile per distinct
+        join-batch width (bounded by ``max_slots``)."""
+        jnp = self._jnp
+        n = len(joins)
+        longest = max(int(r.prompt.size) for _, r in joins)
+        toks = np.zeros((n, longest), np.int32)
+        lens = np.zeros((n,), np.int32)
+        for j, (_, req) in enumerate(joins):
+            toks[j, :req.prompt.size] = req.prompt
+            lens[j] = req.prompt.size
+        stn = self.layer.init_decode_state(n, state.capacity,
+                                           dtype=self.kv_dtype)
+        logits, stn = self.layer.prefill(
+            self.params, jnp.asarray(toks), jnp.asarray(lens), stn)
+        self.prefill_calls += 1
+        out = {}
+        for j, (slot, req) in enumerate(joins):
+            lp = int(req.prompt.size)
+            state = self._splice(
+                state, slot,
+                tuple(self._slot_rows(k, j, lp) for k in stn.k_cache),
+                tuple(self._slot_rows(v, j, lp) for v in stn.v_cache), lp)
+            self._cache_insert(req, stn, logits[j], b=j)
+            out[slot] = self._pick(logits[j], req.temperature)
+        return state, out
+
+    def try_cached_join(self, state, slot: int, req: GenRequest):
+        """Splice cached rows; None on miss. No prefill dispatch."""
+        if self.prefix_cache is None:
+            return None
+        hit = self.prefix_cache.lookup(req.prompt)
+        if hit is None:
+            return None
+        k_rows, v_rows, last_logits = hit
+        state = self._splice(state, slot, k_rows, v_rows,
+                             int(req.prompt.size))
+        return state, self._pick(last_logits, req.temperature)
+
+    def prefill_chunk(self, state, slot: int, req: GenRequest,
+                      start: int, end: int, is_last: bool):
+        """Advance one fixed-width prompt chunk on a batch-1 side state
+        (the running gang is untouched until the final splice). The
+        last (possibly ragged) chunk pads to the established width and
+        masks via ``n_valid``, keeping one jit signature per width."""
+        jnp = self._jnp
+        if start == 0:
+            st1 = self.layer.init_decode_state(1, state.capacity,
+                                               dtype=self.kv_dtype)
+            self._pending[slot] = (st1, end - start)
+        st1, width = self._pending[slot]
+        n_valid = end - start
+        buf = np.zeros((1, width), np.int32)
+        buf[0, :n_valid] = req.prompt[start:end]
+        logits, st1 = self._chunk_fn(
+            self.params, st1, jnp.asarray(buf),
+            jnp.full((1,), n_valid, jnp.int32))
+        self.prefill_calls += 1
+        self._pending[slot] = (st1, width)
+        if not is_last:
+            return state, None
+        del self._pending[slot]
+        lp = int(req.prompt.size)
+        state = self._splice(
+            state, slot,
+            tuple(self._slot_rows(k, 0, lp) for k in st1.k_cache),
+            tuple(self._slot_rows(v, 0, lp) for v in st1.v_cache), lp)
+        last_logits = logits[0, n_valid - 1]
+        self._cache_insert(req, st1, last_logits)
+        return state, self._pick(last_logits, req.temperature)
+
+    # -- decode -----------------------------------------------------------
     def step(self, state, feeds: Dict[int, int],
              temps: Dict[int, float]):
         jnp = self._jnp
@@ -226,10 +555,173 @@ class TransformerDecodeEngine:
                for slot in feeds}
         return state, out
 
+    def step_chunk(self, state, feeds: Dict[int, List[int]],
+                   temps: Dict[int, float]):
+        """Rectangular gang step (speculative verification): C fed
+        tokens per slot through one ``decode_chunk``, C per-row
+        predictions back. Row 0 honours the slot's temperature (it is
+        the one guaranteed-emitted token); rows 1.. are the greedy
+        verification lane."""
+        jnp = self._jnp
+        width = len(next(iter(feeds.values())))
+        tokens = np.zeros((state.batch, width), np.int32)
+        for slot, toks in feeds.items():
+            tokens[slot] = toks
+        logits, state = self._chunk_fn(self.params, state,
+                                       jnp.asarray(tokens), None)
+        out = {}
+        for slot in feeds:
+            rows = logits[slot]
+            greedy = np.asarray(jnp.argmax(rows, axis=-1)).tolist()
+            temp = temps.get(slot, 0.0)
+            if temp and temp > 0.0:
+                greedy[0] = self._pick(rows[0], temp)
+            out[slot] = [int(t) for t in greedy]
+        return state, out
+
+    def rollback(self, state, drops: Dict[int, int]):
+        """Length surgery: un-commit the trailing ``drops[slot]`` rows
+        (the rejected speculative suffix). The rows stay in the slab
+        above the watermark — masked out, overwritten by the next
+        write."""
+        jnp = self._jnp
+        d = np.zeros((state.batch,), np.int32)
+        for slot, n in drops.items():
+            d[slot] = n
+        return state._replace(lengths=state.lengths - jnp.asarray(d))
+
     def evict(self, state, slot: int):
         from ..ops.kv_cache import evict_slot
 
+        self._pending.pop(slot, None)
         return state._replace(lengths=evict_slot(state.lengths, slot))
+
+    def stats(self) -> dict:
+        out = {"prefill_calls": self.prefill_calls}
+        if self.prefix_cache is not None:
+            out["prefix_cache"] = self.prefix_cache.stats()
+        return out
+
+
+class SpeculativeDecodeEngine:
+    """Draft-and-verify gang decode behind the same engine interface
+    (Leviathan et al., 2023).
+
+    Each round, per fed slot: the cheap **draft** runs ``k + 1``
+    width-1 steps (``k`` proposals plus one throwaway step that writes
+    the ``k``-th proposal's KV row, so the draft cache never lags the
+    target on full acceptance); the **target** verifies ``[fed, d_1 ..
+    d_k]`` in ONE rectangular ``step_chunk``. The longest agreeing
+    prefix ``a`` yields ``a + 1`` emitted tokens (``a`` accepted drafts
+    plus the target's own next token — the classic bonus), and both
+    engines ``rollback`` the rejected ``k - a`` suffix rows by length
+    surgery. Greedy output is token-for-token identical to plain
+    decode; sampled slots (temperature > 0) force ``a = 0`` and emit
+    the target's row-0 sample, which is exactly a plain sampled step.
+
+    ``step`` therefore returns per-slot **lists** of 1..k+1 tokens;
+    the scheduler normalises. ``expected_tokens_per_step`` feeds the
+    admission estimate.
+    """
+
+    def __init__(self, target, draft, k: int = 3):
+        self.target = target
+        self.draft = draft
+        self.k = max(int(k), 1)
+        self.buckets = list(target.buckets)
+        self._accepted = 0
+        self._proposed = 0
+        if getattr(target, "prefill_chunk", None) is None or \
+                getattr(draft, "prefill_chunk", None) is None:
+            self.prefill_chunk = None    # degrade: scheduler won't chunk
+        self.prefix_cache = None         # lookups need both caches; skip
+
+    # -- lifecycle (paired states) ----------------------------------------
+    def alloc(self, nslots: int, capacity: int):
+        return (self.target.alloc(nslots, capacity),
+                self.draft.alloc(nslots, capacity))
+
+    def grow(self, state, capacity: int):
+        return (self.target.grow(state[0], capacity),
+                self.draft.grow(state[1], capacity))
+
+    def join(self, state, slot: int, req: GenRequest):
+        t_state, first = self.target.join(state[0], slot, req)
+        d_state, _ = self.draft.join(state[1], slot, req)
+        return (t_state, d_state), first
+
+    def join_batch(self, state, joins: Sequence[Tuple[int, GenRequest]]):
+        t_state, out = self.target.join_batch(state[0], joins)
+        d_state, _ = self.draft.join_batch(state[1], joins)
+        return (t_state, d_state), out
+
+    def prefill_chunk(self, state, slot: int, req: GenRequest,
+                      start: int, end: int, is_last: bool):
+        t_state, first = self.target.prefill_chunk(
+            state[0], slot, req, start, end, is_last)
+        d_state, _ = self.draft.prefill_chunk(
+            state[1], slot, req, start, end, is_last)
+        return (t_state, d_state), first
+
+    def evict(self, state, slot: int):
+        return (self.target.evict(state[0], slot),
+                self.draft.evict(state[1], slot))
+
+    # -- decode -----------------------------------------------------------
+    def step(self, state, feeds: Dict[int, int],
+             temps: Dict[int, float]):
+        t_state, d_state = state
+        k = self.k
+        props: Dict[int, List[int]] = {slot: [] for slot in feeds}
+        cur = {slot: int(tok) for slot, tok in feeds.items()}
+        for i in range(k + 1):
+            d_state, d_out = self.draft.step(d_state, cur, {})
+            for slot in feeds:
+                tok = int(d_out[slot])
+                if i < k:
+                    props[slot].append(tok)
+                cur[slot] = tok
+        chunks = {slot: [int(feeds[slot])] + props[slot] for slot in feeds}
+        t_state, preds = self.target.step_chunk(t_state, chunks, temps)
+        out: Dict[int, List[int]] = {}
+        drops: Dict[int, int] = {}
+        for slot in feeds:
+            pred = [int(t) for t in preds[slot]]
+            a = 0
+            if not temps.get(slot):           # sampling can't verify
+                while a < k and props[slot][a] == pred[a]:
+                    a += 1
+            out[slot] = props[slot][:a] + [pred[a]]
+            drops[slot] = k - a               # both wrote k+1, keep a+1
+            self._accepted += a
+            self._proposed += k
+        t_state = self.target.rollback(t_state, drops)
+        d_state = self.draft.rollback(d_state, drops)
+        telemetry.gauge("zoo_generate_draft_acceptance_rate").set(
+            self.acceptance_rate)
+        return (t_state, d_state), out
+
+    @property
+    def acceptance_rate(self) -> float:
+        return self._accepted / self._proposed if self._proposed else 0.0
+
+    @property
+    def expected_tokens_per_step(self) -> float:
+        """EWMA-free admission signal: accepted drafts per round plus
+        the always-emitted bonus token."""
+        if not self._proposed:
+            return 1.0
+        return 1.0 + self.k * self.acceptance_rate
+
+    def stats(self) -> dict:
+        out = {"k": self.k, "draft_accepted": self._accepted,
+               "draft_proposed": self._proposed,
+               "acceptance_rate": round(self.acceptance_rate, 4),
+               "tokens_per_step": round(self.expected_tokens_per_step, 4)}
+        t_stats = getattr(self.target, "stats", None)
+        if callable(t_stats):
+            out["target"] = t_stats()
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -243,8 +735,22 @@ class ContinuousBatchScheduler:
     commit their results immediately → **refill** the freed cache
     slots from the admission queue (``admit_generate`` sheds requests
     whose deadline cannot survive the queue depth; joiners prefill
-    into the running gang) → **step** the gang one token
-    (``observe_tokens`` feeds the per-token EWMA back to admission).
+    into the running gang) → **advance chunked prefills** one chunk
+    each → **step** the gang one token (``observe_tokens`` feeds the
+    per-token EWMA back to admission).
+
+    Refill takes the fast path where the engine offers one: a
+    prefix-cache hit joins with no prefill at all; a prompt longer
+    than ``prefill_chunk`` tokens joins *incrementally* — one chunk
+    per token boundary, decode steps interleaved between chunks, so a
+    long joiner can no longer stall the gang for its whole prompt;
+    remaining same-boundary joiners fuse into a single batched prefill
+    dispatch. Engines missing a verb degrade to the sequential path.
+
+    An engine whose ``step`` returns per-slot token *lists* (the
+    speculative engine) is handled natively — every emitted token gets
+    its own ``_note_token`` so stop/budget/deadline checks stay
+    per-token exact.
 
     ``continuous=False`` degrades to static batching — the gang only
     refills once *every* slot has drained — which is the baseline leg
@@ -261,7 +767,7 @@ class ContinuousBatchScheduler:
                  max_slots: int = 8, continuous: bool = True,
                  admission: Optional[AdmissionController] = None,
                  batcher: Optional[AdaptiveBatcher] = None,
-                 idle_poll_s: float = 0.02):
+                 idle_poll_s: float = 0.02, prefill_chunk: int = 0):
         self.engine = engine
         self._commit_cb = commit
         self.max_slots = max(int(max_slots), 1)
@@ -269,6 +775,7 @@ class ContinuousBatchScheduler:
         self.admission = admission
         self.batcher = batcher
         self.idle_poll_s = float(idle_poll_s)
+        self.prefill_chunk = max(int(prefill_chunk), 0)
 
         self._queue: "queue.Queue[GenRequest]" = queue.Queue()
         self._slots: List[Optional[_Slot]] = [None] * self.max_slots
@@ -311,6 +818,9 @@ class ContinuousBatchScheduler:
         out["queue_depth"] = self._queue.qsize()
         out["active_slots"] = sum(s is not None for s in self._slots)
         out["capacity"] = self._capacity
+        eng_stats = getattr(self.engine, "stats", None)
+        if callable(eng_stats):
+            out["engine"] = eng_stats()
         return out
 
     # -- commit (exactly once) ------------------------------------------
@@ -338,12 +848,24 @@ class ContinuousBatchScheduler:
             return None
         return req.deadline_at_ms - now_ms()
 
+    def _wants_chunked(self, req: GenRequest) -> bool:
+        return (self.prefill_chunk > 0
+                and getattr(self.engine, "prefill_chunk", None) is not None
+                and int(req.prompt.size) > self.prefill_chunk)
+
     def _admit(self, req: GenRequest) -> bool:
         """Admission-time shed; True when the request may join."""
         if self.admission is not None:
+            n_chunks = 1
+            if self._wants_chunked(req):
+                n_chunks = math.ceil(int(req.prompt.size)
+                                     / self.prefill_chunk)
+            tps = float(getattr(self.engine,
+                                "expected_tokens_per_step", 1.0) or 1.0)
             ok, code = self.admission.admit_generate(
                 self._slack_ms(req), req.max_new_tokens,
-                queue_depth=self._queue.qsize())
+                queue_depth=self._queue.qsize(),
+                prefill_chunks=n_chunks, tokens_per_step=tps)
             if not ok:
                 self._shed(req, code, "deadline unmeetable at admission")
                 return False
@@ -364,6 +886,18 @@ class ContinuousBatchScheduler:
             self._capacity = need
         return True
 
+    def _seat(self, slot: int, req: GenRequest, first: int,
+              cached: bool = False):
+        """Common join bookkeeping once a slot has its first token."""
+        if self._slots[slot] is None:
+            self._slots[slot] = _Slot(req=req, t_join=time.perf_counter())
+        with self._lock:
+            self.counts["joins"] += 1
+        telemetry.counter("zoo_generate_join_total").inc()
+        telemetry.event("generate_join", uri=req.uri, slot=slot,
+                        cached=cached, trace_id=req.trace_id)
+        self._note_token(slot, int(first))
+
     def _join(self, slot: int, req: GenRequest):
         with span("generate/prefill", uri=req.uri, slot=slot,
                   prompt_len=int(req.prompt.size),
@@ -371,14 +905,75 @@ class ContinuousBatchScheduler:
             if req.trace_id:
                 telemetry.flow("serving/request", req.trace_id, "f")
             self._state, first = self.engine.join(self._state, slot, req)
-        s = _Slot(req=req, t_join=time.perf_counter())
-        self._slots[slot] = s
-        with self._lock:
-            self.counts["joins"] += 1
-        telemetry.counter("zoo_generate_join_total").inc()
-        telemetry.event("generate_join", uri=req.uri, slot=slot,
+        self._seat(slot, req, first)
+
+    def _join_batch(self, joins: List[tuple]):
+        """Fuse same-boundary joiners into one prefill dispatch."""
+        with span("generate/prefill_batch", n=len(joins)):
+            for _, req in joins:
+                if req.trace_id:
+                    telemetry.flow("serving/request", req.trace_id, "f")
+            self._state, firsts = self.engine.join_batch(self._state,
+                                                         joins)
+        telemetry.counter("zoo_generate_batched_join_total").inc(
+            len(joins))
+        for slot, req in joins:
+            self._seat(slot, req, firsts[slot])
+
+    def _try_cached_join(self, slot: int, req: GenRequest) -> bool:
+        """Prefix-cache hit: splice rows, skip prefill entirely."""
+        fn = getattr(self.engine, "try_cached_join", None)
+        if fn is None:
+            return False
+        with span("generate/prefix_cache_join", uri=req.uri, slot=slot,
+                  trace_id=req.trace_id):
+            res = fn(self._state, slot, req)
+        if res is None:
+            return False
+        if req.trace_id:
+            telemetry.flow("serving/request", req.trace_id, "f")
+        self._state, first = res
+        self._seat(slot, req, first, cached=True)
+        return True
+
+    def _begin_chunked_join(self, slot: int, req: GenRequest):
+        """Seat a long-prompt joiner and run its FIRST chunk; the rest
+        interleave with decode steps (one chunk per token boundary)."""
+        self._slots[slot] = _Slot(req=req, t_join=time.perf_counter(),
+                                  prefill_next=0)
+        if req.trace_id:
+            telemetry.flow("serving/request", req.trace_id, "f")
+        telemetry.event("generate_join_begin", uri=req.uri, slot=slot,
+                        prompt_len=int(req.prompt.size),
                         trace_id=req.trace_id)
-        self._note_token(slot, int(first))
+        self._prefill_one_chunk(slot)
+
+    def _prefill_one_chunk(self, slot: int):
+        s = self._slots[slot]
+        start = s.prefill_next
+        lp = int(s.req.prompt.size)
+        end = min(start + self.prefill_chunk, lp)
+        is_last = end >= lp
+        t0 = time.perf_counter()
+        with span("generate/prefill_chunk", uri=s.req.uri, slot=slot,
+                  start=start, end=end, trace_id=s.req.trace_id):
+            self._state, first = self.engine.prefill_chunk(
+                self._state, slot, s.req, start, end, is_last)
+        dt = time.perf_counter() - t0
+        if self.admission is not None:
+            self.admission.observe_prefill_chunk(dt)
+        telemetry.summary("zoo_generate_prefill_chunk_ms").record(dt * 1e3)
+        if is_last:
+            s.prefill_next = None
+            self._seat(slot, s.req, int(first))
+        else:
+            s.prefill_next = end
+
+    def _prefill_step(self):
+        """Advance every in-flight chunked prefill one chunk."""
+        for i, s in enumerate(self._slots):
+            if s is not None and s.prefill_next is not None:
+                self._prefill_one_chunk(i)
 
     def _note_token(self, slot: int, tok: int):
         """Record one emitted token; set the slot's finish reason when
@@ -468,6 +1063,7 @@ class ContinuousBatchScheduler:
             return
         gang_was_empty = active == 0
         free = [i for i, s in enumerate(self._slots) if s is None]
+        pending: List[tuple] = []    # joiners for one fused dispatch
         while free:
             try:
                 req = self._queue.get_nowait()
@@ -487,23 +1083,45 @@ class ContinuousBatchScheduler:
             if not self._admit(req):
                 continue
             slot = free.pop(0)
-            self._join(slot, req)
+            if self._try_cached_join(slot, req):
+                continue
+            if self._wants_chunked(req):
+                self._begin_chunked_join(slot, req)
+                continue
+            pending.append((slot, req))
+        if len(pending) > 1 and \
+                getattr(self.engine, "join_batch", None) is not None:
+            self._join_batch(pending)
+        else:
+            for slot, req in pending:
+                self._join(slot, req)
 
     def _step(self):
         feeds = {i: s.last for i, s in enumerate(self._slots)
-                 if s is not None and s.finish is None}
+                 if s is not None and s.finish is None
+                 and s.prefill_next is None}
         if not feeds:
             return
         temps = {i: self._slots[i].req.temperature for i in feeds}
         t0 = time.perf_counter()
         self._state, out = self.engine.step(self._state, feeds, temps)
         dt = time.perf_counter() - t0
-        if self.admission is not None:
-            self.admission.observe_tokens(len(feeds), dt)
-        telemetry.counter("zoo_generate_tokens_total").inc(len(feeds))
-        telemetry.summary("zoo_generate_step_ms").record(dt * 1e3)
+        emitted = 0
         for slot, tok in out.items():
-            self._note_token(slot, int(tok))
+            s = self._slots[slot]
+            toks = tok if isinstance(tok, (list, tuple)) else (tok,)
+            for t in toks:
+                # a speculative step can emit several tokens; the
+                # sequence may finish mid-list, and trailing tokens
+                # past the finish are discarded
+                if s.finish is not None:
+                    break
+                self._note_token(slot, int(t))
+                emitted += 1
+        if self.admission is not None:
+            self.admission.observe_tokens(emitted, dt)
+        telemetry.counter("zoo_generate_tokens_total").inc(emitted)
+        telemetry.summary("zoo_generate_step_ms").record(dt * 1e3)
         self._publish_occupancy()
 
     def _publish_occupancy(self):
@@ -523,6 +1141,7 @@ class ContinuousBatchScheduler:
         while True:
             self._evict_finished()
             self._refill()
+            self._prefill_step()
             active = sum(s is not None for s in self._slots)
             if self._stop_evt.is_set():
                 if not self._drain:

@@ -1816,6 +1816,203 @@ def bench_generation(n_requests=48, slots=8, step_ms=2.0):
     return out
 
 
+def bench_genfast(step_ms=2.0, prompt_len=2000, chunk=32,
+                  victim_tokens=150, spec_tokens=48, spec_k=3):
+    """Generative fast-path leg (docs/serving-generate.md#fast-path):
+    four A/B measurements over the deterministic stub + the tiny
+    reference transformer, each a hard gate:
+
+    - **chunked prefill**: a victim stream's p99 inter-token gap while
+      a long prompt joins chunk-by-chunk must stay <= 1.5x its
+      steady-state gap (a monolithic join is measured alongside for
+      contrast — it stalls the victim for the whole prompt);
+    - **speculation**: draft-and-verify tokens/s >= 1.5x plain decode
+      with a token-for-token identical greedy stream;
+    - **int8 KV**: per-slot KV slab bytes <= 0.55x f32 on the real
+      ``TransformerLayer`` decode state;
+    - **prefix cache**: a warm identical prompt joins with ZERO new
+      prefill dispatches (engine ``prefill_calls`` counter stands
+      still) and a recorded cache hit.
+    """
+    from analytics_zoo_tpu.serving.generation import (
+        ContinuousBatchScheduler, GenRequest, PrefixCache,
+        SpeculativeDecodeEngine, StubDecodeEngine)
+    from analytics_zoo_tpu.utils import telemetry
+
+    out = {}
+
+    # -- A) long-prompt join: victim inter-token p99 gap ----------------
+    # chunk cost ~0.5ms << step cost 2ms, so interleaved chunks hide
+    # inside token boundaries; the monolithic join stalls ~30ms.
+    prefill_token_ms = 0.015
+
+    from analytics_zoo_tpu.ops.kv_cache import cache_length_buckets
+
+    def _victim_gap(join_prompt_len, prefill_chunk):
+        was = telemetry.enabled()
+        telemetry.set_enabled(True)   # token_ms timestamps
+        try:
+            eng = StubDecodeEngine(
+                ms_per_step=step_ms,
+                ms_per_prefill_token=prefill_token_ms,
+                capacity_buckets=cache_length_buckets(4 * prompt_len))
+            results = {}
+            sched = ContinuousBatchScheduler(
+                eng, commit=lambda u, p: results.__setitem__(u, p),
+                max_slots=2, prefill_chunk=prefill_chunk).start()
+            sched.submit(GenRequest("victim", np.array([9]),
+                                    max_new_tokens=victim_tokens))
+            n_expect = 1
+            if join_prompt_len:
+                time.sleep(step_ms / 1e3 * 8)
+                sched.submit(GenRequest(
+                    "long", np.full(join_prompt_len, 7),
+                    max_new_tokens=4))
+                n_expect = 2
+            t0 = time.perf_counter()
+            while len(results) < n_expect and \
+                    time.perf_counter() - t0 < 120:
+                time.sleep(0.002)
+            sched.stop(drain=True, timeout=120)
+        finally:
+            telemetry.set_enabled(was)
+        if join_prompt_len and "tokens" not in results.get("long", {}):
+            raise RuntimeError(f"long joiner was shed: {results['long']}")
+        gaps = np.diff(results["victim"]["timing"]["token_ms"])
+        return float(np.percentile(gaps, 99)), float(np.max(gaps))
+
+    steady, steady_max = _victim_gap(0, 0)
+    mono, mono_max = _victim_gap(prompt_len, 0)
+    chunked, chunked_max = _victim_gap(prompt_len, chunk)
+    out["genfast_steady_p99_gap_ms"] = round(steady, 3)
+    out["genfast_monolithic_join_p99_gap_ms"] = round(mono, 3)
+    out["genfast_chunked_join_p99_gap_ms"] = round(chunked, 3)
+    # the worst single stall is where the monolithic join shows up: it
+    # freezes the victim for the whole prompt; chunks hide in one step
+    out["genfast_steady_max_gap_ms"] = round(steady_max, 3)
+    out["genfast_monolithic_join_max_gap_ms"] = round(mono_max, 3)
+    out["genfast_chunked_join_max_gap_ms"] = round(chunked_max, 3)
+    _gate("genfast_chunked_gap_le_1p5x_steady",
+          chunked <= 1.5 * steady and chunked_max < mono_max,
+          f"chunked p99 gap {chunked:.2f}ms (max {chunked_max:.2f}ms) "
+          f"vs steady {steady:.2f}ms, monolithic max {mono_max:.2f}ms")
+
+    # -- B) speculation: >= 1.5x tokens/s, bit-identical greedy ----------
+    def _spec_run(engine):
+        results = {}
+        sched = ContinuousBatchScheduler(
+            engine, commit=lambda u, p: results.__setitem__(u, p),
+            max_slots=2).start()
+        sched.submit(GenRequest("s", np.array([100]),
+                                max_new_tokens=spec_tokens))
+        sched.stop(drain=True, timeout=120)
+        return (results["s"]["tokens"],
+                results["s"]["timing"]["tokens_per_s"])
+
+    plain_toks, plain_tps = _spec_run(StubDecodeEngine(ms_per_step=step_ms))
+    spec_eng = SpeculativeDecodeEngine(
+        StubDecodeEngine(ms_per_step=step_ms),
+        StubDecodeEngine(ms_per_step=step_ms / 40.0), k=spec_k)
+    spec_toks, spec_tps = _spec_run(spec_eng)
+    identical = spec_toks == plain_toks
+    speedup = spec_tps / max(plain_tps, 1e-9)
+    out["genfast_plain_tokens_per_s"] = round(plain_tps, 1)
+    out["genfast_spec_tokens_per_s"] = round(spec_tps, 1)
+    out["genfast_spec_speedup"] = round(speedup, 2)
+    out["genfast_spec_acceptance_rate"] = round(
+        spec_eng.acceptance_rate, 4)
+    out["genfast_spec_bit_identical"] = bool(identical)
+    _gate("genfast_speculation_ge_1p5x_bit_identical",
+          speedup >= 1.5 and identical,
+          f"speedup={speedup:.2f}, bit_identical={identical}, "
+          f"acceptance={spec_eng.acceptance_rate:.2f}")
+
+    # -- E) batched joins: one fused dispatch vs N sequential prefills ---
+    n_join = 8
+    base_prefill_ms = 5.0
+
+    def _join_reqs():
+        return [(i, GenRequest(f"j-{i}", np.array([i + 1]),
+                               max_new_tokens=4)) for i in range(n_join)]
+
+    eng_seq = StubDecodeEngine(ms_per_step=step_ms,
+                               ms_per_prefill=base_prefill_ms)
+    st = eng_seq.alloc(n_join, 128)
+    t0 = time.perf_counter()
+    for slot, req in _join_reqs():
+        st, _ = eng_seq.join(st, slot, req)
+    seq_ms = (time.perf_counter() - t0) * 1e3
+    eng_bat = StubDecodeEngine(ms_per_step=step_ms,
+                               ms_per_prefill=base_prefill_ms)
+    st = eng_bat.alloc(n_join, 128)
+    t0 = time.perf_counter()
+    st, _ = eng_bat.join_batch(st, _join_reqs())
+    bat_ms = (time.perf_counter() - t0) * 1e3
+    join_speedup = seq_ms / max(bat_ms, 1e-9)
+    out["genfast_seq_join_wall_ms"] = round(seq_ms, 2)
+    out["genfast_batched_join_wall_ms"] = round(bat_ms, 2)
+    out["genfast_batched_join_speedup"] = round(join_speedup, 2)
+    _gate("genfast_batched_join_beats_sequential", join_speedup >= 2.0,
+          f"{n_join} joins: sequential {seq_ms:.1f}ms vs batched "
+          f"{bat_ms:.1f}ms ({join_speedup:.1f}x)")
+
+    # -- C) int8 KV slabs: per-slot HBM -----------------------------------
+    import jax
+
+    from analytics_zoo_tpu.ops.kv_cache import kv_slab_bytes
+    from analytics_zoo_tpu.pipeline.api.keras.layers.self_attention \
+        import TransformerLayer
+
+    cap, slots = 256, 4
+    layer = TransformerLayer(n_block=2, n_head=2, hidden_size=16,
+                             vocab=32, seq_len=cap, intermediate_size=32,
+                             hidden_p_drop=0.0, attn_p_drop=0.0,
+                             bidirectional=False)
+    params = layer.build(jax.random.PRNGKey(0), (None, cap))
+    f32_bytes = kv_slab_bytes(layer.init_decode_state(slots, cap))
+    i8_bytes = kv_slab_bytes(layer.init_decode_state(slots, cap,
+                                                     dtype="int8"))
+    fraction = i8_bytes / max(f32_bytes, 1)
+    out["genfast_f32_kv_bytes_per_slot"] = f32_bytes // slots
+    out["genfast_int8_kv_bytes_per_slot"] = i8_bytes // slots
+    out["genfast_int8_kv_bytes_fraction"] = round(fraction, 4)
+    _gate("genfast_int8_kv_le_0p55x", fraction <= 0.55,
+          f"int8/f32 KV bytes fraction {fraction:.3f}")
+
+    # -- D) prefix cache: warm join skips prefill (counter-proven) -------
+    from analytics_zoo_tpu.serving.generation import \
+        TransformerDecodeEngine
+
+    cache = PrefixCache()
+    eng = TransformerDecodeEngine(layer, params, prefix_cache=cache)
+    prompt = np.arange(1, 25) % 31
+
+    def _one(uri):
+        results = {}
+        sched = ContinuousBatchScheduler(
+            eng, commit=lambda u, p: results.__setitem__(u, p),
+            max_slots=2).start()
+        sched.submit(GenRequest(uri, prompt.copy(), max_new_tokens=4))
+        sched.stop(drain=True, timeout=300)
+        return results[uri]
+
+    cold = _one("cold")
+    cold_calls = eng.prefill_calls
+    warm = _one("warm")
+    skipped = eng.prefill_calls == cold_calls
+    exact = warm["tokens"] == cold["tokens"]
+    out["genfast_prefix_cold_prefill_calls"] = cold_calls
+    out["genfast_prefix_warm_prefill_calls"] = eng.prefill_calls
+    out["genfast_prefix_cache_hits"] = cache.hits
+    out["genfast_prefix_warm_ttft_ms"] = warm["timing"]["ttft_ms"]
+    out["genfast_prefix_cold_ttft_ms"] = cold["timing"]["ttft_ms"]
+    _gate("genfast_prefix_hit_skips_prefill",
+          skipped and exact and cache.hits == 1,
+          f"prefill_calls {cold_calls}->{eng.prefill_calls}, "
+          f"hits={cache.hits}, exact={exact}")
+    return out
+
+
 def bench_soak(duration_s=62.0, target_qps=120.0, batch_size=8,
                stub_ms=2.0, p99_bound_ms=250.0, shed_bound=0.05):
     """SLO soak leg (docs/observability.md#slo): sustained target-qps
@@ -2716,6 +2913,22 @@ def main():
             _gate("generation_measured", False,
                   RESULT["generation_error"])
         _stamp_leg_artifacts("generation")
+        emit()
+
+    # Generative fast-path leg: chunked-prefill inter-token-gap A/B,
+    # speculative-decode speedup (bit-identical greedy), int8 KV
+    # bytes-per-slot, and the prefix-cache skip proof — four hard gates
+    # (docs/serving-generate.md#fast-path). Host-side, CPU-provable.
+    if time.time() - T_START < TOTAL_BUDGET_S * 0.9:
+        try:
+            RESULT.update(bench_genfast())
+        except Exception as e:  # noqa: BLE001
+            import traceback
+            traceback.print_exc()
+            RESULT["genfast_error"] = (str(e).splitlines()[0][:500]
+                                       if str(e) else repr(e)[:500])
+            _gate("genfast_measured", False, RESULT["genfast_error"])
+        _stamp_leg_artifacts("genfast")
         emit()
 
     # SLO soak leg: >= 60s sustained target-qps through the pipelined
