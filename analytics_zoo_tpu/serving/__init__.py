@@ -8,7 +8,8 @@ admission control (docs/serving-fleet.md).
 """
 
 from .admission import (AdaptiveBatcher, AdmissionController,
-                        BacklogAutoscaler, SHED_DEADLINE, SHED_EXPIRED)
+                        BacklogAutoscaler, SHED_CAPACITY, SHED_DEADLINE,
+                        SHED_EXPIRED, TenantScheduler)
 from .client import (API, GenerationResult, InputQueue, OutputQueue,
                      ServingError, ServingRejected, ServingResult,
                      ServingTimeout)
@@ -21,6 +22,8 @@ from .generation import (ContinuousBatchScheduler, GenRequest,
 from .queue_backend import (DeliveryLedger, FileStreamQueue,
                             InProcessStreamQueue, StreamQueue,
                             get_queue_backend)
+from .shard_fabric import (LocalShardFabric, ShardedStreamQueue,
+                           parse_shard_spec)
 from .socket_queue import SocketStreamQueue, StreamQueueBroker
 from .registry import (CanaryState, DeployError, ModelRegistry,
                        ModelVersion, RegistryControlServer, RegistryError,
@@ -37,8 +40,10 @@ __all__ = ["InputQueue", "OutputQueue", "API", "ServingError",
            "UnknownModelError", "DeployError", "RegistryControlServer",
            "control_request", "RoutedClusterServing",
            "AdmissionController", "AdaptiveBatcher", "BacklogAutoscaler",
-           "SHED_DEADLINE", "SHED_EXPIRED", "ServingFleet", "fleet_status",
+           "SHED_DEADLINE", "SHED_EXPIRED", "SHED_CAPACITY",
+           "TenantScheduler", "ServingFleet", "fleet_status",
            "read_autoscale_trace", "DeliveryLedger", "SocketStreamQueue",
-           "StreamQueueBroker",
+           "StreamQueueBroker", "ShardedStreamQueue", "LocalShardFabric",
+           "parse_shard_spec",
            "GenerationResult", "ContinuousBatchScheduler", "GenRequest",
            "StubDecodeEngine", "TransformerDecodeEngine"]

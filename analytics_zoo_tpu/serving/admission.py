@@ -32,13 +32,15 @@ from __future__ import annotations
 import math
 import threading
 import time
-from typing import Optional, Sequence, Tuple
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..utils.profiling import Ewma
 
 #: typed rejection codes carried in the shed result payload ("code")
 SHED_DEADLINE = "shed_deadline"   # unmeetable at admission time
 SHED_EXPIRED = "shed_expired"     # expired while queued, shed at dispatch
+SHED_CAPACITY = "shed_capacity"   # shed by tenant policy under pressure
 
 
 def now_ms() -> float:
@@ -205,6 +207,169 @@ class AdmissionController:
                     "est_token_ms": round(self.token_ms, 3),
                     "est_chunk_ms": round(self.chunk_ms, 3),
                     "safety_ms": self.safety_ms}
+
+
+#: implicit tenant for traffic no SLO class binds (weight 1, priority 0,
+#: never pressure-shed — it declared no wait bound)
+DEFAULT_TENANT = "_default"
+
+
+class TenantScheduler:
+    """Weighted-fair intake + priority sheds across SLO classes.
+
+    The single-tenant intake path admits records in stream order, so one
+    tenant's burst monopolizes the pipeline and burns every other
+    tenant's error budget.  This scheduler puts a per-tenant queue
+    between stream intake and the decode stage
+    (docs/multi-tenancy.md#scheduling):
+
+    - **classify**: route each record to the most-specific SLO class for
+      its (model, version) — exact match > model-only > catch-all —
+      falling back to the implicit ``_default`` tenant;
+    - **weighted-fair drain**: deficit round-robin — each pass a class
+      earns ``weight * quantum`` credit and drains whole records while
+      credit lasts, so a weight-3 class gets 3 of every 4 slots while
+      both have backlog, yet an idle class's share flows to the others
+      (work-conserving; an empty class's deficit resets so it cannot
+      hoard credit for a later burst);
+    - **priority sheds**: under predicted-wait pressure the scheduler
+      sheds the *oldest* queued record of the least-important violating
+      class (highest ``priority`` number; lower = more important) until
+      every remaining class's predicted wait fits its ``shed_wait_ms``
+      bound — so a low-priority burst absorbs the typed
+      ``shed_capacity`` rejections while the high-priority tenant keeps
+      its latency objective.
+
+    Classes are any objects with ``name``/``weight``/``priority``/
+    ``model``/``version``/``shed_wait_ms`` attributes —
+    :class:`~analytics_zoo_tpu.utils.slo.SloClass` in production.
+    """
+
+    def __init__(self, classes: Sequence = (), quantum: float = 1.0):
+        self.classes = list(classes)
+        self.quantum = float(quantum)
+        self.class_of: Dict[str, object] = {c.name: c
+                                            for c in self.classes}
+        self._order = [c.name for c in self.classes]
+        if DEFAULT_TENANT not in self.class_of:
+            self._order.append(DEFAULT_TENANT)
+        self._queues: Dict[str, deque] = {n: deque() for n in self._order}
+        self._deficit: Dict[str, float] = {n: 0.0 for n in self._order}
+        self._lock = threading.Lock()
+        self.offered: Dict[str, int] = {n: 0 for n in self._order}
+        self.drained: Dict[str, int] = {n: 0 for n in self._order}
+        self.shed: Dict[str, int] = {n: 0 for n in self._order}
+
+    # -- class attributes with _default fallbacks ----------------------
+    def _weight(self, name: str) -> float:
+        cls = self.class_of.get(name)
+        return float(getattr(cls, "weight", 1.0)) if cls else 1.0
+
+    def _priority(self, name: str) -> int:
+        cls = self.class_of.get(name)
+        return int(getattr(cls, "priority", 0)) if cls else 0
+
+    def _shed_wait_ms(self, name: str) -> Optional[float]:
+        cls = self.class_of.get(name)
+        return getattr(cls, "shed_wait_ms", None) if cls else None
+
+    # -- routing --------------------------------------------------------
+    def classify(self, model: Optional[str],
+                 version: Optional[str]) -> str:
+        """Tenant name for a record's (model, version): exact match >
+        model-only > catch-all > implicit ``_default``."""
+        best, best_rank = DEFAULT_TENANT, -1
+        for cls in self.classes:
+            if cls.model is None:
+                rank = 0
+            elif cls.model == model:
+                rank = 2 if cls.version is not None else 1
+                if cls.version is not None and cls.version != version:
+                    continue
+            else:
+                continue
+            if rank > best_rank:
+                best, best_rank = cls.name, rank
+        return best
+
+    # -- intake ---------------------------------------------------------
+    def offer(self, tenant: str, item) -> None:
+        """Queue one intake item (whatever the serving loop carries —
+        (meta, record) tuples) under its tenant."""
+        with self._lock:
+            if tenant not in self._queues:
+                tenant = DEFAULT_TENANT
+            self._queues[tenant].append(item)
+            self.offered[tenant] += 1
+
+    def drain(self, max_items: int) -> List:
+        """Up to ``max_items`` items in weighted-fair (DRR) order."""
+        out: List = []
+        with self._lock:
+            while (len(out) < max_items
+                   and any(self._queues[n] for n in self._order)):
+                for name in self._order:
+                    q = self._queues[name]
+                    if not q:
+                        self._deficit[name] = 0.0
+                        continue
+                    self._deficit[name] += self._weight(name) * self.quantum
+                    while (q and self._deficit[name] >= 1.0
+                           and len(out) < max_items):
+                        out.append(q.popleft())
+                        self._deficit[name] -= 1.0
+                        self.drained[name] += 1
+                    if not q:
+                        self._deficit[name] = 0.0
+        return out
+
+    # -- pressure sheds -------------------------------------------------
+    def shed_under_pressure(self, controller: AdmissionController,
+                            extra_backlog: int = 0) -> List[Tuple[str, object]]:
+        """Shed queued items until every class's predicted wait fits its
+        ``shed_wait_ms`` bound.  Returns [(tenant, item), ...] oldest
+        first; the caller commits the typed ``shed_capacity`` payloads.
+
+        ``extra_backlog`` is the pipeline's already-admitted depth (the
+        records queued ahead of every tenant queue).  Victim order: the
+        highest priority *number* (least important) among violating
+        classes, largest backlog as tie-break — so a low class's burst
+        is shed away before a high class loses anything."""
+        out: List[Tuple[str, object]] = []
+        with self._lock:
+            while True:
+                backlog = (max(int(extra_backlog), 0)
+                           + sum(len(q) for q in self._queues.values()))
+                wait = (controller.estimate_wait_ms(backlog)
+                        + controller.safety_ms)
+                victims = [
+                    n for n in self._order
+                    if self._queues[n]
+                    and self._shed_wait_ms(n) is not None
+                    and wait > self._shed_wait_ms(n)]
+                if not victims:
+                    return out
+                name = max(victims, key=lambda n: (self._priority(n),
+                                                   len(self._queues[n])))
+                out.append((name, self._queues[name].popleft()))
+                self.shed[name] += 1
+
+    # -- observability --------------------------------------------------
+    def queued_total(self) -> int:
+        with self._lock:
+            return sum(len(q) for q in self._queues.values())
+
+    def stats(self) -> Dict[str, dict]:
+        with self._lock:
+            return {n: {"queued": len(self._queues[n]),
+                        "offered": self.offered[n],
+                        "drained": self.drained[n],
+                        "shed_capacity": self.shed[n],
+                        "weight": self._weight(n),
+                        "priority": self._priority(n),
+                        "shed_wait_ms": self._shed_wait_ms(n)}
+                    for n in self._order
+                    if self.offered[n] or n != DEFAULT_TENANT}
 
 
 class BacklogAutoscaler:

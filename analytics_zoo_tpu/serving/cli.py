@@ -50,6 +50,7 @@ import json
 import os
 import signal
 import sys
+import threading
 import time
 
 from ..utils import telemetry
@@ -69,7 +70,9 @@ model:
 
 data:
   # transport: "file:<dir>" | "socket://<host>:<port>" (network broker,
-  # docs/serving-network.md) | "<redis-host>:<port>" | empty in-process;
+  # docs/serving-network.md) | "shard://<host>:<p1>,<host>:<p2>,..."
+  # (HRW-sharded broker fabric, docs/serving-network.md#sharding) |
+  # "<redis-host>:<port>" | empty in-process;
   # `--transport` on the CLI overrides this without editing the file
   src: file:/tmp/zoo-serving-stream
   # C, H, W of the decoded image tensor
@@ -145,6 +148,21 @@ params:
 #       p99_ms: 250              # 99% of requests within 250ms
 #     - name: sheds
 #       shed_fraction: 0.05      # at most 5% of requests shed
+#   ## multi-tenant SLO classes (docs/multi-tenancy.md): per-(model,
+#   ## version) tenants with weighted-fair intake + priority sheds
+#   classes:
+#     - name: premium
+#       model: resnet50          # omit for a catch-all class
+#       weight: 3                # deficit-round-robin fair share
+#       priority: 0              # lower number sheds LAST
+#       objectives:
+#         - name: latency
+#           p99_ms: 250
+#     - name: batch
+#       model: embedder
+#       weight: 1
+#       priority: 1              # first to shed under pressure
+#       shed_wait_ms: 150        # shed queued records past this wait
 """
 
 
@@ -295,14 +313,46 @@ def cmd_start(workdir: str, foreground: bool = False,
     os._exit(0)
 
 
+class _BrokerSet:
+    """Shutdown handle over the in-process shard brokers cmd_fleet
+    started (mirrors the single-broker handle's interface)."""
+
+    def __init__(self, brokers):
+        self.brokers = brokers
+
+    def shutdown(self):
+        for b in self.brokers:
+            b.shutdown()
+
+
 def _maybe_local_broker(src):
-    """When ``data.src`` is socket:// and its port is free locally,
-    start the broker in this process (single-host convenience); a bound
-    port means an external broker owns the address — use it."""
-    if not (src or "").startswith("socket://"):
-        return None
+    """When ``data.src`` is socket:// (or shard://) and its port(s) are
+    free locally, start the broker(s) in this process (single-host
+    convenience); a bound port means an external broker owns that
+    address — use it."""
+    src = src or ""
     from .socket_queue import StreamQueueBroker, parse_socket_spec
 
+    if src.startswith("shard://"):
+        from .shard_fabric import parse_shard_spec
+
+        endpoints = parse_shard_spec(src)
+        started = []
+        for host, port in endpoints:
+            bind = ("0.0.0.0" if host not in ("localhost", "127.0.0.1")
+                    else host)
+            try:
+                started.append(
+                    StreamQueueBroker(host=bind, port=port).start())
+            except OSError:
+                continue    # shard owned by an external broker
+        if not started:
+            return None
+        print(f"broker: serving {len(started)}/{len(endpoints)} shard(s) "
+              f"of {src} in-process", flush=True)
+        return _BrokerSet(started)
+    if not src.startswith("socket://"):
+        return None
     host, port = parse_socket_spec(src)
     bind = "0.0.0.0" if host not in ("localhost", "127.0.0.1") else host
     try:
@@ -313,17 +363,52 @@ def _maybe_local_broker(src):
     return broker
 
 
-def cmd_broker(src: str) -> int:
+def cmd_broker(src: str, shards: int = None) -> int:
     """Run a standalone stream broker in the foreground
     (docs/serving-network.md) — the front door fleet workers and
-    clients on other hosts connect to."""
+    clients on other hosts connect to.  ``--shards N`` (or a shard://
+    src) launches the whole fabric locally and prints the shard:// spec
+    to point ``data.src`` at (docs/serving-network.md#sharding)."""
     from .socket_queue import StreamQueueBroker, parse_socket_spec
 
-    host, port = parse_socket_spec(src or "socket://0.0.0.0:6380")
+    src = src or "socket://0.0.0.0:6380"
+    if src.startswith("shard://") or (shards or 0) > 1:
+        from .shard_fabric import parse_shard_spec
+
+        if src.startswith("shard://"):
+            endpoints = parse_shard_spec(src)
+        else:
+            host, port = parse_socket_spec(src)
+            endpoints = [(host, port + k if port else 0)
+                         for k in range(int(shards))]
+        brokers = [StreamQueueBroker(host=h, port=p)
+                   for h, p in endpoints]
+        spec = "shard://" + ",".join(f"{b.host}:{b.port}"
+                                     for b in brokers)
+        print(f"broker: fabric of {len(brokers)} shard(s) on {spec}\n"
+              f"broker: point data.src (or ZOO_SERVING_TRANSPORT) at "
+              f"that spec; Ctrl-C to stop", flush=True)
+        handle = _BrokerSet(brokers)
+        # server.shutdown() blocks until serve_forever acks — which can
+        # never happen on the thread serve_forever runs on, so the
+        # handler must hand off to a helper thread.
+        signal.signal(signal.SIGTERM, lambda _s, _f: threading.Thread(
+            target=handle.shutdown, daemon=True).start())
+        for b in brokers[1:]:
+            b.start()
+        try:
+            brokers[0].run_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            handle.shutdown()
+        return 0
+    host, port = parse_socket_spec(src)
     broker = StreamQueueBroker(host=host, port=port)
     print(f"broker: serving on {broker.address}; Ctrl-C to stop",
           flush=True)
-    signal.signal(signal.SIGTERM, lambda _s, _f: broker.shutdown())
+    signal.signal(signal.SIGTERM, lambda _s, _f: threading.Thread(
+        target=broker.shutdown, daemon=True).start())
     try:
         broker.run_forever()
     except KeyboardInterrupt:
@@ -462,8 +547,33 @@ def _print_transport(workdir: str):
     """Socket-transport row (docs/serving-network.md): one stats op
     against the broker — connections, claims outstanding, redeliveries,
     stream depth.  Non-socket transports print nothing; an unreachable
-    broker prints that instead of hiding the outage."""
+    broker prints that instead of hiding the outage.  A shard:// fabric
+    prints one row per shard (health included), so a dead shard is
+    visible at a glance."""
     src = _effective_src(workdir)
+    if (src or "").startswith("shard://"):
+        from .shard_fabric import ShardedStreamQueue, parse_shard_spec
+
+        q = ShardedStreamQueue(parse_shard_spec(src), connect_timeout=2.0)
+        try:
+            st = q.stats()
+        finally:
+            q.close()
+        print(f"  transport {src}: "
+              f"healthy={st['healthy']}/{len(st['shards'])} "
+              f"failovers={st['failovers']} reenqueued={st['reenqueued']}")
+        for row in st["shards"]:
+            if row["alive"]:
+                print(f"    shard {row['address']}: health=up "
+                      f"connections={row['connections']} "
+                      f"stream_len={row['stream_len']} "
+                      f"claims_outstanding={row['claims_outstanding']} "
+                      f"redelivered={row['redelivered']} "
+                      f"results_pending={row['results_pending']}")
+            else:
+                print(f"    shard {row['address']}: health=DOWN "
+                      f"(failures={row['failures']})")
+        return
     if not (src or "").startswith("socket://"):
         return
     from .socket_queue import SocketStreamQueue, parse_socket_spec
@@ -503,18 +613,36 @@ def _print_autoscale(workdir: str):
               f"{e['action']} -> {e['active']} ({e['reason']})")
 
 
+def _slo_line(label: str, o: dict):
+    mark = "ALERT" if o.get("alerting") else "ok"
+    print(f"  slo {label:12s} [{o.get('kind')} <= {o.get('bound'):g}] "
+          f"burn fast={o.get('burn_fast', 0):.2f} "
+          f"slow={o.get('burn_slow', 0):.2f} "
+          f"budget={o.get('budget_remaining', 0) * 100:.1f}% "
+          f"alerts={o.get('alerts_fired', 0)} {mark}")
+
+
 def _print_slo(stats: dict):
     """Per-objective burn-rate/budget lines (present when the config has
-    an ``slo:`` section — utils/slo.py)."""
+    an ``slo:`` section — utils/slo.py), plus per-tenant class burn
+    rates and scheduler counters when ``classes:`` are declared
+    (docs/multi-tenancy.md)."""
     slo = stats.get("slo") or {}
     for name in sorted(slo):
-        o = slo[name]
-        mark = "ALERT" if o.get("alerting") else "ok"
-        print(f"  slo {name:12s} [{o.get('kind')} <= {o.get('bound'):g}] "
-              f"burn fast={o.get('burn_fast', 0):.2f} "
-              f"slow={o.get('burn_slow', 0):.2f} "
-              f"budget={o.get('budget_remaining', 0) * 100:.1f}% "
-              f"alerts={o.get('alerts_fired', 0)} {mark}")
+        _slo_line(name, slo[name])
+    classes = stats.get("slo_classes") or {}
+    for cname in sorted(classes):
+        for oname in sorted(classes[cname]):
+            _slo_line(f"{cname}/{oname}", classes[cname][oname])
+    tenants = stats.get("tenants") or {}
+    for tname in sorted(tenants):
+        t = tenants[tname]
+        bound = t.get("shed_wait_ms")
+        print(f"  tenant {tname}: weight={t.get('weight'):g} "
+              f"priority={t.get('priority')} "
+              f"queued={t.get('queued')} drained={t.get('drained')} "
+              f"shed_capacity={t.get('shed_capacity')}"
+              + (f" shed_wait_ms={bound:g}" if bound is not None else ""))
 
 
 def _read_stats_files(workdir: str):
@@ -942,8 +1070,13 @@ def main(argv=None) -> int:
     ap.add_argument("--transport", default=None, metavar="SRC",
                     help="override data.src for this invocation — e.g. "
                          "socket://host:port (the network broker, "
-                         "docs/serving-network.md), file:<dir>, or "
+                         "docs/serving-network.md), shard://h:p1,h:p2 "
+                         "(broker fabric), file:<dir>, or "
                          "host:port for redis; fleet workers inherit it")
+    ap.add_argument("--shards", default=None, type=int,
+                    help="broker: launch a local fabric of N shard "
+                         "brokers and print its shard:// spec "
+                         "(docs/serving-network.md#sharding)")
     ap.add_argument("--foreground", action="store_true",
                     help="start: run in the foreground (containers)")
     ap.add_argument("--warmup", action="store_true",
@@ -1010,7 +1143,8 @@ def main(argv=None) -> int:
     if args.command == "fleet":
         return cmd_fleet(workdir, workers=args.workers)
     if args.command == "broker":
-        return cmd_broker(args.transport or _effective_src(workdir))
+        return cmd_broker(args.transport or _effective_src(workdir),
+                          shards=args.shards)
     if args.command == "status":
         return cmd_status(workdir, watch=args.watch)
     if args.command == "trace":

@@ -56,7 +56,7 @@ import queue
 import threading
 import time
 from collections import Counter
-from typing import List, NamedTuple, Optional, Sequence
+from typing import Dict, List, NamedTuple, Optional, Sequence
 
 import numpy as np
 
@@ -64,10 +64,10 @@ from ..pipeline.inference import InferenceModel
 from ..pipeline.inference.inference_model import AbstractModel
 from ..pipeline.inference.inference_summary import InferenceSummary
 from ..utils import telemetry
-from ..utils.slo import SloEngine, parse_slo_config
+from ..utils.slo import SloEngine, parse_slo_class_config, parse_slo_config
 from ..utils.telemetry import span
-from .admission import (AdaptiveBatcher, AdmissionController, SHED_DEADLINE,
-                        SHED_EXPIRED, now_ms)
+from .admission import (AdaptiveBatcher, AdmissionController, SHED_CAPACITY,
+                        SHED_DEADLINE, SHED_EXPIRED, TenantScheduler, now_ms)
 from .queue_backend import StreamQueue, get_queue_backend
 
 logger = logging.getLogger("analytics_zoo_tpu.serving")
@@ -87,6 +87,7 @@ class RecordMeta(NamedTuple):
     dequeue_ts_ms: Optional[float]   # stamped by the queue backend
     deadline_at_ms: Optional[float]  # absolute deadline; None = no deadline
     trace_id: Optional[str] = None   # client-stamped request trace context
+    tenant: Optional[str] = None     # SLO class name (multi-tenancy)
 
 
 class _RequestLog:
@@ -267,6 +268,9 @@ class ClusterServingHelper:
         # -- SLO objectives (utils/slo.py, docs/observability.md#slo) ----
         self.slo_config = config.get("slo") or {}
         self.slo_objectives = parse_slo_config(self.slo_config)
+        # named SLO classes bound to (model, version) with weights and
+        # shed priorities (docs/multi-tenancy.md)
+        self.slo_classes = parse_slo_class_config(self.slo_config)
         # -- generative serving (docs/serving-generate.md) --------------
         gen = config.get("generate") or {}
         self.generate_slots = int(gen.get("slots") or 4)
@@ -361,6 +365,17 @@ class ClusterServing:
         self.slo: Optional[SloEngine] = None
         if getattr(h, "slo_objectives", None):
             self.slo = SloEngine(h.slo_objectives)
+        # multi-tenant intake (serving/admission.TenantScheduler,
+        # docs/multi-tenancy.md): armed when the config declares SLO
+        # classes; one SloEngine per class with objectives, so burn
+        # rates are evaluated per tenant
+        self.tenants: Optional[TenantScheduler] = None
+        self._class_slo: Dict[str, SloEngine] = {}
+        if getattr(h, "slo_classes", None):
+            self.tenants = TenantScheduler(h.slo_classes)
+            self._class_slo = {c.name: SloEngine(c.objectives,
+                                                 service=c.name)
+                               for c in h.slo_classes if c.objectives}
         # committed-timing jsonl for `zoo-serving trace <id>`
         self._request_log: Optional[_RequestLog] = None
         if getattr(h, "request_log", None):
@@ -442,6 +457,11 @@ class ClusterServing:
         out["admission"] = self.admission.stats()
         if self.slo is not None:
             out["slo"] = self.slo.status()
+        if self.tenants is not None:
+            out["tenants"] = self.tenants.stats()
+        if self._class_slo:
+            out["slo_classes"] = {n: e.status()
+                                  for n, e in self._class_slo.items()}
         if self._gen_sched is not None:
             out["generation"] = self._gen_sched.stats()
         if hasattr(self.db, "consumer_stats"):
@@ -461,19 +481,36 @@ class ClusterServing:
         trace_id = rec.get("trace_id") or rec.get(b"trace_id")
         if isinstance(trace_id, (bytes, bytearray)):
             trace_id = trace_id.decode()
+        tenant = None
+        if self.tenants is not None:
+            model = rec.get("model") or rec.get(b"model")
+            version = rec.get("version") or rec.get(b"version")
+            if isinstance(model, (bytes, bytearray)):
+                model = model.decode()
+            if isinstance(version, (bytes, bytearray)):
+                version = version.decode()
+            tenant = self.tenants.classify(
+                None if model is None else str(model),
+                None if version is None else str(version))
         return RecordMeta(t_in, rec.get("uri", rid), enq,
-                          rec.get("dequeue_ts_ms"), deadline_at, trace_id)
+                          rec.get("dequeue_ts_ms"), deadline_at, trace_id,
+                          tenant)
 
     def _backlog(self) -> int:
-        return sum(q.qsize() for q in self._backlog_queues)
+        n = sum(q.qsize() for q in self._backlog_queues)
+        if self.tenants is not None:
+            n += self.tenants.queued_total()
+        return n
 
     def _shed(self, metas: Sequence[RecordMeta], code: str):
         """Commit typed rejection payloads for records that cannot meet
         their deadline (clients decode these as ServingRejected)."""
         if not metas:
             return
-        msg = ("deadline unmeetable at admission"
-               if code == SHED_DEADLINE else "deadline expired in queue")
+        msg = {SHED_DEADLINE: "deadline unmeetable at admission",
+               SHED_EXPIRED: "deadline expired in queue",
+               SHED_CAPACITY: "shed by tenant policy under pressure",
+               }.get(code, code)
         payload = {}
         for m in metas:
             payload[m.uri] = json.dumps(
@@ -482,6 +519,10 @@ class ClusterServing:
             # rejected request still shows its (truncated) causal tree
             telemetry.event("serving/shed", code=code, uri=m.uri,
                             trace_id=m.trace_id)
+            # a shed is one bad event in the tenant's own SLO stream too
+            eng = self._class_slo.get(m.tenant) if m.tenant else None
+            if eng is not None:
+                eng.record(shed=True)
         self.db.put_results(payload)
         self._count(shed=len(metas))
         telemetry.counter("zoo_serving_shed_total", code=code).inc(len(metas))
@@ -498,6 +539,8 @@ class ClusterServing:
              "uri": meta.uri}
         if meta.trace_id:
             t["trace_id"] = meta.trace_id
+        if meta.tenant:
+            t["tenant"] = meta.tenant
         if meta.enqueue_ts_ms is not None:
             t["enqueue_ts_ms"] = meta.enqueue_ts_ms
         if meta.dequeue_ts_ms is not None:
@@ -522,12 +565,16 @@ class ClusterServing:
                                       timing["transport_in_ms"] / 1e3)
         if "queue_ms" in timing:
             self.summary.record_stage("queue_wait", timing["queue_ms"] / 1e3)
-        if self.slo is not None:
+        if self.slo is not None or self._class_slo:
             if timing.get("enqueue_ts_ms") is not None:
                 lat = timing["done_ts_ms"] - timing["enqueue_ts_ms"]
             else:
                 lat = timing.get("server_ms", timing["device_ms"])
-            self.slo.record(latency_ms=lat)
+            if self.slo is not None:
+                self.slo.record(latency_ms=lat)
+            eng = self._class_slo.get(timing.get("tenant"))
+            if eng is not None:
+                eng.record(latency_ms=lat)
         if self._request_log is not None:
             self._request_log.append(dict(timing, kind="predict"))
 
@@ -932,8 +979,16 @@ class ClusterServing:
             t.start()
         try:
             while not self._stop.is_set():
-                items = self.db.read_batch(self.helper.batch_size,
-                                           timeout=poll_timeout)
+                # bound the per-tenant staging queues: past the cap, stop
+                # pulling from the stream (it has its own watermark trim)
+                # and let the pressure sheds / drain catch up
+                if (self.tenants is not None and
+                        self.tenants.queued_total() >= 4 * self.queue_depth):
+                    items = []
+                    time.sleep(min(poll_timeout, 0.05))
+                else:
+                    items = self.db.read_batch(self.helper.batch_size,
+                                               timeout=poll_timeout)
                 if items:
                     now = time.perf_counter()
                     for rid, rec in items:
@@ -952,8 +1007,28 @@ class ClusterServing:
                             if not ok:
                                 self._shed([meta], code)
                                 continue
-                        decode_in.put((meta, rid, rec))  # backpressure here
+                        if self.tenants is not None:
+                            # stage per tenant; the DRR drain below picks
+                            # the weighted-fair order into the pipeline
+                            self.tenants.offer(meta.tenant,
+                                               (meta, rid, rec))
+                        else:
+                            decode_in.put((meta, rid, rec))  # backpressure
                     self._count(records_in=len(items))
+                if self.tenants is not None:
+                    # second shed point: capacity policy — the least
+                    # important class gives up its oldest queued records
+                    # while any class's predicted wait overruns its bound
+                    pipe_backlog = sum(q.qsize()
+                                       for q in self._backlog_queues)
+                    victims = self.tenants.shed_under_pressure(
+                        self.admission, pipe_backlog)
+                    if victims:
+                        self._shed([item[0] for _t, item in victims],
+                                   SHED_CAPACITY)
+                    for item in self.tenants.drain(self.queue_depth):
+                        decode_in.put(item)  # backpressure here
+                if items or self.tenants is not None:
                     self.summary.record_queue_depth("decode",
                                                     decode_in.qsize())
                     self.summary.record_queue_depth("ready", ready.qsize())
@@ -964,6 +1039,9 @@ class ClusterServing:
         finally:
             # orderly drain: each stage fully flushes before the next
             # stage sees its sentinel, so no in-flight record is lost
+            if self.tenants is not None:
+                for item in self.tenants.drain(1 << 30):
+                    decode_in.put(item)
             for _ in decoders:
                 decode_in.put(_SENTINEL)
             for t in decoders:
@@ -1001,9 +1079,11 @@ class ClusterServing:
         from ..utils import file_io
 
         while True:
-            if self.slo is not None:
+            engines = ([self.slo] if self.slo is not None else []) \
+                + list(self._class_slo.values())
+            for eng in engines:
                 try:
-                    self.slo.evaluate()
+                    eng.evaluate()
                 except Exception as e:  # noqa: BLE001 - observability only
                     logger.debug("slo evaluate failed: %s", e)
             if self.stats_path:
@@ -1021,7 +1101,7 @@ class ClusterServing:
                     self.helper.batch_size,
                     "pipelined" if self.pipelined else "synchronous",
                     self.buckets if self.pipelined else "n/a")
-        if self.stats_path or self.slo is not None:
+        if self.stats_path or self.slo is not None or self._class_slo:
             threading.Thread(target=self._stats_dump_loop, daemon=True,
                              name="serving-stats").start()
         if self.pipelined:

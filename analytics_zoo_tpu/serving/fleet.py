@@ -474,7 +474,11 @@ class ServingFleet:
         (inproc/redis src, or the broker is down this tick)."""
         if self._backlog_q is None:
             src = self.helper.src or ""
-            if not (src.startswith("file:") or src.startswith("socket://")):
+            # shard:// sums stream_len across every healthy shard
+            # (ShardedStreamQueue.stream_len), so scale-up sizing sees
+            # the whole fabric's backlog, not one broker's
+            if not (src.startswith("file:") or src.startswith("socket://")
+                    or src.startswith("shard://")):
                 return None
             from .queue_backend import get_queue_backend
 

@@ -451,7 +451,9 @@ def get_queue_backend(spec: Optional[str] = None) -> StreamQueue:
     """``None``/'inproc' -> InProcessStreamQueue (also registered as the
     process-wide default so clients and server share it); 'file:<dir>' ->
     FileStreamQueue; 'socket://host:port' -> SocketStreamQueue (network
-    broker, serving/socket_queue.py); 'host:port' -> RedisStreamQueue."""
+    broker, serving/socket_queue.py); 'shard://host:p1,host:p2,...' ->
+    ShardedStreamQueue (HRW-sharded broker fabric, serving/
+    shard_fabric.py); 'host:port' -> RedisStreamQueue."""
     global _DEFAULT_INPROC
     if spec is None or spec == "inproc":
         if _DEFAULT_INPROC is None:
@@ -464,6 +466,10 @@ def get_queue_backend(spec: Optional[str] = None) -> StreamQueue:
 
         host, port = parse_socket_spec(spec)
         return SocketStreamQueue(host, port)
+    if spec.startswith("shard://"):
+        from .shard_fabric import ShardedStreamQueue, parse_shard_spec
+
+        return ShardedStreamQueue(parse_shard_spec(spec))
     host, _, port = spec.partition(":")
     return RedisStreamQueue(host, int(port or 6379))
 

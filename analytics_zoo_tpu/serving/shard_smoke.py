@@ -1,0 +1,200 @@
+"""Sharded-fabric chaos smoke (``scripts/shard-smoke``; CI fast tier).
+
+Brings up the fabric's full production shape — two real broker
+*processes*, an in-process :class:`ClusterServing` consuming
+``shard://`` with two SLO tenant classes, and a fabric producer — then
+SIGKILLs one broker mid-burst and asserts the fabric contract
+(docs/serving-network.md#sharding, docs/multi-tenancy.md):
+
+- **exactly-once through broker death**: every uri ends with exactly
+  one result carrying *its own* record's value; records (and unpopped
+  results) the dead broker swallowed are re-driven from the producer's
+  pending ledger with their original dedup tokens, so nothing is lost
+  and nothing double-answers;
+- **tenant classification**: each result's timing payload names the
+  SLO class its (model, version) bound to, and the scheduler drained
+  both classes;
+- **status rows**: ``zoo-serving status`` transport section renders
+  one row per shard, with the killed shard marked DOWN.
+
+Exit 0 on success, 1 on any violated assertion.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import io
+import json
+import os
+import shutil
+import signal
+import socket as socket_mod
+import sys
+import tempfile
+import time
+
+CONFIG_TMPL = """\
+model:
+  stub_ms_per_batch: {stub_ms}
+
+data:
+  src: {src}
+  image_shape: 3, 4, 4
+
+params:
+  batch_size: 4
+  top_n: 0
+  stream_maxlen: 1000000
+
+slo:
+  classes:
+    - name: premium
+      model: m1
+      weight: 3
+      priority: 0
+      objectives:
+        - name: latency
+          p99_ms: 60000
+    - name: batch
+      model: m2
+      weight: 1
+      priority: 1
+      shed_wait_ms: 60000
+"""
+
+
+def _free_ports(n):
+    socks = [socket_mod.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def run_smoke(records: int = 48, stub_ms: float = 2.0,
+              stream=None) -> int:
+    import numpy as np
+
+    from . import cli
+    from .cluster_serving import ClusterServing, ClusterServingHelper
+    from .shard_fabric import (ShardedStreamQueue, spawn_broker_proc,
+                               wait_broker_up)
+
+    out = stream if stream is not None else sys.stdout
+    workdir = tempfile.mkdtemp(prefix="zoo_shard_smoke_")
+    ports = _free_ports(2)
+    spec = "shard://" + ",".join(f"127.0.0.1:{p}" for p in ports)
+    cfg = os.path.join(workdir, "config.yaml")
+    with open(cfg, "w") as f:
+        f.write(CONFIG_TMPL.format(stub_ms=stub_ms, src=spec))
+
+    def fail(msg):
+        out.write(f"SHARD_SMOKE_FAIL: {msg}\n")
+        return 1
+
+    procs = [spawn_broker_proc(p, claim_timeout_s=5.0) for p in ports]
+    serving = None
+    old_env = os.environ.get("ZOO_SERVING_TRANSPORT")
+    try:
+        for p in ports:
+            wait_broker_up("127.0.0.1", p)
+        serving = ClusterServing(
+            helper=ClusterServingHelper(config_path=cfg)).start()
+        q = ShardedStreamQueue([("127.0.0.1", p) for p in ports],
+                               probe_interval_s=0.2)
+        uris = [f"u-{i}" for i in range(records)]
+        for i, uri in enumerate(uris):
+            q.enqueue({
+                "uri": uri, "model": "m1" if i % 2 else "m2",
+                "tensors": {"t": {
+                    "data": np.full((3, 4, 4), float(i),
+                                    np.float32).tobytes(),
+                    "shape": [3, 4, 4]}},
+                "enqueue_ts_ms": time.time() * 1e3})
+
+        # -- mid-burst: wait for first results, then SIGKILL shard 0 --
+        results = {}
+        deadline = time.time() + 30.0
+        while len(results) < records // 4:
+            if time.time() > deadline:
+                return fail("burst never started draining")
+            results.update(q.all_results(pop=True))
+            time.sleep(0.02)
+        os.kill(procs[0].pid, signal.SIGKILL)
+        procs[0].wait(timeout=10)
+
+        # -- recovery: popped results are ground truth; re-drive what
+        # the dead broker swallowed via the producer's pending ledger -
+        deadline = time.time() + 60.0
+        while len(results) < records and time.time() < deadline:
+            got = q.all_results(pop=True)
+            results.update(got)
+            if not got:
+                q.reenqueue_missing(u for u in uris if u not in results)
+                time.sleep(0.1)
+        if len(results) != records:
+            missing = [u for u in uris if u not in results][:8]
+            return fail(f"only {len(results)}/{records} results after "
+                        f"kill (missing {missing}...)")
+        for i, uri in enumerate(uris):
+            row = json.loads(results[uri])
+            if abs(float(row["value"][0]) - i) > 1e-4:
+                return fail(f"{uri} value {row['value'][0]} != {i} "
+                            f"(cross-wired: not exactly-once)")
+            want = "premium" if i % 2 else "batch"
+            if row["timing"].get("tenant") != want:
+                return fail(f"{uri} classified "
+                            f"{row['timing'].get('tenant')} != {want}")
+        if q.all_results(pop=True):
+            return fail("duplicate results after recovery")
+        if q.reenqueued < 1:
+            return fail("broker death re-drove nothing (reenqueued=0)")
+        st = serving.pipeline_stats()
+        tn = st.get("tenants", {})
+        if not (tn.get("premium", {}).get("drained", 0) > 0
+                and tn.get("batch", {}).get("drained", 0) > 0):
+            return fail(f"tenant scheduler drained nothing: {tn}")
+
+        # -- status: one row per shard, dead shard marked DOWN --------
+        os.environ["ZOO_SERVING_TRANSPORT"] = spec
+        cap = io.StringIO()
+        with contextlib.redirect_stdout(cap):
+            cli._print_transport(workdir)
+        status = cap.getvalue()
+        if status.count("shard socket://") != 2:
+            return fail(f"expected 2 shard rows in status:\n{status}")
+        if "health=DOWN" not in status or "healthy=1/2" not in status:
+            return fail(f"killed shard not marked DOWN:\n{status}")
+
+        out.write(f"SHARD_SMOKE_OK records={records} "
+                  f"reenqueued={q.reenqueued} failovers={q.failovers} "
+                  f"premium_drained={tn['premium']['drained']} "
+                  f"batch_drained={tn['batch']['drained']}\n")
+        return 0
+    finally:
+        if old_env is None:
+            os.environ.pop("ZOO_SERVING_TRANSPORT", None)
+        else:
+            os.environ["ZOO_SERVING_TRANSPORT"] = old_env
+        if serving is not None:
+            serving.stop()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            p.wait(timeout=10)
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="shard-smoke")
+    ap.add_argument("--records", type=int, default=48)
+    ap.add_argument("--stub-ms", type=float, default=2.0)
+    args = ap.parse_args(argv)
+    return run_smoke(records=args.records, stub_ms=args.stub_ms)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -151,9 +151,17 @@ class StreamQueueBroker:
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 name: str = "image_stream", claim_timeout_s: float = 60.0):
+                 name: str = "image_stream", claim_timeout_s: float = 60.0,
+                 op_cost_ms: float = 0.0):
         self.name = name
         self.claim_timeout_s = float(claim_timeout_s)
+        # stubbed serialized-core cost: sleep this long INSIDE the stream
+        # lock on each data-plane op, so scale-out benches on a 1-core
+        # host can model N brokers on N cores (sleeping releases the GIL,
+        # so two brokers' ops overlap the way two cores would, while one
+        # broker's ops stay serialized on its lock).  0 = off; see
+        # BENCH_NOTES.md for the stubbed-cost methodology.
+        self.op_cost_ms = float(op_cost_ms)
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)          # stream
         self._results_cv = threading.Condition(self._lock)  # results
@@ -177,6 +185,8 @@ class StreamQueueBroker:
         self._server.broker = self
         self.host, self.port = self._server.server_address[:2]
         self._thread: Optional[threading.Thread] = None
+        self._shutdown_once = threading.Lock()
+        self._shut_down = False
 
     @property
     def address(self) -> str:
@@ -196,6 +206,14 @@ class StreamQueueBroker:
         self._server.serve_forever(poll_interval=0.1)
 
     def shutdown(self):
+        # Idempotent and thread-safe: the CLI's SIGTERM handler shuts
+        # down from a helper thread while the foreground finally-block
+        # does the same (server.shutdown() must never run on the thread
+        # inside serve_forever, or it deadlocks waiting for the ack).
+        with self._shutdown_once:
+            if self._shut_down:
+                return
+            self._shut_down = True
         self._server.shutdown()
         self._server.server_close()
         if self._thread is not None:
@@ -263,6 +281,8 @@ class StreamQueueBroker:
         toks = req.get("toks") or [None] * len(records)
         rids = []
         with self._cv:
+            if self.op_cost_ms:
+                time.sleep(self.op_cost_ms / 1e3)
             for rec, tok in zip(records, toks):
                 if tok is not None and tok in self._tokens:
                     rids.append(self._tokens[tok])   # retried send: dedup
@@ -284,6 +304,8 @@ class StreamQueueBroker:
         max_items = int(req.get("max", 1))
         deadline = time.time() + float(req.get("timeout_ms", 1000)) / 1e3
         with self._cv:
+            if self.op_cost_ms:
+                time.sleep(self.op_cost_ms / 1e3)
             # this connection is now the consumer's lease: its death
             # triggers redelivery of whatever this read hands out
             self._consumer_conn[consumer] = conn_id
@@ -490,9 +512,13 @@ class SocketStreamQueue(StreamQueue):
         return resp
 
     # -- StreamQueue contract -------------------------------------------
-    def enqueue(self, record: dict) -> str:
+    def enqueue(self, record: dict, token: Optional[str] = None) -> str:
+        # a caller-supplied token lets a fabric retry the SAME logical
+        # send against this broker without double-inserting (shard
+        # failover reuses one token across attempts)
         return self._request({"op": "enqueue", "records": [record],
-                              "toks": [uuid.uuid4().hex]})["rids"][0]
+                              "toks": [token or uuid.uuid4().hex]}
+                             )["rids"][0]
 
     def read_batch(self, max_items: int, timeout: float = 1.0
                    ) -> List[Tuple[str, dict]]:
@@ -576,11 +602,15 @@ def main(argv=None) -> int:  # pragma: no cover - CLI entry
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--port", type=int, default=6380)
     ap.add_argument("--claim-timeout-s", type=float, default=60.0)
+    ap.add_argument("--op-cost-ms", type=float, default=0.0,
+                    help="stubbed serialized-core cost per data-plane op "
+                         "(scale-out benches on few-core hosts)")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s broker %(message)s")
     broker = StreamQueueBroker(host=args.host, port=args.port,
-                               claim_timeout_s=args.claim_timeout_s)
+                               claim_timeout_s=args.claim_timeout_s,
+                               op_cost_ms=args.op_cost_ms)
     try:
         broker.run_forever()
     except KeyboardInterrupt:

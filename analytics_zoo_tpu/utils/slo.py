@@ -41,6 +41,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from . import telemetry
 
 __all__ = ["Objective", "SloEngine", "parse_slo_config",
+           "SloClass", "parse_slo_class_config", "match_slo_class",
            "DEFAULT_FAST_WINDOW_S", "DEFAULT_SLOW_WINDOW_S",
            "DEFAULT_BURN_THRESHOLD"]
 
@@ -140,6 +141,108 @@ def parse_slo_config(cfg: Optional[dict]) -> List[Objective]:
             slow_window_s=float(entry.get("slow_window_s") or slow),
             burn_threshold=float(entry.get("burn_threshold") or burn)))
     return out
+
+
+@dataclass
+class SloClass:
+    """A named tenant: an SLO class bound to (model, version) with a
+    fair-share weight and a shed priority (docs/multi-tenancy.md).
+
+    - ``weight`` is the deficit-round-robin share of intake capacity
+      (a weight-3 class drains 3 records for every 1 a weight-1 class
+      does while both have backlog);
+    - ``priority`` orders sheds under pressure — LOWER is more
+      important, so the highest-priority-number class sheds first;
+    - ``shed_wait_ms`` is the predicted-wait bound above which this
+      class's queued records are shed (defaults to the tightest
+      latency-objective bound, since queueing past it makes the
+      objective unmeetable);
+    - ``model``/``version`` bind traffic: exact (model, version) beats
+      model-only beats the catch-all (``model: None``)."""
+
+    name: str
+    weight: float = 1.0
+    priority: int = 0
+    model: Optional[str] = None
+    version: Optional[str] = None
+    shed_wait_ms: Optional[float] = None
+    objectives: List[Objective] = field(default_factory=list)
+
+    def __post_init__(self):
+        if self.weight <= 0:
+            raise ValueError(f"slo class {self.name}: weight must be "
+                             f"> 0, got {self.weight}")
+        if self.shed_wait_ms is None:
+            bounds = [o.bound for o in self.objectives
+                      if o.kind in KIND_LATENCY]
+            self.shed_wait_ms = min(bounds) if bounds else None
+
+
+def parse_slo_class_config(cfg: Optional[dict]) -> List[SloClass]:
+    """Build tenant classes from the ``slo:`` section's ``classes:``::
+
+        slo:
+          classes:
+            - name: premium
+              model: resnet50        # omit for a catch-all class
+              version: "2"           # optional; omit to match any
+              weight: 3              # DRR fair share (default 1)
+              priority: 0            # lower sheds LAST (default 0)
+              shed_wait_ms: 250      # default: tightest latency bound
+              objectives:
+                - name: latency
+                  p99_ms: 250
+
+    Per-class objectives inherit the section-level window/threshold
+    defaults exactly like the top-level ``objectives:`` list."""
+    if not cfg:
+        return []
+    out: List[SloClass] = []
+    seen = set()
+    for i, entry in enumerate(cfg.get("classes") or []):
+        name = str(entry.get("name") or f"class-{i}")
+        if name in seen:
+            raise ValueError(f"duplicate slo class name {name!r}")
+        seen.add(name)
+        objectives = parse_slo_config(
+            {**{k: cfg.get(k) for k in ("fast_window_s", "slow_window_s",
+                                        "burn_threshold")},
+             "objectives": entry.get("objectives") or []})
+        model = entry.get("model")
+        version = entry.get("version")
+        shed_wait = entry.get("shed_wait_ms")
+        out.append(SloClass(
+            name=name,
+            weight=float(entry.get("weight", 1.0)),
+            priority=int(entry.get("priority", 0)),
+            model=None if model is None else str(model),
+            version=None if version is None else str(version),
+            shed_wait_ms=None if shed_wait is None else float(shed_wait),
+            objectives=objectives))
+    return out
+
+
+def match_slo_class(classes: Sequence[SloClass], model: Optional[str],
+                    version: Optional[str]) -> Optional[SloClass]:
+    """Most-specific class for a request: exact (model, version) >
+    model-only > catch-all (``model: None``); None if nothing binds."""
+    best: Optional[SloClass] = None
+    best_rank = -1
+    for cls in classes:
+        if cls.model is None:
+            rank = 0
+        elif cls.model == model:
+            if cls.version is None:
+                rank = 1
+            elif cls.version == version:
+                rank = 2
+            else:
+                continue
+        else:
+            continue
+        if rank > best_rank:
+            best, best_rank = cls, rank
+    return best
 
 
 class _ObjectiveState:

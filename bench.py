@@ -1727,6 +1727,347 @@ def bench_network_serving(n_records=400, batch_size=8, stub_ms=0.5):
     return out
 
 
+def bench_shard_fabric(n_records=360, op_cost_ms=2.0, batch_size=8,
+                       producers=4, consumers=3):
+    """Sharded-fabric leg (docs/serving-network.md#sharding): the same
+    producer/consumer burst through a ShardedStreamQueue over ONE
+    broker vs over TWO, with each broker charging ``op_cost_ms`` of
+    serialized stream-lock time per enqueue/read op — the stubbed
+    "one core per broker" cost (the repo's rtt-stub methodology), so
+    scale-out is measurable on a shared CPU host where two broker
+    threads would otherwise contend for the same core.  The sleep
+    releases the GIL, so two brokers genuinely overlap.
+
+    Acceptance gates: the 2-shard fabric sustains >= 1.5x the 1-shard
+    req/s at <= 1.1x the p99; and a chaos phase (two real broker
+    *processes*, one SIGKILLed mid-burst after a vulture consumer
+    abandons claims) ends exactly-once — every record has exactly one
+    correct result, ``reenqueued > 0`` (the pending ledger re-drove
+    what the dead broker swallowed) and ``redelivered > 0`` (the
+    survivor requeued the vulture's claims on EOF).
+    """
+    import signal as _signal
+    import socket as _socket
+    import threading
+
+    from analytics_zoo_tpu.serving.shard_fabric import (
+        LocalShardFabric, ShardedStreamQueue, spawn_broker_proc,
+        wait_broker_up)
+
+    out = {}
+
+    # -- phase 1: scale-out A/B (1 shard vs 2, stubbed broker core) ----
+    def _arm(n_shards):
+        fab = LocalShardFabric(n_shards, op_cost_ms=op_cost_ms).start()
+        stop = threading.Event()
+        enq_ts, done_ts = {}, {}
+        ts_lock = threading.Lock()
+
+        def _produce(span):
+            q = fab.queue()
+            for i in span:
+                uri = f"f-{i}"
+                with ts_lock:
+                    enq_ts[uri] = time.perf_counter()
+                q.enqueue({"uri": uri, "data": b"x" * 64, "shape": [16]})
+
+        def _consume():
+            q = fab.queue()
+            while not stop.is_set():
+                items = q.read_batch(batch_size, timeout=0.1)
+                if items:
+                    q.put_results({r["uri"]: b"ok" for _i, r in items})
+
+        try:
+            per = n_records // producers
+            spans = [range(j * per, (j + 1) * per if j < producers - 1
+                           else n_records) for j in range(producers)]
+            threads = [threading.Thread(target=_produce, args=(s,),
+                                        daemon=True) for s in spans]
+            threads += [threading.Thread(target=_consume, daemon=True)
+                        for _ in range(consumers)]
+            collector = fab.queue()
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            results = {}
+            deadline = time.time() + 180.0
+            while len(results) < n_records and time.time() < deadline:
+                got = collector.all_results(pop=True)
+                now = time.perf_counter()
+                for u in got:
+                    done_ts[u] = now
+                results.update(got)
+                if not got:
+                    time.sleep(0.002)
+            wall = time.perf_counter() - t0
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+            lat = np.asarray([1e3 * (done_ts[u] - enq_ts[u])
+                              for u in results]) if results else \
+                np.asarray([0.0])
+            return {"served": len(results),
+                    "rec_per_s": round(len(results) / wall, 1),
+                    "p50_ms": round(float(np.percentile(lat, 50)), 2),
+                    "p99_ms": round(float(np.percentile(lat, 99)), 2)}
+        finally:
+            stop.set()
+            fab.shutdown()
+
+    # median of 3 interleaved windows per arm: the dual p99 rides the
+    # consumers' poll-slice tail, which is noisy run to run
+    runs = {"single": [], "dual": []}
+    for _ in range(3):
+        runs["single"].append(_arm(1))
+        runs["dual"].append(_arm(2))
+    single, dual = {}, {}
+    for name, res in (("single", single), ("dual", dual)):
+        for k in ("served", "rec_per_s", "p50_ms", "p99_ms"):
+            vals = sorted(r[k] for r in runs[name])
+            res[k] = vals[len(vals) // 2]
+        for k, v in res.items():
+            out[f"shard_{name}_{k}"] = v
+    ratio = dual["rec_per_s"] / max(single["rec_per_s"], 1e-9)
+    out["shard_dual_vs_single"] = round(ratio, 2)
+    out["shard_complete_ok"] = _gate(
+        "shard_all_records_served",
+        single["served"] == dual["served"] == n_records,
+        f"single {single['served']}, dual {dual['served']} "
+        f"of {n_records}")
+    out["shard_scaleout_ok"] = _gate(
+        "shard_dual_ge_1p5x_single", ratio >= 1.5,
+        f"dual {dual['rec_per_s']} vs single {single['rec_per_s']} "
+        f"rec/s ({ratio:.2f}x < 1.5x)")
+    out["shard_p99_ok"] = _gate(
+        "shard_dual_p99_le_1p1x_single",
+        dual["p99_ms"] <= single["p99_ms"] * 1.1,
+        f"dual p99 {dual['p99_ms']}ms > 1.1x single p99 "
+        f"{single['p99_ms']}ms")
+
+    # -- phase 2: chaos — SIGKILL one of two broker processes ----------
+    ports = []
+    for _ in range(2):
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        s.close()
+    procs = [spawn_broker_proc(p, claim_timeout_s=5.0) for p in ports]
+    try:
+        for p in ports:
+            wait_broker_up("127.0.0.1", p)
+        q = ShardedStreamQueue([("127.0.0.1", p) for p in ports],
+                               probe_interval_s=0.2)
+        n = 80
+        uris = [f"c-{i}" for i in range(n)]
+        for uri in uris:
+            q.enqueue({"uri": uri, "data": uri.encode(), "shape": [1]})
+        # vulture: claims a batch ON THE SURVIVOR (ports[1]; ports[0] is
+        # the one SIGKILLed below), then drops the connection without
+        # acking -> the survivor must redeliver those claims on EOF
+        from analytics_zoo_tpu.serving import SocketStreamQueue
+        vulture = SocketStreamQueue("127.0.0.1", ports[1])
+        vultured = len(vulture.read_batch(6, timeout=2.0))
+        vulture.close()
+        results = {}
+        deadline = time.time() + 30.0
+        while len(results) < n // 4 and time.time() < deadline:
+            batch = {rec["uri"]: rec["data"]
+                     for _r, rec in q.read_batch(8, timeout=0.5)}
+            if batch:
+                q.put_results(batch)
+            results.update(q.all_results(pop=True))
+        os.kill(procs[0].pid, _signal.SIGKILL)
+        procs[0].wait(timeout=10)
+        deadline = time.time() + 60.0
+        while len(results) < n and time.time() < deadline:
+            batch = {rec["uri"]: rec["data"]
+                     for _r, rec in q.read_batch(8, timeout=0.5)}
+            if batch:
+                q.put_results(batch)
+            results.update(q.all_results(pop=True))
+            if not batch:
+                q.reenqueue_missing(u for u in uris if u not in results)
+        cross_wired = sum(1 for u, v in results.items()
+                          if v != u.encode())
+        redelivered = sum(r.get("redelivered", 0)
+                          for r in q.stats()["shards"] if r["alive"])
+        out["shard_chaos_results"] = len(results)
+        out["shard_chaos_lost"] = n - len(results)
+        out["shard_chaos_reenqueued"] = q.reenqueued
+        out["shard_chaos_redelivered"] = redelivered
+        out["shard_chaos_vultured_claims"] = vultured
+        out["shard_chaos_ok"] = _gate(
+            "shard_chaos_exactly_once",
+            len(results) == n and cross_wired == 0
+            and not q.all_results(pop=True) and q.reenqueued > 0,
+            f"results {len(results)}/{n} cross_wired={cross_wired} "
+            f"reenqueued={q.reenqueued}")
+        out["shard_chaos_redelivery_ok"] = _gate(
+            "shard_chaos_redelivered_gt_0", redelivered > 0,
+            f"vultured {vultured} claims but survivor redelivered "
+            f"{redelivered}")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            p.wait(timeout=10)
+    return out
+
+
+def bench_tenant_slo(steady_qps=50.0, duration_s=10.0, burst_factor=4,
+                     batch_size=8, stub_ms=4.0, premium_p99_ms=400.0,
+                     batch_shed_wait_ms=40.0):
+    """Multi-tenant SLO leg (docs/multi-tenancy.md): two named SLO
+    classes through one pipelined server — ``premium`` (weight 3,
+    priority 0, p99 latency objective) and ``batch`` (weight 1,
+    priority 1, tight shed-wait bound) — both paced at ``steady_qps``;
+    mid-run the batch tenant bursts ``burst_factor``x its whole steady
+    window in one shot.  Weighted-fair intake (deficit round-robin)
+    plus priority shedding must isolate the premium tenant:
+
+    - premium served-row p99 stays inside its SLO bound;
+    - premium's burn-rate engine fires ZERO alerts and premium sheds
+      nothing;
+    - the batch tenant absorbs the burst as typed capacity sheds
+      (``shed_capacity > 0``), not as premium latency.
+    """
+    import threading
+
+    from analytics_zoo_tpu.serving import (ClusterServing,
+                                           ClusterServingHelper,
+                                           InProcessStreamQueue,
+                                           InputQueue, OutputQueue,
+                                           ServingRejected, ServingResult)
+
+    helper = ClusterServingHelper(config={
+        "model": {"stub_ms_per_batch": stub_ms},
+        "data": {"image_shape": "3, 8, 8"},
+        "params": {"batch_size": batch_size, "top_n": 0,
+                   "decode_workers": 2, "pipelined": True,
+                   "linger_ms": 2.0},
+        "slo": {"fast_window_s": 3.0, "slow_window_s": 9.0,
+                "burn_threshold": 2.0,
+                "classes": [
+                    {"name": "premium", "model": "m1", "weight": 3,
+                     "priority": 0,
+                     "objectives": [{"name": "latency",
+                                     "p99_ms": premium_p99_ms}]},
+                    {"name": "batch", "model": "m2", "weight": 1,
+                     "priority": 1,
+                     "shed_wait_ms": batch_shed_wait_ms}]}})
+    backend = InProcessStreamQueue()
+    serving = ClusterServing(helper=helper, backend=backend)
+    in_q = InputQueue(backend=backend)
+    x = np.full((3, 8, 8), 7, np.float32)
+    prem_uris, batch_uris = [], []
+    stop = threading.Event()
+
+    def _produce(model, uris, prefix):
+        period = 1.0 / steady_qps
+        i = 0
+        t_next = time.perf_counter()
+        while not stop.is_set():
+            uri = f"{prefix}-{i}"
+            in_q.enqueue(uri, model=model, input=x)
+            uris.append(uri)
+            i += 1
+            t_next += period
+            delay = t_next - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+
+    serving.start()
+    threads = [threading.Thread(target=_produce, args=("m1", prem_uris,
+                                                       "prem"),
+                                daemon=True),
+               threading.Thread(target=_produce, args=("m2", batch_uris,
+                                                       "bat"),
+                                daemon=True)]
+    for t in threads:
+        t.start()
+    # mid-run: the low-priority tenant bursts 4x its whole steady window
+    time.sleep(duration_s * 0.4)
+    n_burst = int(burst_factor * steady_qps * duration_s)
+    for i in range(n_burst):
+        uri = f"bat-burst-{i}"
+        in_q.enqueue(uri, model="m2", input=x)
+        batch_uris.append(uri)
+    time.sleep(duration_s * 0.6)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    # one wait over BOTH tenants: on a polling backend wait_all pops
+    # every landed result, so per-tenant waits would steal each other's
+    got = OutputQueue(backend=backend).wait_all(
+        list(prem_uris) + list(batch_uris), timeout=180, max_poll=0.05)
+    got_prem = {u: v for u, v in got.items() if u.startswith("prem-")}
+    got_batch = {u: v for u, v in got.items() if u.startswith("bat-")}
+    stats = serving.pipeline_stats()
+    prem_alerts = serving._class_slo["premium"].total_alerts()
+    serving.stop()
+
+    def _split(got):
+        served_ms, shed = [], 0
+        for v in got.values():
+            if isinstance(v, ServingRejected):
+                shed += 1
+                continue
+            t = getattr(v, "timing", None) \
+                if isinstance(v, ServingResult) else None
+            if t and t.get("enqueue_ts_ms") and t.get("done_ts_ms"):
+                served_ms.append(t["done_ts_ms"] - t["enqueue_ts_ms"])
+        return np.asarray(served_ms if served_ms else [0.0]), shed
+
+    prem_ms, prem_shed = _split(got_prem)
+    batch_ms, batch_shed = _split(got_batch)
+    tn = stats.get("tenants", {})
+    out = {
+        "tenant_premium_offered": len(prem_uris),
+        "tenant_premium_served": len(got_prem) - prem_shed,
+        "tenant_premium_shed": prem_shed,
+        "tenant_premium_p50_ms":
+            round(float(np.percentile(prem_ms, 50)), 2),
+        "tenant_premium_p99_ms":
+            round(float(np.percentile(prem_ms, 99)), 2),
+        "tenant_premium_alerts": prem_alerts,
+        "tenant_batch_offered": len(batch_uris),
+        "tenant_batch_served": len(got_batch) - batch_shed,
+        "tenant_batch_shed": batch_shed,
+        "tenant_batch_p99_ms":
+            round(float(np.percentile(batch_ms, 99)), 2),
+        "tenant_batch_shed_capacity":
+            tn.get("batch", {}).get("shed_capacity", 0),
+        "tenant_slo_classes": {
+            cname: {oname: {k: s[k] for k in
+                            ("burn_fast", "burn_slow",
+                             "budget_remaining", "alerting",
+                             "alerts_fired")}
+                    for oname, s in status.items()}
+            for cname, status in stats.get("slo_classes", {}).items()},
+    }
+    out["tenant_premium_p99_ok"] = _gate(
+        "tenant_premium_p99_within_slo",
+        out["tenant_premium_p99_ms"] <= premium_p99_ms,
+        f"premium p99 {out['tenant_premium_p99_ms']}ms > SLO bound "
+        f"{premium_p99_ms}ms under batch burst")
+    out["tenant_premium_alerts_ok"] = _gate(
+        "tenant_premium_zero_alerts", prem_alerts == 0,
+        f"{prem_alerts} premium burn-rate alert(s) fired")
+    out["tenant_premium_sheds_ok"] = _gate(
+        "tenant_premium_zero_sheds",
+        prem_shed == 0 and
+        tn.get("premium", {}).get("shed_capacity", 0) == 0,
+        f"premium shed {prem_shed} "
+        f"(scheduler {tn.get('premium', {}).get('shed_capacity')})")
+    out["tenant_batch_sheds_ok"] = _gate(
+        "tenant_batch_absorbs_sheds",
+        batch_shed > 0 and out["tenant_batch_shed_capacity"] > 0,
+        f"batch burst produced {batch_shed} typed sheds "
+        f"(scheduler {out['tenant_batch_shed_capacity']})")
+    return out
+
+
 def bench_generation(n_requests=48, slots=8, step_ms=2.0):
     """Generative-serving leg (docs/serving-generate.md): the identical
     skewed request mix (1 in 4 requests wants 32 tokens, the rest 4 —
@@ -2895,6 +3236,41 @@ def main():
                                        if str(e) else repr(e)[:500])
             _gate("network_measured", False, RESULT["network_error"])
         _stamp_leg_artifacts("network")
+        emit()
+
+    # Sharded-fabric leg: the same burst over a 1-shard vs 2-shard
+    # fabric with a stubbed per-op broker-core cost (2-shard must serve
+    # >= 1.5x req/s at <= 1.1x p99), plus the chaos phase — SIGKILL one
+    # of two real broker processes mid-burst and end exactly-once with
+    # reenqueued > 0 and redelivered > 0
+    # (docs/serving-network.md#sharding). Host-side, CPU-provable.
+    if time.time() - T_START < TOTAL_BUDGET_S * 0.9:
+        try:
+            RESULT.update(bench_shard_fabric())
+        except Exception as e:  # noqa: BLE001
+            import traceback
+            traceback.print_exc()
+            RESULT["shard_error"] = (str(e).splitlines()[0][:500]
+                                     if str(e) else repr(e)[:500])
+            _gate("shard_measured", False, RESULT["shard_error"])
+        _stamp_leg_artifacts("shard")
+        emit()
+
+    # Multi-tenant SLO leg: premium (weight 3, prio 0) + batch
+    # (weight 1, prio 1) classes through one server; a 4x burst on the
+    # batch tenant must land as typed batch sheds while premium p99 and
+    # burn rate stay inside its SLO with zero alerts
+    # (docs/multi-tenancy.md). Host-side, CPU-provable.
+    if time.time() - T_START < TOTAL_BUDGET_S * 0.9:
+        try:
+            RESULT.update(bench_tenant_slo())
+        except Exception as e:  # noqa: BLE001
+            import traceback
+            traceback.print_exc()
+            RESULT["tenant_error"] = (str(e).splitlines()[0][:500]
+                                      if str(e) else repr(e)[:500])
+            _gate("tenant_measured", False, RESULT["tenant_error"])
+        _stamp_leg_artifacts("tenant")
         emit()
 
     # Generative-serving leg: continuous vs static batching tokens/s +
