@@ -24,6 +24,8 @@ from .queue_backend import (DeliveryLedger, FileStreamQueue,
                             get_queue_backend)
 from .shard_fabric import (LocalShardFabric, ShardedStreamQueue,
                            parse_shard_spec)
+from .routing import (GenerateRouter, RouteDecision, RoutedGenerateQueue,
+                      WorkerIntakeQueue, WorkerReport)
 from .socket_queue import SocketStreamQueue, StreamQueueBroker
 from .registry import (CanaryState, DeployError, ModelRegistry,
                        ModelVersion, RegistryControlServer, RegistryError,
@@ -46,4 +48,6 @@ __all__ = ["InputQueue", "OutputQueue", "API", "ServingError",
            "StreamQueueBroker", "ShardedStreamQueue", "LocalShardFabric",
            "parse_shard_spec",
            "GenerationResult", "ContinuousBatchScheduler", "GenRequest",
-           "StubDecodeEngine", "TransformerDecodeEngine"]
+           "StubDecodeEngine", "TransformerDecodeEngine",
+           "GenerateRouter", "RouteDecision", "RoutedGenerateQueue",
+           "WorkerIntakeQueue", "WorkerReport"]

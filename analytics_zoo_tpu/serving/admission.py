@@ -416,38 +416,54 @@ class BacklogAutoscaler:
         self._last_change: float = -1e12
 
     def predicted_wait_ms(self, backlog: int, record_ms: float,
-                          batch_ms: float, workers: int) -> float:
+                          batch_ms: float, workers: int, *,
+                          gen_steps: float = 0.0,
+                          token_ms: float = 0.0) -> float:
         """Expected finish time for a record arriving now, with the
-        backlog drained in parallel across ``workers``."""
+        backlog drained in parallel across ``workers``.  ``gen_steps``
+        weighs the generate backlog in queued *decode steps* times the
+        EWMA per-token cost — one queued 512-token generation is 512
+        steps of work, not one record."""
         return (max(int(backlog), 0) * max(record_ms, 0.0)
-                / max(int(workers), 1) + max(batch_ms, 0.0))
+                / max(int(workers), 1)
+                + max(gen_steps, 0.0) * max(token_ms, 0.0)
+                / max(int(workers), 1)
+                + max(batch_ms, 0.0))
 
     def desired(self, backlog: int, record_ms: float, batch_ms: float,
-                workers: int, now: Optional[float] = None
+                workers: int, now: Optional[float] = None, *,
+                gen_steps: float = 0.0, token_ms: float = 0.0
                 ) -> Tuple[int, Optional[str]]:
         """(desired_workers, reason) — reason is None when no change."""
         now = time.time() if now is None else now
         workers = max(int(workers), 1)
         wait = self.predicted_wait_ms(backlog, record_ms, batch_ms,
-                                      workers)
+                                      workers, gen_steps=gen_steps,
+                                      token_ms=token_ms)
         threshold = self.scale_up_fraction * self.target_ms
-        if backlog > 0:
+        if backlog > 0 or gen_steps > 0:
             self._idle_since = None
         elif self._idle_since is None:
             self._idle_since = now
         if now - self._last_change < self.cooldown_s:
             return workers, None
         if wait > threshold and workers < self.max_workers:
-            # size the jump: workers needed so the drain term fits the
-            # slack left after one batch (>= +1, <= max)
+            # size the jump: workers needed so the drain term (predict
+            # records + generate decode steps) fits the slack left
+            # after one batch (>= +1, <= max)
             slack = max(threshold - batch_ms, 1.0)
-            need = math.ceil(backlog * record_ms / slack) \
-                if record_ms > 0 else workers + 1
+            work_ms = (max(int(backlog), 0) * max(record_ms, 0.0)
+                       + max(gen_steps, 0.0) * max(token_ms, 0.0))
+            need = math.ceil(work_ms / slack) \
+                if work_ms > 0 else workers + 1
             target = min(self.max_workers, max(workers + 1, need))
             self._last_change = now
             self._idle_since = None
+            detail = f" + {gen_steps:.0f} decode steps" \
+                if gen_steps > 0 else ""
             return target, (f"predicted wait {wait:.0f}ms > "
-                            f"{threshold:.0f}ms at backlog {backlog}")
+                            f"{threshold:.0f}ms at backlog "
+                            f"{backlog}{detail}")
         if (workers > self.min_workers and self._idle_since is not None
                 and now - self._idle_since >= self.idle_s):
             self._last_change = now

@@ -52,6 +52,7 @@ import signal
 import sys
 import threading
 import time
+from typing import Optional
 
 from ..utils import telemetry
 
@@ -697,14 +698,19 @@ def _render_status(workdir: str) -> int:
               f"batches={stats.get('batches', 0)}")
         _print_stage_percentiles(stats)
         _print_slo(stats)
+        _print_fleet_generation(_read_stats_files(workdir))
+        _print_routing_rows(workdir)
         if stats.get("models"):
             _print_models(stats["models"])
             return 0
     elif fleet_rows:
-        for name, st in _read_stats_files(workdir):
+        frames = _read_stats_files(workdir)
+        for name, st in frames:
             if name == STATSFILE:
                 continue
             _print_slo(st)
+        _print_fleet_generation(frames)
+        _print_routing_rows(workdir)
     # registry mode but no stats dump yet: fall back to the manifest
     root = _registry_root(workdir)
     if root:
@@ -732,33 +738,82 @@ def cmd_status(workdir: str, watch: float = None) -> int:
     return 0
 
 
-def _print_generation(st: dict):
-    """One line per worker summarising the generative fast path:
-    occupancy, prefill dispatches, prefix-cache hit ratio and resident
-    bytes, draft acceptance."""
-    gen = st.get("generation")
-    if not gen:
+def _merged_generation(frames) -> Optional[dict]:
+    """Fleet-merged generate section over every stats frame carrying
+    one: counter sums, weighted prefix hit ratio (Σhits over Σlookups,
+    not a mean of per-worker ratios), mean draft acceptance."""
+    tot = {"frames": 0, "active": 0, "cap": 0, "queue": 0,
+           "pending_steps": 0, "tokens": 0, "joins": 0, "shed": 0,
+           "prefills": 0, "hits": 0, "lookups": 0, "bytes": 0,
+           "accept_sum": 0.0, "accept_n": 0, "tps_sum": 0.0}
+    for _name, st in frames:
+        gen = st.get("generation")
+        if not gen:
+            continue
+        tot["frames"] += 1
+        tot["active"] += gen.get("active_slots", 0)
+        tot["cap"] += gen.get("capacity", 0)
+        tot["queue"] += gen.get("queue_depth", 0)
+        tot["pending_steps"] += gen.get("pending_steps", 0)
+        tot["tokens"] += gen.get("tokens", 0)
+        tot["joins"] += gen.get("joins", 0)
+        tot["shed"] += gen.get("shed", 0)
+        eng = gen.get("engine") or {}
+        target = eng.get("target") or {}
+        tot["prefills"] += eng.get("prefill_calls",
+                                   target.get("prefill_calls", 0)) or 0
+        pc = eng.get("prefix_cache") or target.get("prefix_cache")
+        if pc:
+            tot["hits"] += pc.get("hits", 0)
+            tot["lookups"] += pc.get("hits", 0) + pc.get("misses", 0)
+            tot["bytes"] += pc.get("bytes", 0)
+        if "acceptance_rate" in eng:
+            tot["accept_sum"] += eng["acceptance_rate"]
+            tot["tps_sum"] += eng.get("tokens_per_step", 1.0)
+            tot["accept_n"] += 1
+    return tot if tot["frames"] else None
+
+
+def _print_fleet_generation(frames, tok_per_s: Optional[float] = None):
+    """The fleet-level ``generate:`` line — one merged view instead of
+    the old per-worker (in practice worker-0-only) lines."""
+    m = _merged_generation(frames)
+    if not m:
         return
-    line = (f"    generate: active={gen.get('active_slots', 0)}"
-            f"/{gen.get('capacity', 0)}cap "
-            f"queue={gen.get('queue_depth', 0)} "
-            f"tokens={gen.get('tokens', 0)} "
-            f"joins={gen.get('joins', 0)} shed={gen.get('shed', 0)}")
-    eng = gen.get("engine") or {}
-    target = eng.get("target") or {}
-    if "prefill_calls" in eng or "prefill_calls" in target:
-        line += (f" prefills="
-                 f"{eng.get('prefill_calls', target.get('prefill_calls'))}")
-    pc = eng.get("prefix_cache") or target.get("prefix_cache")
-    if pc:
-        total = pc.get("hits", 0) + pc.get("misses", 0)
-        ratio = pc.get("hits", 0) / total if total else 0.0
-        line += (f" prefix_hit={ratio:.0%}({pc.get('hits', 0)}/{total})"
-                 f" prefix_mb={pc.get('bytes', 0) / (1 << 20):.1f}")
-    if "acceptance_rate" in eng:
-        line += (f" draft_accept={eng['acceptance_rate']:.0%}"
-                 f" tok/step={eng.get('tokens_per_step', 1.0):.2f}")
+    line = (f"  generate: workers={m['frames']} "
+            f"active={m['active']}/{m['cap']}cap "
+            f"queue={m['queue']} pending_steps={m['pending_steps']} "
+            f"tokens={m['tokens']} joins={m['joins']} shed={m['shed']}")
+    if tok_per_s is not None:
+        line += f" tok/s={tok_per_s:.1f}"
+    if m["prefills"]:
+        line += f" prefills={m['prefills']}"
+    if m["lookups"]:
+        line += (f" prefix_hit={m['hits'] / m['lookups']:.0%}"
+                 f"({m['hits']}/{m['lookups']})"
+                 f" prefix_mb={m['bytes'] / (1 << 20):.1f}")
+    if m["accept_n"]:
+        line += (f" draft_accept={m['accept_sum'] / m['accept_n']:.0%}"
+                 f" tok/step={m['tps_sum'] / m['accept_n']:.2f}")
     print(line)
+
+
+def _print_routing_rows(workdir: str):
+    """Per-worker routing rows from the heartbeat load reports
+    (serving/routing.py): free slots, queued decode steps, routed
+    arrivals and how many landed on a warm prefix."""
+    from .routing import STALE_AFTER_S, load_reports
+
+    reports = load_reports(workdir)
+    now = time.time()
+    for wid in sorted(reports):
+        r = reports[wid]
+        stale = " STALE" if r.age_s(now) > STALE_AFTER_S else ""
+        print(f"    route worker-{wid}: free={r.free_slots} "
+              f"queued_steps={r.queued_steps:.0f} "
+              f"routed_in={r.routed_in} "
+              f"affinity_hits={r.affinity_hits} "
+              f"keys={len(r.prefix_keys)}{stale}")
 
 
 def cmd_top(workdir: str, interval: float = 2.0,
@@ -768,6 +823,7 @@ def cmd_top(workdir: str, interval: float = 2.0,
     budget, per-worker health — refreshed every ``interval`` seconds.
     ``iterations`` bounds the loop (tests / one-shot snapshots)."""
     prev = {}
+    prev_tok = {}
     done = 0
     try:
         while iterations is None or done < iterations:
@@ -778,6 +834,7 @@ def cmd_top(workdir: str, interval: float = 2.0,
             print(f"zoo-serving top  {time.strftime('%H:%M:%S')}  "
                   f"(refresh {interval:g}s, Ctrl-C to exit)")
             total_qps = 0.0
+            tok_per_s = None
             for name, st in frames:
                 out = st.get("results_out", 0)
                 qps = None
@@ -787,16 +844,26 @@ def cmd_top(workdir: str, interval: float = 2.0,
                         qps = max(out - p_out, 0) / (now - p_t)
                         total_qps += qps
                 prev[name] = (out, now)
+                gen = st.get("generation")
+                if gen:
+                    toks = gen.get("tokens", 0)
+                    if name in prev_tok:
+                        p_toks, p_t = prev_tok[name]
+                        if now > p_t:
+                            tok_per_s = (tok_per_s or 0.0) + \
+                                max(toks - p_toks, 0) / (now - p_t)
+                    prev_tok[name] = (toks, now)
                 e2e = (st.get("stages") or {}).get("e2e") or {}
                 qps_s = f"{qps:7.1f}" if qps is not None else "      -"
                 print(f"  {name:24s} qps={qps_s} served={out} "
                       f"shed={st.get('shed', 0)} "
                       f"p50={e2e.get('p50', 0):.1f}ms "
                       f"p99={e2e.get('p99', 0):.1f}ms")
-                _print_generation(st)
                 _print_slo(st)
             if len(frames) > 1:
                 print(f"  fleet qps={total_qps:.1f}")
+            _print_fleet_generation(frames, tok_per_s=tok_per_s)
+            _print_routing_rows(workdir)
             _print_fleet(workdir)
             sys.stdout.flush()
             done += 1

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import base64
 import json
+import os
 import time
 from typing import Dict, Iterable, Optional
 
@@ -138,6 +139,34 @@ class InputQueue(API):
     #: `zoo-serving trace <id>` / `zoo-trace show <id>`)
     last_trace_id: Optional[str] = None
 
+    def __init__(self, backend: Optional[StreamQueue] = None,
+                 address: Optional[str] = None,
+                 route_workdir: Optional[str] = None):
+        """``route_workdir`` opts generate enqueues into length- and
+        cache-aware fleet placement: point it at the ServingFleet
+        workdir (where workers write heartbeats) and a file-rooted
+        transport, and each generate record lands on the cheapest
+        worker's substream instead of the shared any-claim stream —
+        degrading back to any-claim whenever reports are stale
+        (docs/serving-generate.md#fleet-routing)."""
+        super().__init__(backend=backend, address=address)
+        self._routed = None
+        if route_workdir is not None:
+            from .routing import RoutedGenerateQueue, file_root
+
+            src = address or os.environ.get("ZOO_SERVING_TRANSPORT")
+            if file_root(src) is None and hasattr(self.db, "stream_dir"):
+                # backend injected directly or built from a bare path:
+                # recover the file root from its stream directory
+                src = "file:" + os.path.dirname(self.db.stream_dir)
+            if file_root(src) is not None:
+                self._routed = RoutedGenerateQueue(
+                    route_workdir, src=src, base=self.db)
+
+    @property
+    def routing_stats(self) -> Optional[dict]:
+        return self._routed.stats() if self._routed is not None else None
+
     def _route_fields(self, rec: dict, model: Optional[str],
                       version: Optional[int],
                       deadline_ms: Optional[float] = None,
@@ -222,8 +251,14 @@ class InputQueue(API):
         if temperature is not None:
             gen["temperature"] = float(temperature)
         rec = {"uri": uri, "generate": gen}
-        return self._traced_enqueue(
-            self._route_fields(rec, model, version, deadline_ms))
+        rec = self._route_fields(rec, model, version, deadline_ms)
+        if self._routed is not None:
+            with telemetry.span("client/enqueue",
+                                trace_id=rec["trace_id"], uri=uri):
+                telemetry.flow("serving/request", rec["trace_id"], "s")
+                rid, _decision = self._routed.enqueue_routed(rec)
+            return rid
+        return self._traced_enqueue(rec)
 
     @staticmethod
     def base64_encode_image(data: bytes) -> str:

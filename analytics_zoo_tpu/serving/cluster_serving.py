@@ -350,6 +350,11 @@ class ClusterServing:
         self.dead_letters = 0
         self.shed = 0
         self.batches = 0
+        # routed-placement intake accounting (serving/routing.py):
+        # routed_in counts records stamped `routed_to` us; affinity_hits
+        # counts those whose prompt was warm in our prefix cache
+        self.routed_in = 0
+        self.affinity_hits = 0
         self.bucket_counts: Counter = Counter()
         self.stats_path = getattr(h, "stats_path", None)
         # deadline-aware admission + bounded linger (serving/admission.py)
@@ -464,10 +469,36 @@ class ClusterServing:
                                   for n, e in self._class_slo.items()}
         if self._gen_sched is not None:
             out["generation"] = self._gen_sched.stats()
+        report = self.generate_load_report()
+        if report is not None:
+            out["routing"] = report
         if hasattr(self.db, "consumer_stats"):
             out["queue"] = self.db.consumer_stats()
         out.update(self.summary.snapshot())
         return out
+
+    def generate_load_report(self, max_keys: int = 32) -> Optional[dict]:
+        """Heartbeat payload section for the fleet router
+        (serving/routing.py); None when this server has no generate
+        engine configured.  Before the scheduler lazily starts, an
+        all-free report advertises the configured capacity so routing
+        works from the first request."""
+        sched = self._gen_sched
+        if sched is None:
+            h = self.helper
+            if self._gen_engine is None and \
+                    getattr(h, "generate_stub_ms_per_step", None) is None:
+                return None
+            slots = max(int(getattr(h, "generate_slots", 4) or 4), 1)
+            report = {"slots": slots, "active_slots": 0,
+                      "free_slots": slots, "queue_depth": 0,
+                      "queued_steps": 0, "prefix_keys": []}
+        else:
+            report = sched.load_report(max_keys=max_keys)
+        with self._ctr_lock:
+            report["routed_in"] = self.routed_in
+            report["affinity_hits"] = self.affinity_hits
+        return report
 
     # -- deadline admission + timing decomposition ----------------------
     def _meta_for(self, rid: str, rec: dict, t_in: float) -> RecordMeta:
@@ -719,12 +750,24 @@ class ClusterServing:
             return True
         from .generation import GenRequest
 
+        prompt = np.asarray(gen.get("prompt") or [], np.int64)
+        routed_to = rec.get("routed_to", rec.get(b"routed_to"))
+        if routed_to is not None:
+            # router placed this record on our substream; count whether
+            # the affinity bet paid off (warm membership probe only —
+            # the real hit/miss counters move in the engine's lookup)
+            pc = sched._engine_prefix_cache()
+            warm = bool(pc is not None and pc.contains(prompt))
+            self._count(routed_in=1, affinity_hits=1 if warm else 0)
+            telemetry.counter("zoo_route_landed_total").inc()
+            if warm:
+                telemetry.counter("zoo_route_landed_warm_total").inc()
         stop_id = gen.get("stop_id")
         if stop_id is None:
             stop_id = getattr(self.helper, "generate_stop_id", None)
         sched.submit(GenRequest(
             uri=meta.uri,
-            prompt=np.asarray(gen.get("prompt") or [], np.int64),
+            prompt=prompt,
             max_new_tokens=int(gen.get("max_new_tokens") or
                                getattr(self.helper,
                                        "generate_max_new_tokens", 32)),

@@ -466,6 +466,24 @@ class ServingFleet:
             os.remove(health_path(self.workdir, wid))
         except OSError:
             pass
+        # routed records parked on the retired worker's private generate
+        # substream go back to the shared any-claim stream — placement
+        # must never strand work (serving/routing.py)
+        src = self.helper.src or ""
+        if src.startswith("file:"):
+            from .routing import sweep_substream
+
+            try:
+                n = sweep_substream(src[len("file:"):], wid)
+                if n:
+                    with self._lock:
+                        self.stream.write(
+                            f"[fleet] worker-{wid} substream swept: "
+                            f"{n} routed record(s) back on the shared "
+                            f"stream\n")
+                        self.stream.flush()
+            except OSError:
+                pass
         self._write_supervisor_state()
 
     def _queue_backlog(self) -> Optional[int]:
@@ -503,6 +521,35 @@ class ServingFleet:
                 bat.append(b)
         return (sum(rec) / len(rec) if rec else 0.0,
                 sum(bat) / len(bat) if bat else 0.0)
+
+    def _generate_load(self) -> tuple:
+        """(gen_steps, token_ms): queued decode-step backlog summed over
+        the workers' heartbeat routing reports, and the mean positive
+        EWMA per-token cost — the generate-aware inputs the autoscaler
+        weighs so one queued 512-token essay no longer sizes like one
+        predict record (docs/serving-generate.md#fleet-routing)."""
+        steps = 0.0
+        toks = []
+        for wid in list(self._active):
+            h = read_health(self.workdir, wid) or {}
+            routing = h.get("routing") or {}
+            steps += float(routing.get("queued_steps") or 0.0)
+            t = float((h.get("admission") or {}).get(
+                "est_token_ms") or 0.0)
+            if t > 0:
+                toks.append(t)
+        return steps, (sum(toks) / len(toks) if toks else 0.0)
+
+    def _routed_backlog(self) -> int:
+        """Unclaimed records parked on per-worker generate substreams —
+        invisible to the shared stream's ``stream_len`` but real
+        backlog for scale-up sizing."""
+        src = self.helper.src or ""
+        if not src.startswith("file:"):
+            return 0
+        from .routing import substream_backlog
+
+        return substream_backlog(src[len("file:"):])
 
     def _note_autoscale(self, action: str, wids: List[int], reason: str,
                         backlog: int, wait_ms: float):
@@ -544,14 +591,18 @@ class ServingFleet:
         backlog = self._queue_backlog()
         if backlog is None:
             return False
+        backlog += self._routed_backlog()
         record_ms, batch_ms = self._ewma_estimates()
+        gen_steps, token_ms = self._generate_load()
         current = len(self._active)
         desired, reason = self.autoscaler.desired(
-            backlog, record_ms, batch_ms, current, now)
+            backlog, record_ms, batch_ms, current, now,
+            gen_steps=gen_steps, token_ms=token_ms)
         if reason is None or desired == current:
             return False
         wait_ms = self.autoscaler.predicted_wait_ms(
-            backlog, record_ms, batch_ms, current)
+            backlog, record_ms, batch_ms, current,
+            gen_steps=gen_steps, token_ms=token_ms)
         if desired > current:
             added = []
             for wid in range(self.max_workers):

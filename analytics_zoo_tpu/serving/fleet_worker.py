@@ -50,8 +50,20 @@ def _build_serving(cfg: str, workdir: str, worker_id: int):
         # every requests*.jsonl under the workdir for the waterfall
         helper.request_log = os.path.join(
             workdir, f"requests-worker-{worker_id}.jsonl")
+    # file transports get routed-placement intake: drain our private
+    # generate substream first, then the shared any-claim stream
+    # (serving/routing.py; a fleet with no router sees an empty
+    # substream and behaves exactly as before)
+    backend = None
+    root = None
+    src = helper.src or ""
+    if src.startswith("file:"):
+        from .routing import WorkerIntakeQueue
+
+        root = src[len("file:"):]
+        backend = WorkerIntakeQueue(root, worker_id)
     if not helper.registry_root:
-        return ClusterServing(helper=helper), None
+        return ClusterServing(helper=helper, backend=backend), None
     from .registry import ModelRegistry, RegistryControlServer
     from .router import RoutedClusterServing
 
@@ -60,7 +72,8 @@ def _build_serving(cfg: str, workdir: str, worker_id: int):
         default_model=helper.default_model,
         canary_error_threshold=helper.canary_error_threshold,
         canary_min_requests=helper.canary_min_requests)
-    serving = RoutedClusterServing(registry, helper=helper)
+    serving = RoutedClusterServing(registry, helper=helper,
+                                   backend=backend)
     registry.recover(load=True, warmup=serving.registry_warmup(),
                      save=worker_id == 0)
     ctl = None
@@ -111,6 +124,15 @@ def _heartbeat(serving, workdir: str, worker_id: int,
             # without RPC into the worker (docs/serving-network.md)
             "admission": serving.admission.stats(),
         }
+        try:
+            # routing load report (free slots, queued decode steps,
+            # prefix-key digest) rides the same heartbeat — the fleet
+            # router's only data source (serving/routing.py)
+            report = serving.generate_load_report()
+        except Exception:  # noqa: BLE001 - never kill the heartbeat
+            report = None
+        if report is not None:
+            payload["routing"] = report
         dump = getattr(serving, "_flight_dump_path", None)
         if dump:
             payload["flight_dump"] = dump

@@ -184,6 +184,19 @@ class PrefixCache:
             self._bytes -= evicted
         telemetry.gauge("zoo_generate_prefix_cache_bytes").set(self._bytes)
 
+    def contains(self, prompt: np.ndarray) -> bool:
+        """Membership probe that does NOT move the hit/miss counters or
+        recency — routing affinity accounting must not pollute the true
+        hit ratio that ``lookup`` maintains."""
+        return prompt_key(prompt) in self._entries
+
+    def key_digest(self, limit: int = 32, width: int = 12) -> List[str]:
+        """Newest-first bounded digest of resident keys, truncated to
+        ``width`` hex chars — small enough to ride a fleet heartbeat,
+        wide enough that a router prefix-match is a real cache hit."""
+        keys = list(reversed(self._entries))[: max(int(limit), 0)]
+        return [k[: int(width)] for k in keys]
+
     def stats(self) -> dict:
         return {"hits": self.hits, "misses": self.misses,
                 "entries": len(self._entries), "bytes": self._bytes}
@@ -778,6 +791,7 @@ class ContinuousBatchScheduler:
         self.prefill_chunk = max(int(prefill_chunk), 0)
 
         self._queue: "queue.Queue[GenRequest]" = queue.Queue()
+        self._queued_steps = 0      # decode-step budget still queued
         self._slots: List[Optional[_Slot]] = [None] * self.max_slots
         self._state = None
         self._capacity = 0
@@ -794,7 +808,50 @@ class ContinuousBatchScheduler:
     def submit(self, req: GenRequest):
         with self._lock:
             self.counts["submitted"] += 1
+            self._queued_steps += max(int(req.max_new_tokens), 1)
         self._queue.put(req)
+
+    def _note_dequeued(self, req: GenRequest):
+        with self._lock:
+            self._queued_steps = max(
+                self._queued_steps - max(int(req.max_new_tokens), 1), 0)
+
+    def pending_decode_steps(self) -> int:
+        """Decode-step backlog: queued requests' full token budgets plus
+        the remaining budget of every active slot — the unit the fleet
+        router and autoscaler reason in, so a 4-token ping and a
+        512-token essay stop counting as the same \"one record\"."""
+        with self._lock:
+            queued = self._queued_steps
+        remaining = 0
+        for s in list(self._slots):
+            if s is not None:
+                remaining += max(
+                    int(s.req.max_new_tokens) - len(s.tokens), 0)
+        return int(queued + remaining)
+
+    def _engine_prefix_cache(self):
+        """The engine's prefix cache, reaching through a speculative
+        wrapper to its target (the draft engine never caches)."""
+        pc = getattr(self.engine, "prefix_cache", None)
+        if pc is None:
+            pc = getattr(getattr(self.engine, "target", None),
+                         "prefix_cache", None)
+        return pc
+
+    def load_report(self, max_keys: int = 32) -> dict:
+        """Free-slot / queued-step / prefix-digest snapshot for the
+        fleet heartbeat (consumed by ``serving/routing.py``)."""
+        active = sum(s is not None for s in self._slots)
+        report = {"slots": self.max_slots,
+                  "active_slots": active,
+                  "free_slots": max(self.max_slots - active, 0),
+                  "queue_depth": self._queue.qsize(),
+                  "queued_steps": self.pending_decode_steps()}
+        pc = self._engine_prefix_cache()
+        if pc is not None:
+            report["prefix_keys"] = pc.key_digest(limit=max_keys)
+        return report
 
     def start(self):
         if self._thread is not None:
@@ -818,6 +875,7 @@ class ContinuousBatchScheduler:
         out["queue_depth"] = self._queue.qsize()
         out["active_slots"] = sum(s is not None for s in self._slots)
         out["capacity"] = self._capacity
+        out["pending_steps"] = self.pending_decode_steps()
         eng_stats = getattr(self.engine, "stats", None)
         if callable(eng_stats):
             out["engine"] = eng_stats()
@@ -1080,6 +1138,7 @@ class ContinuousBatchScheduler:
                     req = self._queue.get(timeout=budget)
                 except queue.Empty:
                     break
+            self._note_dequeued(req)
             if not self._admit(req):
                 continue
             slot = free.pop(0)
@@ -1171,5 +1230,6 @@ class ContinuousBatchScheduler:
                     req = self._queue.get_nowait()
                 except queue.Empty:
                     break
+                self._note_dequeued(req)
                 self._shed(req, FINISH_CANCELLED,
                            "generation cancelled at shutdown")

@@ -1,0 +1,240 @@
+"""Fleet-routing chaos smoke (``scripts/route-smoke``; CI fast tier).
+
+Brings up the routed generative fleet's production shape — a 2-worker
+:class:`ServingFleet` over the file transport with the stub decode
+engine and a prefix cache per worker, a :class:`RoutedGenerateQueue`
+producer placing requests by load report — and asserts the PR's
+contract (docs/serving-generate.md#fleet-routing):
+
+- **affinity**: a repeat prompt routes to the worker whose heartbeat
+  digest shows its prefix warm, lands there (`routed_to` accounting),
+  and the decision is flagged ``affinity``;
+- **skewed mix**: a 3:1 short/long + repeat-prompt burst is placed by
+  cost (every record gets a routing decision once reports are fresh);
+- **SIGKILL redelivery**: one worker is SIGKILLed mid-burst; the
+  supervisor restarts it, unclaimed substream records are swept back
+  to the shared stream, claimed-but-uncommitted ones are re-driven
+  from the producer's pending ledger — every uri ends with exactly one
+  result carrying *its own* token stream, and nothing re-appears after
+  settle (zero lost, zero duplicated);
+- **status**: the fleet-level ``generate:`` line and per-worker
+  ``route worker-N`` rows render from the heartbeat reports.
+
+Exit 0 on success, 1 on any violated assertion.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import io
+import json
+import os
+import random
+import shutil
+import signal
+import sys
+import tempfile
+import threading
+import time
+
+CONFIG_TMPL = """\
+model:
+  stub_ms_per_batch: 1.0
+
+data:
+  src: file:{stream_dir}
+  image_shape: 3, 4, 4
+
+params:
+  batch_size: 4
+  top_n: 0
+  workers: 2
+  health_interval: 0.25
+  health_timeout: {health_timeout}
+
+generate:
+  slots: 4
+  stub_ms_per_step: {stub_ms}
+  max_new_tokens: 8
+  prefix_cache_mb: 8
+"""
+
+WARM_PROMPT = [100, 0, 7, 7, 7, 7]
+
+
+def _prompt_for(i: int, rng: random.Random):
+    """Skewed 3:1 short/long mix with ~30% repeats of the warm prompt.
+    Second token 0 keeps the stub's scripted stop disabled."""
+    if rng.random() < 0.30:
+        return WARM_PROMPT, 8
+    if rng.random() < 0.75:
+        return [200 + i, 0], 4            # short
+    return [200 + i, 0, 1, 1, 1, 1], 32   # long
+
+
+def run_smoke(records: int = 24, stub_ms: float = 2.0,
+              health_timeout: float = 3.0, stream=None) -> int:
+    import numpy as np
+
+    from .client import OutputQueue
+    from .fleet import ServingFleet, read_health
+    from .generation import prompt_key
+    from .queue_backend import FileStreamQueue
+    from .routing import RoutedGenerateQueue, load_reports
+
+    out = stream if stream is not None else sys.stdout
+    workdir = tempfile.mkdtemp(prefix="zoo_route_smoke_")
+    stream_dir = os.path.join(workdir, "stream")
+    cfg = os.path.join(workdir, "config.yaml")
+    with open(cfg, "w") as f:
+        f.write(CONFIG_TMPL.format(stream_dir=stream_dir,
+                                   stub_ms=stub_ms,
+                                   health_timeout=health_timeout))
+    cap = io.StringIO()
+
+    def fail(msg):
+        out.write(cap.getvalue())
+        out.write(f"ROUTE_SMOKE_FAIL: {msg}\n")
+        return 1
+
+    fleet = ServingFleet(cfg, workdir, stream=cap,
+                         env={"JAX_PLATFORMS": "cpu"})
+    sup = threading.Thread(target=fleet.supervise, daemon=True)
+    results = {}
+
+    def drain(q):
+        for uri, raw in q.db.all_results(pop=True).items():
+            try:
+                payload = json.loads(raw.decode())
+            except ValueError:
+                payload = {"error": "undecodable"}
+            if uri in results:
+                return fail(f"{uri} answered twice")
+            results[uri] = payload
+        return None
+
+    try:
+        fleet.start()
+        sup.start()
+        if not fleet.wait_healthy(timeout=90.0):
+            return fail("workers never became healthy")
+        routed = RoutedGenerateQueue(workdir, src=f"file:{stream_dir}")
+        out_q = OutputQueue(backend=FileStreamQueue(stream_dir))
+
+        # -- phase 1: warm a prefix, then assert affinity routing ------
+        warm_key = prompt_key(np.asarray(WARM_PROMPT, np.int64))
+        _rid, d0 = routed.enqueue_routed(
+            {"uri": "warm-0",
+             "generate": {"prompt": WARM_PROMPT, "max_new_tokens": 8}})
+        if d0 is None:
+            return fail("no routing decision despite fresh heartbeats")
+        deadline = time.time() + 60.0
+        holder = None
+        while time.time() < deadline and holder is None:
+            rc = drain(out_q)
+            if rc is not None:
+                return rc
+            for wid, rep in load_reports(workdir).items():
+                if rep.holds_prefix(warm_key):
+                    holder = wid
+            time.sleep(0.2)
+        if holder is None:
+            return fail("warm prefix never appeared in a heartbeat digest")
+        _rid, d1 = routed.enqueue_routed(
+            {"uri": "warm-1",
+             "generate": {"prompt": WARM_PROMPT, "max_new_tokens": 8}})
+        if d1 is None or not d1.affinity or d1.worker_id != holder:
+            return fail(f"repeat prompt not affinity-routed to "
+                        f"worker-{holder} (got {d1})")
+
+        # -- phase 2: skewed burst, SIGKILL mid-burst, exactly-once ----
+        rng = random.Random(0)
+        expected = {}
+        victim = 0
+        h0 = read_health(workdir, victim)
+        if not h0:
+            return fail(f"no heartbeat for worker-{victim}")
+        for i in range(records):
+            prompt, steps = _prompt_for(i, rng)
+            uri = f"mix-{i}"
+            expected[uri] = prompt[0] + 1       # stub: token 1 = p[0]+1
+            routed.enqueue_routed(
+                {"uri": uri, "generate": {"prompt": list(prompt),
+                                          "max_new_tokens": steps}})
+            if i == records // 2:
+                os.kill(int(h0["pid"]), signal.SIGKILL)
+        expected["warm-0"] = WARM_PROMPT[0] + 1
+        expected["warm-1"] = WARM_PROMPT[0] + 1
+        deadline = time.time() + 120.0
+        while len(results) < len(expected) and time.time() < deadline:
+            rc = drain(out_q)
+            if rc is not None:
+                return rc
+            missing = [u for u in expected if u not in results]
+            if missing:
+                # unclaimed substream records of the dead worker go
+                # back to the shared stream; claimed-but-uncommitted
+                # ones are re-driven under their original rid
+                routed.sweep_worker(victim)
+                routed.reenqueue_missing(missing)
+                time.sleep(0.3)
+        if len(results) < len(expected):
+            missing = sorted(u for u in expected if u not in results)
+            return fail(f"lost {len(missing)} result(s) after SIGKILL: "
+                        f"{missing[:6]}")
+        for uri, want in expected.items():
+            payload = results[uri]
+            toks = payload.get("tokens")
+            if "error" in payload or not toks:
+                return fail(f"{uri} errored: {payload}")
+            if int(toks[0]) != want:
+                return fail(f"{uri} first token {toks[0]} != {want} "
+                            f"(cross-wired streams)")
+        time.sleep(1.0)          # settle: late duplicates would land now
+        late = out_q.db.all_results(pop=True)
+        if late:
+            return fail(f"duplicated results after settle: "
+                        f"{sorted(late)[:6]}")
+        if fleet.restarts.get(victim, 0) < 1:
+            return fail(f"supervisor never restarted worker-{victim}")
+        rstats = routed.stats()
+        if rstats["router"]["affinity"] < 1:
+            return fail("no affinity decision over a 30%-repeat mix")
+        if rstats["routed"] < records // 2:
+            return fail(f"only {rstats['routed']} routed placements "
+                        f"over {records} records")
+
+        # -- status rendering ------------------------------------------
+        from . import cli
+
+        scap = io.StringIO()
+        with contextlib.redirect_stdout(scap):
+            cli._print_fleet_generation(cli._read_stats_files(workdir))
+            cli._print_routing_rows(workdir)
+        status = scap.getvalue()
+        if "route worker-" not in status:
+            return fail(f"status is missing routing rows:\n{status}")
+        out.write(f"ROUTE_SMOKE_OK records={len(expected)} "
+                  f"routed={rstats['routed']} "
+                  f"affinity={rstats['router']['affinity']} "
+                  f"swept={rstats['swept']} "
+                  f"reenqueued={rstats['reenqueued']} "
+                  f"restarts={fleet.restarts.get(victim, 0)}\n")
+        return 0
+    finally:
+        fleet.stop()
+        fleet.shutdown()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="route-smoke")
+    ap.add_argument("--records", type=int, default=24)
+    ap.add_argument("--stub-ms", type=float, default=2.0)
+    args = ap.parse_args(argv)
+    return run_smoke(records=args.records, stub_ms=args.stub_ms)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2354,6 +2354,179 @@ def bench_genfast(step_ms=2.0, prompt_len=2000, chunk=32,
     return out
 
 
+def bench_genroute(n_requests=144, workers=3, slots=4, step_ms=2.0,
+                   prefill_token_ms=0.25, template_len=400,
+                   n_templates=8, chaos_records=20):
+    """Fleet-routing leg (docs/serving-generate.md#fleet-routing): a
+    skewed generate burst — 3:1 short/long token budgets with ~30% of
+    requests repeating one of ``n_templates`` long template prompts
+    (agent/system-prompt traffic) — placed onto ``workers`` stub-engine
+    schedulers twice:
+
+    - **rr** — blind round-robin placement (the pre-routing fleet:
+      any worker claims any record);
+    - **routed** — the real :class:`GenerateRouter` scoring live
+      :class:`WorkerReport` snapshots built from each scheduler's
+      ``load_report()`` (queued decode steps, free slots, prefix-key
+      digest) plus the stub's known token/chunk costs.
+
+    Each arm first establishes every template with a paced seed phase
+    and drains to idle, then the measured burst is submitted at once.
+    Per-worker prefix caches are sized for a 1/``workers`` share of the
+    template working set: affinity routing PARTITIONS the templates
+    across the fleet so each worker's residents fit, while blind
+    placement cycles every template through every worker and thrashes
+    the LRU — each thrashed repeat re-pays a template prefill that
+    stalls the whole gang.  Short requests also stop queueing behind
+    long decodes.  Gates: routed >= 1.3x rr tokens/s, routed
+    short-request p99 TTFT <= rr, and >= 80% of repeats with a warm
+    holder landing on it.  A final chaos pass drives the full fleet
+    smoke (2 real worker processes, SIGKILL mid-burst) and gates on
+    exactly-once delivery.
+    """
+    from analytics_zoo_tpu.serving.generation import (
+        ContinuousBatchScheduler, GenRequest, PrefixCache,
+        StubDecodeEngine)
+    from analytics_zoo_tpu.serving.routing import (GenerateRouter,
+                                                   WorkerReport)
+
+    rng = np.random.RandomState(0)
+    templates = [np.concatenate(([501 + t, 0],
+                                 np.full(template_len - 2, 7 + t)))
+                 for t in range(n_templates)]
+    seeds = [(f"seed-{t}", templates[t], 8) for t in range(n_templates)]
+    body = []
+    for i in range(n_requests):
+        u = rng.rand()
+        if u < 0.30:           # template repeat: long prompt, short answer
+            prompt, steps = templates[int(rng.randint(n_templates))], 8
+        elif u < 0.75:         # unique short
+            prompt, steps = np.array([200 + i, 0]), 8
+        else:                  # unique long
+            prompt, steps = np.array([200 + i, 0]), 64
+        body.append((f"q-{i}", prompt, steps))
+
+    # per-worker cache sized for its SHARE of the template working set
+    # (n_templates/workers + slack): affinity routing partitions the
+    # templates across the fleet so each worker's residents fit; blind
+    # placement makes every worker cycle through all n_templates and
+    # thrash — the aggregate-cache-size win of cache-aware routing
+    cache_bytes = template_len * 8 * (n_templates // workers + 2)
+
+    def _run(route):
+        caches = [PrefixCache(max_bytes=cache_bytes)
+                  for _ in range(workers)]
+        engines = [StubDecodeEngine(ms_per_step=step_ms,
+                                    ms_per_prefill_token=prefill_token_ms,
+                                    prefix_cache=caches[w])
+                   for w in range(workers)]
+        results = {}
+        scheds = [ContinuousBatchScheduler(
+            engines[w], commit=lambda u, p: results.__setitem__(u, p),
+            max_slots=slots).start() for w in range(workers)]
+        router = GenerateRouter(stale_after_s=60.0)
+        warm_avail = warm_hit = 0
+
+        def place(i, uri, prompt, steps):
+            nonlocal warm_avail, warm_hit
+            if route:
+                now = time.time()
+                reports = []
+                for w, s in enumerate(scheds):
+                    lr = s.load_report()
+                    reports.append(WorkerReport(
+                        worker_id=w, ts=now,
+                        free_slots=lr["free_slots"],
+                        active_slots=lr["active_slots"],
+                        queue_depth=lr["queue_depth"],
+                        queued_steps=lr["queued_steps"],
+                        token_ms=step_ms, chunk_ms=prefill_token_ms,
+                        prefix_keys=tuple(lr.get("prefix_keys") or ())))
+                w = router.decide(prompt, steps, reports,
+                                  prefill_chunks=int(prompt.size)).worker_id
+                holders = [x for x in range(workers)
+                           if caches[x].contains(prompt)]
+                if holders:
+                    warm_avail += 1
+                    warm_hit += int(w in holders)
+            else:
+                w = i % workers
+            scheds[w].submit(GenRequest(uri, prompt.copy(),
+                                        max_new_tokens=steps))
+
+        # seed phase (unmeasured): establish every template, drain idle
+        for i, (uri, prompt, steps) in enumerate(seeds):
+            place(i, uri, prompt, steps)
+        t_seed = time.perf_counter()
+        while len(results) < len(seeds) and \
+                time.perf_counter() - t_seed < 120:
+            time.sleep(0.005)
+        if len(results) < len(seeds):
+            raise RuntimeError(f"seed phase stalled (route={route})")
+
+        # measured burst
+        t0 = time.perf_counter()
+        for i, (uri, prompt, steps) in enumerate(body):
+            place(i, uri, prompt, steps)
+        for s in scheds:
+            s.stop(drain=True, timeout=600)
+        wall = time.perf_counter() - t0
+        served = [uri for uri, _p, _s in body
+                  if "tokens" in results.get(uri, {})]
+        if len(served) != len(body):
+            raise RuntimeError(f"served {len(served)}/{len(body)} "
+                               f"(route={route})")
+        toks = sum(len(results[uri]["tokens"]) for uri in served)
+        short_ttft = np.asarray(
+            [results[uri]["timing"]["ttft_ms"]
+             for uri, _p, steps in body if steps == 8])
+        return {"tokens_per_s": toks / wall,
+                "short_p99_ttft_ms": float(np.percentile(short_ttft, 99)),
+                "prefill_calls": sum(e.prefill_calls for e in engines),
+                "affinity": (warm_hit, warm_avail),
+                "router": router.stats()}
+
+    out = {}
+    rr = _run(False)
+    routed = _run(True)
+    speedup = routed["tokens_per_s"] / max(rr["tokens_per_s"], 1e-9)
+    hit, avail = routed["affinity"]
+    rate = hit / max(avail, 1)
+    out["genroute_rr_tokens_per_s"] = round(rr["tokens_per_s"], 1)
+    out["genroute_routed_tokens_per_s"] = round(routed["tokens_per_s"], 1)
+    out["genroute_routed_vs_rr_speedup"] = round(speedup, 2)
+    out["genroute_rr_short_p99_ttft_ms"] = round(
+        rr["short_p99_ttft_ms"], 2)
+    out["genroute_routed_short_p99_ttft_ms"] = round(
+        routed["short_p99_ttft_ms"], 2)
+    out["genroute_rr_prefill_dispatches"] = rr["prefill_calls"]
+    out["genroute_routed_prefill_dispatches"] = routed["prefill_calls"]
+    out["genroute_affinity_hit_rate"] = round(rate, 4)
+    out["genroute_affinity_decisions"] = routed["router"]["affinity"]
+    _gate("genroute_routed_ge_1p3x_rr", speedup >= 1.3,
+          f"routed {routed['tokens_per_s']:.0f} vs rr "
+          f"{rr['tokens_per_s']:.0f} tok/s ({speedup:.2f}x)")
+    _gate("genroute_short_p99_ttft_routed_le_rr",
+          routed["short_p99_ttft_ms"] <= rr["short_p99_ttft_ms"],
+          f"routed {routed['short_p99_ttft_ms']:.1f}ms vs rr "
+          f"{rr['short_p99_ttft_ms']:.1f}ms")
+    _gate("genroute_affinity_ge_0p8", rate >= 0.8,
+          f"{hit}/{avail} warm-holder repeats landed on the holder")
+
+    # -- chaos: real 2-worker fleet, SIGKILL mid-burst, exactly-once ----
+    import io as _io
+
+    from analytics_zoo_tpu.serving.route_smoke import run_smoke
+
+    buf = _io.StringIO()
+    rc = run_smoke(records=chaos_records, stream=buf)
+    tail = (buf.getvalue().strip().splitlines() or [""])[-1]
+    out["genroute_chaos_exactly_once"] = bool(rc == 0)
+    out["genroute_chaos_lost_results"] = 0 if rc == 0 else 1
+    _gate("genroute_chaos_sigkill_exactly_once", rc == 0, tail[:300])
+    return out
+
+
 def bench_soak(duration_s=62.0, target_qps=120.0, batch_size=8,
                stub_ms=2.0, p99_bound_ms=250.0, shed_bound=0.05):
     """SLO soak leg (docs/observability.md#slo): sustained target-qps
@@ -3305,6 +3478,22 @@ def main():
                                        if str(e) else repr(e)[:500])
             _gate("genfast_measured", False, RESULT["genfast_error"])
         _stamp_leg_artifacts("genfast")
+        emit()
+
+    # Fleet-routing leg: length/cache-aware placement vs round-robin on
+    # the skewed template mix (tokens/s, short p99 TTFT, warm-prefix
+    # affinity) + the SIGKILL exactly-once chaos pass
+    # (docs/serving-generate.md#fleet-routing). Host-side, CPU-provable.
+    if time.time() - T_START < TOTAL_BUDGET_S * 0.9:
+        try:
+            RESULT.update(bench_genroute())
+        except Exception as e:  # noqa: BLE001
+            import traceback
+            traceback.print_exc()
+            RESULT["genroute_error"] = (str(e).splitlines()[0][:500]
+                                        if str(e) else repr(e)[:500])
+            _gate("genroute_measured", False, RESULT["genroute_error"])
+        _stamp_leg_artifacts("genroute")
         emit()
 
     # SLO soak leg: >= 60s sustained target-qps through the pipelined

@@ -151,3 +151,40 @@ def test_empty_read_respects_timeout(kind, make_backend):
     t0 = time.time()
     assert q.read_batch(4, timeout=0.2) == []
     assert time.time() - t0 < 2.0
+
+
+# ---------------------------------------------------------------------------
+# routed-substream variant (serving/routing.py): the same contract must
+# hold when generate records are placed on per-worker substreams
+# ---------------------------------------------------------------------------
+
+def test_routed_substreams_fifo_and_exactly_once(tmp_path):
+    """FIFO per substream + single-assignment claims survive routed
+    placement: each worker drains its own substream in enqueue order,
+    concurrent intakes never claim the same record, and every record is
+    served exactly once fleet-wide."""
+    from analytics_zoo_tpu.serving import WorkerIntakeQueue
+
+    root = str(tmp_path)
+    producer = FileStreamQueue(root)
+    subs = {w: FileStreamQueue(root, name=f"gen-w{w}") for w in (0, 1)}
+    expect = {0: [], 1: []}
+    for i in range(12):
+        w = i % 2
+        subs[w].enqueue(_rec(i))
+        expect[w].append(f"u-{i}")
+    for i in range(12, 16):                  # unrouted shared traffic
+        producer.enqueue(_rec(i))
+    intakes = {w: WorkerIntakeQueue(root, w) for w in (0, 1)}
+    got = {w: [rec["uri"] for _r, rec in
+               intakes[w].read_batch(6, timeout=2.0)]
+           for w in (0, 1)}
+    # substream FIFO: each worker saw exactly its routed records, in order
+    assert got == expect
+    # shared tail: disjoint claims, nothing lost, nothing duplicated
+    tail = [rec["uri"] for w in (0, 1)
+            for _r, rec in intakes[w].read_batch(16, timeout=2.0)]
+    assert sorted(tail) == [f"u-{i}" for i in range(12, 16)]
+    assert len(set(tail)) == len(tail)
+    for w in (0, 1):
+        assert intakes[w].consumer_stats().get("duplicates", 0) == 0
