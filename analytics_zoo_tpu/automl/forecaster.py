@@ -9,6 +9,7 @@ forecaster, both ``(B, lookback, F) -> (B, horizon)``.
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Sequence
 
 import numpy as np
@@ -50,6 +51,35 @@ class _BaseForecaster:
     def predict(self, x, batch_size: int = 128):
         return self.model.predict(np.asarray(x, np.float32),
                                   batch_size=batch_size)
+
+    # -- checkpointing (ASHA pause/resume at rung boundaries) ----------
+    def save_params(self, path: str):
+        """Atomically checkpoint model weights to ``path`` (npz).
+
+        Written via a file object — ``np.savez(str)`` appends ``.npz``
+        to bare paths — then ``os.replace``d so a killed worker never
+        leaves a torn checkpoint behind.
+        """
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as fh:
+            np.savez(fh, *self.model.get_weights())
+        os.replace(tmp, path)
+        return path
+
+    def load_params(self, path: str):
+        """Restore weights saved by :meth:`save_params`.
+
+        Only weights round-trip; optimizer moments restart per segment —
+        a known resume tradeoff documented in docs/automl.md.
+        """
+        with np.load(path) as data:
+            weights = [data[k] for k in sorted(
+                data.files, key=lambda n: int(n.split("_")[-1]))]
+        self.model.set_weights(weights)
+        return self
 
 
 class LSTMForecaster(_BaseForecaster):

@@ -12,10 +12,13 @@ from __future__ import annotations
 
 import itertools
 import logging
+import math
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from ..utils import telemetry
 
 logger = logging.getLogger("analytics_zoo_tpu.automl")
 
@@ -62,11 +65,26 @@ def sample_config(space: Dict, rng) -> Dict:
             for k, v in space.items()}
 
 
-def grid_configs(space: Dict) -> List[Dict]:
+#: default ceiling on grid enumeration — a wide ``RandInt`` silently
+#: cross-products into thousands of full-budget trials otherwise
+DEFAULT_GRID_LIMIT = 256
+
+
+def grid_configs(space: Dict, limit: Optional[int] = DEFAULT_GRID_LIMIT
+                 ) -> List[Dict]:
     keys, values = [], []
     for k, v in space.items():
         keys.append(k)
         values.append(v.grid() if hasattr(v, "grid") else [v])
+    total = 1
+    for vals in values:
+        total *= len(vals)
+    if limit is not None and total > limit:
+        raise ValueError(
+            f"grid search would enumerate {total} trials "
+            f"(> max_grid_trials={limit}); narrow the space or use the "
+            f"'random' or 'asha' engines, which sample a fixed "
+            f"num_samples instead of cross-producting every dimension")
     return [dict(zip(keys, combo)) for combo in itertools.product(*values)]
 
 
@@ -96,10 +114,40 @@ def run_trial(config: Dict, x_train, y_train, x_val, y_val) -> Dict:
 # engines
 # ---------------------------------------------------------------------------
 
+def select_best(trials: Sequence[Dict]) -> Dict:
+    """Best finite-loss trial; non-finite/failed trials never win.
+
+    A diverged trial reports NaN/Inf val loss — ``min()`` over raw
+    values lets NaN win the search (NaN comparisons are False both
+    ways).  Trials without a finite ``val_loss`` (or already marked
+    ``failed``) are excluded; if *every* trial failed the search raises
+    instead of returning garbage.
+    """
+    eligible = []
+    for t in trials:
+        loss = t.get("val_loss")
+        finite = loss is not None and math.isfinite(float(loss))
+        if t.get("state") not in ("failed",) and finite:
+            eligible.append(t)
+        elif "state" not in t:
+            t["state"] = "failed"
+    if not eligible:
+        errors = [str(t.get("error") or f"val_loss={t.get('val_loss')!r}")
+                  for t in trials[:5]]
+        raise RuntimeError(
+            f"all {len(trials)} trials failed — no finite val_loss to "
+            f"select from (first errors: {errors})")
+    best = min(eligible, key=lambda t: float(t["val_loss"]))
+    telemetry.gauge("zoo_automl_best_val_loss").set(
+        float(best["val_loss"]))
+    return best
+
+
 class _EngineBase:
     def __init__(self, ray_ctx=None):
         self.ray_ctx = ray_ctx
         self.trials: List[Dict] = []
+        self.stats: Dict = {}
 
     def _configs(self, space, num_samples, seed) -> List[Dict]:
         raise NotImplementedError
@@ -118,7 +166,7 @@ class _EngineBase:
         else:
             self.trials = [run_trial(c, x_train, y_train, x_val, y_val)
                            for c in configs]
-        best = min(self.trials, key=lambda t: t["val_loss"])
+        best = select_best(self.trials)
         logger.info("search done: %d trials, best %.5f %s",
                     len(self.trials), best["val_loss"], best["config"])
         return best
@@ -131,8 +179,68 @@ class RandomSearchEngine(_EngineBase):
 
 
 class GridSearchEngine(_EngineBase):
+    def __init__(self, ray_ctx=None, max_grid_trials: int =
+                 DEFAULT_GRID_LIMIT):
+        super().__init__(ray_ctx)
+        self.max_grid_trials = max_grid_trials
+
     def _configs(self, space, num_samples, seed):
-        return grid_configs(space)
+        return grid_configs(space, limit=self.max_grid_trials)
+
+
+class AshaSearchEngine(_EngineBase):
+    """Asynchronous successive halving over the async executor.
+
+    Samples ``num_samples`` configs like random search, but instead of
+    training each to the full budget it drives them through
+    :class:`~analytics_zoo_tpu.automl.scheduler.AshaScheduler` rungs at
+    ``min_epochs·η^k`` epochs on an
+    :class:`~analytics_zoo_tpu.automl.executor.AsyncTrialExecutor`:
+    trials report at rung boundaries, losers stop early, winners resume
+    from their checkpoint — same trial budget as random, a fraction of
+    the trained epochs, so best-val-loss per wall-hour scales with the
+    worker pool instead of the slowest bracket (docs/automl.md).
+
+    ``epochs`` (from the recipe) is the *maximum* per-trial budget R;
+    ``min_epochs`` (r) and ``reduction_factor`` (η) shape the rungs.
+    """
+
+    def __init__(self, ray_ctx=None, min_epochs: int = 1,
+                 reduction_factor: int = 3,
+                 max_concurrent: Optional[int] = None,
+                 workdir: Optional[str] = None, serial: bool = False):
+        super().__init__(ray_ctx)
+        self.min_epochs = int(min_epochs)
+        self.reduction_factor = int(reduction_factor)
+        self.max_concurrent = max_concurrent
+        self.workdir = workdir
+        self.serial = serial
+
+    def run(self, space: Dict, data: Tuple, num_samples: int = 4,
+            epochs: int = 1, seed: int = 0) -> Dict:
+        from .executor import AsyncTrialExecutor
+        from .scheduler import AshaScheduler
+
+        rng = np.random.default_rng(seed)
+        configs = [sample_config(space, rng) for _ in range(num_samples)]
+        scheduler = AshaScheduler(
+            max_epochs=epochs, min_epochs=min(self.min_epochs, epochs),
+            reduction_factor=self.reduction_factor)
+        executor = AsyncTrialExecutor(
+            scheduler, ray_ctx=self.ray_ctx,
+            max_concurrent=self.max_concurrent, workdir=self.workdir,
+            serial=self.serial)
+        self.trials = executor.run(configs, data)
+        self.stats = dict(executor.stats, rungs=scheduler.rungs())
+        best = select_best(self.trials)
+        logger.info(
+            "asha done: %d trials (%d completed / %d stopped / %d "
+            "failed), %d epochs trained, best %.5f %s",
+            len(self.trials), self.stats.get("completed", 0),
+            self.stats.get("stopped", 0), self.stats.get("failed", 0),
+            self.stats.get("epochs_trained", 0), best["val_loss"],
+            best["config"])
+        return best
 
 
 # ---------------------------------------------------------------------------
@@ -148,10 +256,20 @@ class AutoForecaster:
     >>> preds = pipeline.predict(x)
     """
 
-    def __init__(self, recipe, ray_ctx=None, engine: str = "random"):
+    #: engine name -> class; ``AutoForecaster(engine=...)`` validates
+    #: against this map instead of silently defaulting unknowns to grid
+    ENGINES = {"random": RandomSearchEngine, "grid": GridSearchEngine,
+               "asha": AshaSearchEngine}
+
+    def __init__(self, recipe, ray_ctx=None, engine: str = "random",
+                 **engine_kwargs):
         self.recipe = recipe
-        cls = RandomSearchEngine if engine == "random" else GridSearchEngine
-        self.engine = cls(ray_ctx)
+        cls = self.ENGINES.get(engine)
+        if cls is None:
+            raise ValueError(
+                f"unknown search engine {engine!r}; valid engines: "
+                f"{sorted(self.ENGINES)}")
+        self.engine = cls(ray_ctx, **engine_kwargs)
         self.best_trial: Optional[Dict] = None
         self.forecaster = None
 

@@ -1,0 +1,120 @@
+"""Distributed-AutoML end-to-end smoke (``scripts/automl-smoke``; CI fast tier).
+
+Proves the async-search contract with the production executor and a real
+SIGKILL — the knobs the bench leg measures, asserted cheaply:
+
+1. An 8-trial ASHA search fans across **two local spawn workers**
+   (private :class:`~analytics_zoo_tpu.ray.RayContext` pool).
+2. One worker is SIGKILLed the moment it claims a segment; the orphaned
+   segment must be **requeued exactly once** and finish on the survivor.
+3. Exactly-once accounting holds: every trial terminal, ``finalized ==
+   trials``, at least one trial early-stopped, the best val loss finite.
+
+Trial segments are deterministic stubs (loss shrinks with budget), so
+the smoke exercises scheduling/execution/fault paths in seconds without
+training; the bench ``automl`` leg covers real forecaster training.
+
+Exit 0 and ``AUTOML_SMOKE_OK`` on success; 1 with the offending stat on
+any violated assertion.
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+
+
+def _stub_segment(trial_id, config, budget, data, ckpt_dir):
+    """Deterministic fake trial: announces its claim (pid file in the
+    shared workdir, so the chaos thread can kill mid-segment), then
+    reports a loss that improves with cumulative budget."""
+    with open(os.path.join(ckpt_dir, f"claim-{os.getpid()}"), "w"):
+        pass
+    time.sleep(0.5)
+    return {"trial_id": trial_id, "val_loss": config["v"] / (1 + budget),
+            "epochs": budget, "seconds": 0.5, "pid": os.getpid()}
+
+
+def run_smoke(n_trials: int = 8, kill: bool = True) -> int:
+    from ..ray import RayContext
+    from .executor import AsyncTrialExecutor
+    from .scheduler import AshaScheduler
+
+    ctx = RayContext(num_ray_nodes=2, ray_node_cpu_cores=1,
+                     platform="cpu").init()
+    workdir = tempfile.mkdtemp(prefix="zoo-automl-smoke-")
+    victim = ctx._procs[0].pid
+    try:
+        if kill:
+            def kill_on_claim():
+                claim = os.path.join(workdir, f"claim-{victim}")
+                deadline = time.time() + 60
+                while not os.path.exists(claim) and \
+                        time.time() < deadline:
+                    time.sleep(0.02)
+                os.kill(victim, signal.SIGKILL)
+                print(f"automl-smoke: SIGKILLed worker {victim} "
+                      f"mid-segment")
+            threading.Thread(target=kill_on_claim, daemon=True).start()
+
+        scheduler = AshaScheduler(max_epochs=9, min_epochs=1,
+                                  reduction_factor=3)
+        executor = AsyncTrialExecutor(
+            scheduler, ray_ctx=ctx, max_concurrent=2,
+            trial_fn=_stub_segment, workdir=workdir)
+        configs = [{"v": 0.5 + 0.37 * ((7 * i) % n_trials)}
+                   for i in range(n_trials)]
+        t0 = time.time()
+        trials = executor.run(configs, data=None)
+        wall = time.time() - t0
+    finally:
+        ctx.stop()
+        import shutil
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    stats = executor.stats
+    best = min((t["val_loss"] for t in trials
+                if t["val_loss"] is not None and
+                math.isfinite(t["val_loss"])), default=float("nan"))
+    print(f"automl-smoke: {stats['trials']} trials in {wall:.1f}s — "
+          f"{stats['completed']} completed / {stats['stopped']} stopped "
+          f"/ {stats['failed']} failed, {stats['requeued']} requeued, "
+          f"max_concurrent={stats['max_concurrent']}, best={best:.4f}")
+
+    checks = [
+        ("finalized", stats["finalized"] == n_trials),
+        ("terminal_states", all(t["state"] in
+                                ("completed", "stopped", "failed")
+                                for t in trials)),
+        ("requeued_exactly_once", stats["requeued"] == (1 if kill else 0)),
+        ("nothing_failed", stats["failed"] == 0),
+        ("early_stopped", stats["stopped"] > 0),
+        ("max_concurrent_2", stats["max_concurrent"] >= 2),
+        ("best_finite", math.isfinite(best)),
+    ]
+    failed = [name for name, ok in checks if not ok]
+    if failed:
+        print(f"automl-smoke: FAILED {failed}; stats={stats}",
+              file=sys.stderr)
+        return 1
+    print("AUTOML_SMOKE_OK")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="automl-smoke")
+    ap.add_argument("--trials", type=int, default=8)
+    ap.add_argument("--no-kill", action="store_true",
+                    help="skip the worker-kill chaos leg")
+    args = ap.parse_args(argv)
+    return run_smoke(n_trials=args.trials, kill=not args.no_kill)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,7 +1,8 @@
 from .raycontext import (ActorClass, ActorHandle, ObjectRef, RayContext,
-                         RemoteFunction, RemoteTaskError, get_ray_context)
+                         RemoteFunction, RemoteTaskError, WorkerLostError,
+                         get_ray_context)
 from .process import ProcessMonitor, ProcessGuard
 
 __all__ = ["RayContext", "RemoteFunction", "ActorClass", "ActorHandle",
-           "ObjectRef", "RemoteTaskError", "get_ray_context",
-           "ProcessMonitor", "ProcessGuard"]
+           "ObjectRef", "RemoteTaskError", "WorkerLostError",
+           "get_ray_context", "ProcessMonitor", "ProcessGuard"]

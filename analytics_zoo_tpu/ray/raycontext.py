@@ -166,6 +166,21 @@ class RemoteTaskError(RuntimeError):
     """A task raised in the worker; carries the remote traceback."""
 
 
+class WorkerLostError(RemoteTaskError):
+    """The worker executing a task died (SIGKILL/OOM) before finishing.
+
+    Distinct from :class:`RemoteTaskError` so callers can tell "the task
+    raised" (retrying is pointless) from "the task's process was killed
+    under it" (requeueing is safe) — the AutoML executor requeues lost
+    trial segments exactly once on this type."""
+
+
+#: sentinel ``ok`` value on the result queue: "worker <pid> picked up
+#: task <id>" — lets the driver attribute in-flight tasks to pids so a
+#: SIGKILLed worker's task can be resolved as lost instead of hanging.
+_STARTED = "__started__"
+
+
 def _worker_main(worker_id: int, parent_pid: int, task_q, result_q,
                  platform: Optional[str], env: Optional[Dict[str, str]]):
     ProcessGuard(parent_pid).start()
@@ -186,6 +201,10 @@ def _worker_main(worker_id: int, parent_pid: int, task_q, result_q,
         if item is None:
             break
         task_id, fn_blob, args_blob = item
+        # claim marker BEFORE executing: if this process is killed
+        # mid-task, the driver's liveness sweep knows which task died
+        # with it (and resolves its ref as WorkerLostError)
+        result_q.put((task_id, _STARTED, os.getpid()))
         try:
             fn = cloudpickle.loads(fn_blob)
             args, kwargs = cloudpickle.loads(args_blob)
@@ -231,6 +250,8 @@ class RayContext:
         self._results: Dict[str, Any] = {}
         self._results_lock = threading.Lock()
         self._pending: set = set()
+        self._inflight: Dict[str, int] = {}   # task_id -> worker pid
+        self._lost_tasks: set = set()         # force-resolved as lost
         # actor_id -> ("local", proc, task_q) | ("remote", RemoteHost)
         #            | ("lost", reason)
         self._actors: Dict[str, Any] = {}
@@ -244,6 +265,8 @@ class RayContext:
         ctx = mp.get_context("spawn")  # hermetic workers (no jax state leak)
         self._task_q = ctx.Queue()
         self._result_q = ctx.Queue()
+        self._inflight.clear()
+        self._lost_tasks.clear()
         parent = os.getpid()
         for i in range(self.num_workers):
             p = ctx.Process(
@@ -457,6 +480,78 @@ class RayContext:
         out = [self._wait_one(r.task_id, deadline) for r in ref_list]
         return out[0] if single else out
 
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None):
+        """ray.wait parity: block until ``num_returns`` of ``refs`` have
+        results (or ``timeout`` elapses); returns ``(ready, not_ready)``
+        without consuming the results — ``get`` each ready ref after.
+        The as-completed primitive the async AutoML executor saturates
+        the pool with (submit → wait(num_returns=1) → refill)."""
+        refs = list(refs)
+        num_returns = min(num_returns, len(refs))
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            with self._results_lock:
+                ready = [r for r in refs if r.task_id in self._results]
+            if len(ready) >= num_returns:
+                break
+            remain = None if deadline is None else deadline - time.time()
+            if remain is not None and remain <= 0:
+                break
+            self._pump(remain)
+        ready_ids = {r.task_id for r in ready}
+        return ready, [r for r in refs if r.task_id not in ready_ids]
+
+    def _sweep_lost_workers(self):
+        """Resolve in-flight tasks whose local worker process died.
+
+        Only tasks claimed by a pid we spawned are swept (remote-host
+        workers report foreign pids; host loss is handled by the cluster
+        listener's own requeue path).  The ref resolves to a
+        :class:`WorkerLostError` so callers can requeue."""
+        local = {p.pid: p for p in self._procs}
+        with self._results_lock:
+            for task_id, pid in list(self._inflight.items()):
+                proc = local.get(pid)
+                if proc is None or proc.is_alive():
+                    continue
+                del self._inflight[task_id]
+                if task_id in self._results:
+                    continue   # result landed before the sweep
+                self._lost_tasks.add(task_id)
+                self._pending.discard(task_id)
+                self._results[task_id] = (
+                    "lost", f"worker pid {pid} died (exitcode "
+                            f"{proc.exitcode}) while running task "
+                            f"{task_id[:8]}")
+
+    def _pump(self, remain: Optional[float]):
+        """Drain one result-queue item (or time out and sweep liveness)."""
+        try:
+            tid, ok, payload = self._result_q.get(
+                timeout=min(remain, 1.0) if remain else 1.0)
+        except queue_mod.Empty:
+            self._sweep_lost_workers()
+            if not any(p.is_alive() for p in self._procs):
+                raise RuntimeError("all workers died") from None
+            return
+        if ok == _STARTED:
+            # claim marker: payload is the executing worker's pid
+            with self._results_lock:
+                if tid in self._pending:
+                    self._inflight[tid] = payload
+            return
+        with self._results_lock:
+            self._inflight.pop(tid, None)
+            if tid in self._lost_tasks:
+                # already force-resolved as lost; the straggler result
+                # (a SIGKILL racing the queue feeder) must not resurrect
+                # the task id — callers may have requeued it already
+                self._lost_tasks.discard(tid)
+                return
+            self._results[tid] = (ok, payload)
+            self._pending.discard(tid)
+
     def _wait_one(self, task_id: str, deadline: Optional[float]):
         import cloudpickle
 
@@ -464,22 +559,15 @@ class RayContext:
             with self._results_lock:
                 if task_id in self._results:
                     ok, payload = self._results.pop(task_id)
+                    if ok == "lost":
+                        raise WorkerLostError(payload)
                     if not ok:
                         raise RemoteTaskError(payload)
                     return cloudpickle.loads(payload)
             remain = None if deadline is None else deadline - time.time()
             if remain is not None and remain <= 0:
                 raise TimeoutError(f"task {task_id[:8]} timed out")
-            try:
-                tid, ok, payload = self._result_q.get(
-                    timeout=min(remain, 1.0) if remain else 1.0)
-            except queue_mod.Empty:
-                if not any(p.is_alive() for p in self._procs):
-                    raise RuntimeError("all workers died") from None
-                continue
-            with self._results_lock:
-                self._results[tid] = (ok, payload)
-                self._pending.discard(tid)
+            self._pump(remain)
 
     # convenience ------------------------------------------------------
     def map(self, fn: Callable, items: Sequence, timeout=None) -> List:

@@ -3150,38 +3150,103 @@ def bench_eval_predict(n_samples=4096, batch_size=64, k=16, rtt_ms=5.0):
     return out
 
 
-def bench_automl(n_trials=3):
-    """AutoML trials/hour (BASELINE.md target row: 'AutoML time-series
-    forecaster (LSTM/TCN, Ray) — trials/hour'). Host-side work: each
-    trial is a forecaster fit dispatched to RayContext workers. Measured
-    here on a tiny taxi-like series; the number scales with host cores
-    (this box has one)."""
-    from analytics_zoo_tpu.automl import AutoForecaster, TCNRandomRecipe
+def bench_automl(n_trials=20, max_epochs=16):
+    """Distributed AutoML: ASHA early stopping vs random-to-completion
+    at an equal trial budget (BASELINE.md target row 'AutoML time-series
+    forecaster — trials/hour'; docs/automl.md).
+
+    The same ``n_trials`` sampled configs run through the same
+    :class:`~analytics_zoo_tpu.automl.executor.AsyncTrialExecutor` on
+    the same 2-worker RayContext pool twice: once under
+    ``RunToCompletionScheduler`` (random search: every trial trains the
+    full ``max_epochs``) and once under ``AshaScheduler`` rungs — so the
+    wall-clock delta is purely the early-stopping policy, not pool or
+    compile differences. Gated: >=20 trials, >=2 concurrent worker
+    processes, ASHA best val loss matching random's (tolerance: resumed
+    segments restart optimizer moments), ASHA wall <= 0.7x random, and
+    a non-zero early-stopped fraction."""
+    from analytics_zoo_tpu.automl import Choice, Uniform
+    from analytics_zoo_tpu.automl.executor import AsyncTrialExecutor
+    from analytics_zoo_tpu.automl.feature import (rolling_window,
+                                                  train_val_split)
+    from analytics_zoo_tpu.automl.scheduler import (
+        AshaScheduler, RunToCompletionScheduler)
+    from analytics_zoo_tpu.automl.search import sample_config
     from analytics_zoo_tpu.ray import RayContext
 
+    # sized so an epoch (~200 batches) dominates a segment's fixed cost
+    # (model build + compile) — the regime ASHA is built for; with toy
+    # epochs the per-segment overhead would swamp the early-stop savings
     rng = np.random.default_rng(0)
-    t = np.arange(600, dtype=np.float32)
+    t = np.arange(18000, dtype=np.float32)
     series = (10 + 3 * np.sin(2 * np.pi * t / 48) +
-              rng.normal(0, 0.5, t.shape)).astype(np.float32)
+              rng.normal(0, 0.5, t.shape)).astype(np.float32)[:, None]
+    x, y = rolling_window(series, lookback=12, horizon=1)
+    (x_tr, y_tr), (x_val, y_val) = train_val_split(x, y, 0.2)
+    data = (x_tr, y_tr, x_val, y_val)
+
+    space = {"model": "lstm", "lstm_units": Choice([(4,), (8,), (16,)]),
+             "lr": Uniform(1e-3, 1.5e-2), "dropout": 0.0,
+             "batch_size": 64}
+    cfg_rng = np.random.default_rng(0)
+    configs = [sample_config(space, cfg_rng) for _ in range(n_trials)]
+
     t0 = time.perf_counter()
     with RayContext(num_ray_nodes=2, ray_node_cpu_cores=1,
                     platform="cpu") as ray_ctx:
         boot = time.perf_counter() - t0
-        t1 = time.perf_counter()
-        recipe = TCNRandomRecipe(num_samples=n_trials, epochs=1)
-        auto = AutoForecaster(recipe=recipe, ray_ctx=ray_ctx).fit(
-            series, lookback=24, horizon=1)
-        search = time.perf_counter() - t1
-    trials = len(auto.engine.trials)
-    # trials/hour excludes the one-time Ray boot; the winner refit at
-    # the end of fit() is still included (it is part of every search)
+
+        def leg(scheduler):
+            ex = AsyncTrialExecutor(scheduler, ray_ctx=ray_ctx,
+                                    max_concurrent=2)
+            t1 = time.perf_counter()
+            trials = ex.run([dict(c) for c in configs], data)
+            wall = time.perf_counter() - t1
+            finite = [tr["val_loss"] for tr in trials
+                      if tr["val_loss"] is not None
+                      and np.isfinite(tr["val_loss"])]
+            return trials, ex.stats, wall, min(finite) if finite \
+                else float("nan")
+
+        asha_trials, asha_stats, asha_wall, asha_best = leg(
+            AshaScheduler(max_epochs=max_epochs, min_epochs=1,
+                          reduction_factor=4))
+        _, rand_stats, rand_wall, rand_best = leg(
+            RunToCompletionScheduler(max_epochs=max_epochs))
+
+    _gate("automl_trial_budget", asha_stats["trials"] >= 20,
+          f"{asha_stats['trials']} < 20 trials")
+    _gate("automl_concurrency",
+          asha_stats["max_concurrent"] >= 2 and
+          len(asha_stats["worker_pids"]) >= 2,
+          f"max_concurrent={asha_stats['max_concurrent']} "
+          f"pids={asha_stats['worker_pids']}")
+    _gate("automl_asha_wall", asha_wall <= 0.7 * rand_wall,
+          f"asha {asha_wall:.1f}s > 0.7x random {rand_wall:.1f}s")
+    # "matching": within 25% + eps — promoted segments restart Adam
+    # moments at rung boundaries, so bit-parity is not expected
+    _gate("automl_asha_quality",
+          asha_best <= rand_best * 1.25 + 0.02,
+          f"asha best {asha_best:.5f} vs random {rand_best:.5f}")
+    _gate("automl_early_stop",
+          asha_stats["early_stopped_fraction"] > 0,
+          f"stopped={asha_stats['stopped']}")
     return {
-        "automl_trials": trials,
+        "automl_trials": asha_stats["trials"],
         "automl_boot_s": round(boot, 1),
-        "automl_search_s": round(search, 1),
-        "automl_trials_per_hour": round(trials / search * 3600, 1),
-        "automl_best_val_loss": round(
-            float(auto.best_trial["val_loss"]), 5),
+        "automl_asha_wall_s": round(asha_wall, 1),
+        "automl_random_wall_s": round(rand_wall, 1),
+        "automl_asha_speedup": round(rand_wall / max(asha_wall, 1e-9), 2),
+        "automl_asha_best_val_loss": round(float(asha_best), 5),
+        "automl_random_best_val_loss": round(float(rand_best), 5),
+        "automl_asha_epochs_trained": asha_stats["epochs_trained"],
+        "automl_random_epochs_trained": rand_stats["epochs_trained"],
+        "automl_early_stopped_fraction": round(
+            asha_stats["early_stopped_fraction"], 3),
+        "automl_asha_requeued": asha_stats["requeued"],
+        "automl_cached_segments": asha_stats["cached_segments"],
+        "automl_trials_per_hour": round(
+            asha_stats["trials"] / asha_wall * 3600, 1),
     }
 
 
