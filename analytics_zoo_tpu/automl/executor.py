@@ -11,10 +11,11 @@ checkpoint, report val loss" — as an as-completed stream over
   (``RayContext.wait(num_returns=1)``), so ASHA's async promotions keep
   every worker busy with no rung barrier;
 * a segment reaching its rung boundary checkpoints the forecaster params
-  under ``<workdir>/trial-<id>/weights.npz`` (atomic rename); a promoted
-  trial's next segment resumes from that checkpoint instead of
-  retraining from scratch (optimizer moments restart per segment — the
-  params do not);
+  under ``<workdir>/trial-<id>/weights.npz`` plus a ``progress.json``
+  sidecar (cumulative epochs + a fresh cache token; both atomic
+  renames); a promoted trial's next segment resumes from that checkpoint
+  instead of retraining from scratch (optimizer moments restart per
+  segment — the params do not);
 * a segment whose worker process died (``WorkerLostError``) is requeued
   **exactly once** — same trial, same budget, resumed from the last
   committed checkpoint; a second loss (or a task-raised error, or a
@@ -49,36 +50,70 @@ logger = logging.getLogger("analytics_zoo_tpu.automl")
 
 
 #: worker-local model cache: (ckpt_dir, trial_id) -> (forecaster,
-#: checkpoint stat at our last save).  A promoted trial that lands on
+#: progress token at our last save).  A promoted trial that lands on
 #: the worker that ran its previous segment reuses the live model —
 #: skipping rebuild, recompile (jit traces are per-model-instance, so a
 #: rebuilt model always recompiles) and the checkpoint load.  The cached
-#: entry is only trusted when the on-disk checkpoint still carries the
-#: stat we recorded at save time; if another worker ran an intermediate
-#: segment (requeue after a kill), the stat differs and we fall back to
-#: the authoritative checkpoint.
+#: entry is only trusted while the trial's ``progress.json`` sidecar
+#: still carries the random token we wrote at save time; if another
+#: worker committed an intermediate segment (requeue after a kill), its
+#: save rolled the token and we fall back to the authoritative
+#: checkpoint.  (A stat-based check would be fooled by same-size
+#: checkpoints landing within one mtime granule on coarse filesystems.)
 _MODEL_CACHE: Dict[tuple, tuple] = {}
 _MODEL_CACHE_CAP = 32
 
 
-def _ckpt_stat(path: str):
+def _progress_path(ckpt: str) -> str:
+    return os.path.join(os.path.dirname(ckpt), "progress.json")
+
+
+def _read_progress(ckpt: str) -> Optional[Dict]:
+    """The trial's committed progress sidecar, or None if absent/torn."""
+    import json
+
     try:
-        st = os.stat(path)
-        return (st.st_mtime_ns, st.st_size)
-    except OSError:
+        with open(_progress_path(ckpt)) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
         return None
 
 
+def _write_progress(ckpt: str, epochs: int) -> str:
+    """Atomically commit {cumulative epochs, fresh token}; returns the
+    token (the model-cache validity key for this checkpoint state)."""
+    import json
+    import uuid
+
+    token = uuid.uuid4().hex
+    path = _progress_path(ckpt)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump({"epochs": int(epochs), "token": token}, fh)
+    os.replace(tmp, path)
+    return token
+
+
 def run_trial_segment(trial_id: int, config: Dict, budget_epochs: int,
-                      data: Tuple, ckpt_dir: Optional[str]) -> Dict:
+                      data: Tuple, ckpt_dir: Optional[str],
+                      start_epochs: int = 0) -> Dict:
     """Train one forecaster segment (runs inside a worker process).
 
     Builds the config's forecaster (or reuses the worker's still-warm
     model from the trial's previous segment), resumes params from the
-    trial's checkpoint when one exists, trains ``budget_epochs`` more
-    epochs, evaluates, and commits the new checkpoint (atomic rename)
-    before returning — so a worker killed mid-segment leaves the
-    previous checkpoint intact and the segment can be requeued as-is.
+    trial's checkpoint when one exists, trains up to ``budget_epochs``
+    more epochs, evaluates, and commits checkpoint + progress sidecar
+    (both atomic renames) before returning — so a worker killed
+    mid-segment leaves the previous commit intact and the segment can
+    be requeued as-is.
+
+    ``start_epochs`` is the cumulative epoch count the driver has
+    accounted for this trial.  If the sidecar already records
+    ``start_epochs + budget_epochs`` — the previous attempt committed
+    its checkpoint but its worker died before the result reached the
+    driver — the rerun trains 0 extra epochs (evaluate only), so a
+    requeued trial never accrues epochs beyond its rung and its rung
+    comparison against peers stays fair.
     """
     from .forecaster import build_forecaster
 
@@ -91,14 +126,18 @@ def run_trial_segment(trial_id: int, config: Dict, budget_epochs: int,
                         epochs=int(budget_epochs)):
         ckpt = None if ckpt_dir is None else os.path.join(
             ckpt_dir, f"trial-{trial_id}", "weights.npz")
+        target = int(start_epochs) + int(budget_epochs)
+        progress = None
         f = None
         resumed = False
         cached = False
-        if ckpt is not None:
+        if ckpt is not None and os.path.exists(ckpt):
+            progress = _read_progress(ckpt)
             entry = _MODEL_CACHE.get((ckpt_dir, trial_id))
-            if entry is not None and entry[1] == _ckpt_stat(ckpt) \
-                    and entry[1] is not None:
-                f, _ = entry
+            if (entry is not None and progress is not None
+                    and entry[1] is not None
+                    and entry[1] == progress.get("token")):
+                f = entry[0]
                 resumed = cached = True
         if f is None:
             f = build_forecaster(lookback=x_train.shape[1],
@@ -107,18 +146,26 @@ def run_trial_segment(trial_id: int, config: Dict, budget_epochs: int,
             if ckpt is not None and os.path.exists(ckpt):
                 f.load_params(ckpt)
                 resumed = True
-        f.fit(x_train, y_train, batch_size=batch_size,
-              epochs=int(budget_epochs))
+        # epochs already committed on disk; a checkpoint without a
+        # sidecar (or a fresh trial) is assumed exactly at start_epochs
+        done = int(start_epochs)
+        if resumed and progress is not None:
+            done = int(progress.get("epochs", start_epochs))
+        train_epochs = min(int(budget_epochs), max(0, target - done))
+        if train_epochs:
+            f.fit(x_train, y_train, batch_size=batch_size,
+                  epochs=train_epochs)
         metrics = f.evaluate(x_val, y_val, batch_size=batch_size)
         loss = float(metrics["loss"] if isinstance(metrics, dict)
                      else metrics)
-        if ckpt is not None:
+        if ckpt is not None and train_epochs:
             f.save_params(ckpt)
+            token = _write_progress(ckpt, max(done, target))
             while len(_MODEL_CACHE) >= _MODEL_CACHE_CAP:
                 _MODEL_CACHE.pop(next(iter(_MODEL_CACHE)))
-            _MODEL_CACHE[(ckpt_dir, trial_id)] = (f, _ckpt_stat(ckpt))
+            _MODEL_CACHE[(ckpt_dir, trial_id)] = (f, token)
     return {"trial_id": trial_id, "val_loss": loss,
-            "epochs": int(budget_epochs), "resumed": resumed,
+            "epochs": train_epochs, "resumed": resumed,
             "cached": cached, "seconds": time.time() - t0,
             "pid": os.getpid()}
 
@@ -133,7 +180,7 @@ def _finite(v) -> bool:
 class _Trial:
     __slots__ = ("trial_id", "config", "state", "val_loss", "epochs",
                  "segments", "requeues", "seconds", "error", "pids",
-                 "resumed_segments")
+                 "resumed_segments", "budget_done")
 
     def __init__(self, trial_id: int, config: Dict):
         self.trial_id = trial_id
@@ -141,6 +188,7 @@ class _Trial:
         self.state = "pending"    # pending|running|completed|stopped|failed
         self.val_loss: Optional[float] = None
         self.epochs = 0
+        self.budget_done = 0      # cumulative budget of handled segments
         self.segments = 0
         self.requeues = 0
         self.seconds = 0.0
@@ -173,8 +221,8 @@ class AsyncTrialExecutor:
     workdir: checkpoint root.  ``None`` → a private temp dir, removed
         after the search.
     trial_fn: segment function ``(trial_id, config, budget, data,
-        ckpt_dir) -> {"val_loss": ..., ...}``; defaults to
-        :func:`run_trial_segment`.  Swappable so chaos tests can run
+        ckpt_dir, start_epochs) -> {"val_loss": ..., ...}``; defaults
+        to :func:`run_trial_segment`.  Swappable so chaos tests can run
         cheap stub segments.
     max_requeues: worker-loss requeue budget per trial (default 1 —
         "requeue exactly once").
@@ -251,7 +299,7 @@ class AsyncTrialExecutor:
                 self.stats["max_concurrent"], 1)
             try:
                 result = self.trial_fn(trial_id, trial.config, budget,
-                                       data, workdir)
+                                       data, workdir, trial.budget_done)
             except Exception as e:  # noqa: BLE001 - record, keep going
                 self._finalize(trial, "failed",
                                error=f"{type(e).__name__}: {e}")
@@ -276,7 +324,8 @@ class AsyncTrialExecutor:
                     trial = self.trials[trial_id]
                     trial.state = "running"
                     ref = ctx.remote(self.trial_fn).remote(
-                        trial_id, trial.config, budget, data, workdir)
+                        trial_id, trial.config, budget, data, workdir,
+                        trial.budget_done)
                     inflight[ref.task_id] = (ref, trial_id, budget)
                     self.stats["segments"] += 1
                 self.stats["max_concurrent"] = max(
@@ -290,9 +339,12 @@ class AsyncTrialExecutor:
                         result = ctx.get(ref)
                     except WorkerLostError as e:
                         if trial.requeues < self.max_requeues:
-                            # same trial, same budget: the segment
-                            # committed no checkpoint, so rerunning it
-                            # resumes from the previous rung's params
+                            # same trial, same budget, same start_epochs:
+                            # the rerun resumes from the last committed
+                            # checkpoint, and the progress sidecar caps
+                            # it at the rung budget — if the dead worker
+                            # committed before the result got out, the
+                            # rerun skips straight to evaluate
                             trial.requeues += 1
                             self.stats["requeued"] += 1
                             telemetry.counter(
@@ -319,6 +371,7 @@ class AsyncTrialExecutor:
     def _handle_result(self, trial: _Trial, budget: int, result: Dict,
                        runnable) -> None:
         trial.segments += 1
+        trial.budget_done += int(budget)
         trial.epochs += int(result.get("epochs", budget))
         trial.seconds += float(result.get("seconds", 0.0))
         if result.get("resumed"):

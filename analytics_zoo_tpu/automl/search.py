@@ -223,6 +223,12 @@ class AshaSearchEngine(_EngineBase):
 
         rng = np.random.default_rng(seed)
         configs = [sample_config(space, rng) for _ in range(num_samples)]
+        for c in configs:
+            # segment budgets come from the scheduler
+            # (run_trial_segment pops this key), but the winning config
+            # must still carry the full budget so AutoForecaster's final
+            # refit trains recipe.epochs, not the 1-epoch fallback
+            c.setdefault("epochs", epochs)
         scheduler = AshaScheduler(
             max_epochs=epochs, min_epochs=min(self.min_epochs, epochs),
             reduction_factor=self.reduction_factor)
@@ -286,10 +292,13 @@ class AutoForecaster:
             self.recipe.search_space(), (x_tr, y_tr, x_val, y_val),
             num_samples=self.recipe.num_samples, epochs=self.recipe.epochs,
             seed=seed)
-        # refit the winning config on the full window set (driver process)
+        # refit the winning config on the full window set (driver process);
+        # fall back to the recipe's budget if the config lacks "epochs"
+        # (an engine that strips it must not shrink the refit to 1 epoch)
         cfg = dict(self.best_trial["config"])
         batch_size = int(cfg.pop("batch_size", 32))
-        epochs = int(cfg.pop("epochs", 1))
+        epochs = int(cfg.pop("epochs",
+                             getattr(self.recipe, "epochs", 1)))
         self.forecaster = build_forecaster(
             lookback=lookback, feature_dim=x.shape[2], horizon=horizon,
             **cfg)

@@ -30,7 +30,8 @@ import threading
 import time
 
 
-def _stub_segment(trial_id, config, budget, data, ckpt_dir):
+def _stub_segment(trial_id, config, budget, data, ckpt_dir,
+                  start_epochs=0):
     """Deterministic fake trial: announces its claim (pid file in the
     shared workdir, so the chaos thread can kill mid-segment), then
     reports a loss that improves with cumulative budget."""
@@ -68,7 +69,12 @@ def run_smoke(n_trials: int = 8, kill: bool = True) -> int:
         executor = AsyncTrialExecutor(
             scheduler, ray_ctx=ctx, max_concurrent=2,
             trial_fn=_stub_segment, workdir=workdir)
-        configs = [{"v": 0.5 + 0.37 * ((7 * i) % n_trials)}
+        # interleaved (non-monotone) quality order: with a descending
+        # sequence, losing the single best trial to the mid-segment kill
+        # requeues it behind the rest and every rung report arrives as a
+        # new best — ASHA then promotes everything and the early_stopped
+        # gate flakes on which worker claimed which trial first
+        configs = [{"v": 0.5 + 0.37 * ((3 * i) % n_trials)}
                    for i in range(n_trials)]
         t0 = time.time()
         trials = executor.run(configs, data=None)

@@ -252,6 +252,17 @@ class RayContext:
         self._pending: set = set()
         self._inflight: Dict[str, int] = {}   # task_id -> worker pid
         self._lost_tasks: set = set()         # force-resolved as lost
+        # dispatched-but-unclaimed local-queue tasks, in dispatch order:
+        # task_id -> dispatch seq.  A worker SIGKILLed between
+        # task_q.get() and its feeder thread flushing the _STARTED
+        # marker consumes a task that never reaches _inflight; these
+        # fields let _sweep_lost_workers resolve it instead of hanging.
+        self._dispatched: Dict[str, int] = {}
+        self._dispatch_seq = 0
+        self._max_claimed_seq = 0
+        self._dead_pids: set = set()          # local worker pids swept
+        self._unclaimed_deaths = 0            # deaths with no claimed task
+        self._unclaimed_death_at = 0.0
         # actor_id -> ("local", proc, task_q) | ("remote", RemoteHost)
         #            | ("lost", reason)
         self._actors: Dict[str, Any] = {}
@@ -267,6 +278,11 @@ class RayContext:
         self._result_q = ctx.Queue()
         self._inflight.clear()
         self._lost_tasks.clear()
+        self._dispatched.clear()
+        self._dispatch_seq = 0
+        self._max_claimed_seq = 0
+        self._dead_pids.clear()
+        self._unclaimed_deaths = 0
         parent = os.getpid()
         for i in range(self.num_workers):
             p = ctx.Process(
@@ -285,7 +301,7 @@ class RayContext:
             self._cluster = ClusterListener(
                 tuple(self._listen), self._result_q,
                 authkey=self.cluster_authkey,
-                requeue=self._task_q.put,
+                requeue=self._dispatch_local,
                 on_host_lost=self._on_host_lost)
         _global_ray_context = self
         logger.info("RayContext: %d workers up", self.num_workers)
@@ -469,8 +485,17 @@ class RayContext:
                     # host just died (incl. HostLostError from the race
                     # guard): fall through to the local pool
                     pass
-        self._task_q.put((task_id, fn_blob, args_blob))
+        self._dispatch_local((task_id, fn_blob, args_blob))
         return ObjectRef(task_id)
+
+    def _dispatch_local(self, item):
+        """Queue a task onto the local pool, recording its dispatch
+        order so the liveness sweep can tell claimed from
+        consumed-but-unreported (see :meth:`_sweep_lost_workers`)."""
+        with self._results_lock:
+            self._dispatch_seq += 1
+            self._dispatched[item[0]] = self._dispatch_seq
+        self._task_q.put(item)
 
     def get(self, refs, timeout: Optional[float] = None):
         """Block for one ObjectRef or a list of them (ray.get parity)."""
@@ -502,28 +527,89 @@ class RayContext:
         ready_ids = {r.task_id for r in ready}
         return ready, [r for r in refs if r.task_id not in ready_ids]
 
+    #: seconds an unclaimed task must sit while a live worker idles
+    #: before an unaccounted worker death is blamed for consuming it
+    _CLAIM_GRACE_S = 2.0
+
+    def _resolve_lost(self, task_id: str, msg: str):
+        """Force-resolve ``task_id`` as WorkerLostError (lock held)."""
+        self._lost_tasks.add(task_id)
+        self._pending.discard(task_id)
+        self._results[task_id] = ("lost", msg)
+
     def _sweep_lost_workers(self):
         """Resolve in-flight tasks whose local worker process died.
 
         Only tasks claimed by a pid we spawned are swept (remote-host
         workers report foreign pids; host loss is handled by the cluster
         listener's own requeue path).  The ref resolves to a
-        :class:`WorkerLostError` so callers can requeue."""
-        local = {p.pid: p for p in self._procs}
+        :class:`WorkerLostError` so callers can requeue.
+
+        A worker SIGKILLed *between* ``task_q.get()`` and its queue
+        feeder thread flushing the ``_STARTED`` marker leaves a consumed
+        task that never reached ``_inflight`` — invisible to the claimed
+        sweep above, and no other worker can ever run it.  Each such
+        death accounts for at most one task, so the sweep counts worker
+        deaths not attributable to a claimed task and blames the
+        *oldest* dispatched-but-unclaimed task once the evidence is in:
+        either a later-dispatched task was already claimed (the local
+        queue is FIFO, so the older one must have been consumed), or a
+        live worker has sat idle past a grace period with the task still
+        unclaimed.  A false positive (marker merely delayed) is safe:
+        the straggler guard in ``_pump`` drops the duplicate result."""
+        workers = [p for p in self._procs
+                   if p.name.startswith("zoo-ray-worker")]
+        local = {p.pid: p for p in workers}
+        now = time.time()
         with self._results_lock:
             for task_id, pid in list(self._inflight.items()):
                 proc = local.get(pid)
                 if proc is None or proc.is_alive():
                     continue
+                self._dead_pids.add(pid)   # death accounted by its claim
                 del self._inflight[task_id]
                 if task_id in self._results:
                     continue   # result landed before the sweep
-                self._lost_tasks.add(task_id)
-                self._pending.discard(task_id)
-                self._results[task_id] = (
-                    "lost", f"worker pid {pid} died (exitcode "
-                            f"{proc.exitcode}) while running task "
-                            f"{task_id[:8]}")
+                self._resolve_lost(
+                    task_id, f"worker pid {pid} died (exitcode "
+                             f"{proc.exitcode}) while running task "
+                             f"{task_id[:8]}")
+            for pid, proc in local.items():
+                if proc.is_alive() or pid in self._dead_pids:
+                    continue
+                self._dead_pids.add(pid)
+                self._unclaimed_deaths += 1
+                self._unclaimed_death_at = now
+            if not self._dispatched:
+                # nothing dispatched is outstanding, so those deaths
+                # cannot have consumed anything a caller still waits on
+                self._unclaimed_deaths = 0
+            elif self._unclaimed_deaths:
+                busy = set(self._inflight.values())
+                idle_live = any(p.is_alive() and p.pid not in busy
+                                for p in workers)
+                oldest_id = next(iter(self._dispatched))
+                overtaken = (self._max_claimed_seq
+                             > self._dispatched[oldest_id])
+                waited = (now - self._unclaimed_death_at
+                          >= self._CLAIM_GRACE_S)
+                if overtaken or (idle_live and waited):
+                    self._unclaimed_deaths -= 1
+                    del self._dispatched[oldest_id]
+                    if oldest_id not in self._results:
+                        self._resolve_lost(
+                            oldest_id,
+                            f"task {oldest_id[:8]} was consumed by a "
+                            f"worker that died before reporting its "
+                            f"claim (SIGKILL before the queue feeder "
+                            f"flushed)")
+
+    def _note_claimed(self, tid: str):
+        """A marker/result for ``tid`` arrived: it is no longer
+        dispatched-but-unclaimed (lock held)."""
+        seq = self._dispatched.pop(tid, None)
+        if seq is not None and seq > self._max_claimed_seq:
+            self._max_claimed_seq = seq
 
     def _pump(self, remain: Optional[float]):
         """Drain one result-queue item (or time out and sweep liveness)."""
@@ -538,10 +624,12 @@ class RayContext:
         if ok == _STARTED:
             # claim marker: payload is the executing worker's pid
             with self._results_lock:
+                self._note_claimed(tid)
                 if tid in self._pending:
                     self._inflight[tid] = payload
             return
         with self._results_lock:
+            self._note_claimed(tid)
             self._inflight.pop(tid, None)
             if tid in self._lost_tasks:
                 # already force-resolved as lost; the straggler result

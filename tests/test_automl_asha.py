@@ -129,6 +129,53 @@ def test_autoforecaster_rejects_unknown_engine():
         AutoForecaster(recipe=None, engine="hyperband")
 
 
+def _sine_series(n=120):
+    t = np.arange(n, dtype=np.float32)
+    return np.sin(t / 6)[:, None].astype(np.float32)
+
+
+def test_asha_winner_refits_with_full_epoch_budget(monkeypatch):
+    """The ASHA winner's config must carry the recipe's epoch budget so
+    AutoForecaster's final refit trains recipe.epochs — not the 1-epoch
+    fallback (segments strip "epochs"; the config must keep it)."""
+    from analytics_zoo_tpu.automl import AutoForecaster, LSTMRandomRecipe
+    from analytics_zoo_tpu.automl.forecaster import _BaseForecaster
+
+    fit_epochs = []
+    monkeypatch.setattr(
+        _BaseForecaster, "fit",
+        lambda self, x, y, batch_size=32, epochs=1, validation_data=None:
+        fit_epochs.append(epochs) or self)
+    monkeypatch.setattr(
+        _BaseForecaster, "evaluate",
+        lambda self, x, y, batch_size=32: {"loss": float(self.lr)})
+    auto = AutoForecaster(recipe=LSTMRandomRecipe(num_samples=3, epochs=4),
+                          engine="asha", serial=True)
+    auto.fit(_sine_series(), lookback=6)
+    assert auto.best_trial["config"]["epochs"] == 4
+    assert fit_epochs[-1] == 4        # the refit, at the full budget
+
+
+def test_autoforecaster_refit_falls_back_to_recipe_epochs(monkeypatch):
+    """A best config without "epochs" (engine stripped it) must refit
+    with recipe.epochs, not silently shrink to 1."""
+    from analytics_zoo_tpu.automl import AutoForecaster, LSTMRandomRecipe
+    from analytics_zoo_tpu.automl.forecaster import _BaseForecaster
+
+    fit_epochs = []
+    monkeypatch.setattr(
+        _BaseForecaster, "fit",
+        lambda self, x, y, batch_size=32, epochs=1, validation_data=None:
+        fit_epochs.append(epochs) or self)
+    auto = AutoForecaster(recipe=LSTMRandomRecipe(num_samples=2, epochs=5))
+    monkeypatch.setattr(
+        auto.engine, "run",
+        lambda *a, **k: {"config": {"model": "lstm", "lstm_units": (4,),
+                                    "dropout": 0.0}, "val_loss": 0.1})
+    auto.fit(_sine_series(), lookback=6)
+    assert fit_epochs == [5]
+
+
 def test_grid_configs_capped():
     from analytics_zoo_tpu.automl import RandInt, grid_configs
     from analytics_zoo_tpu.automl.search import GridSearchEngine
@@ -148,13 +195,15 @@ def test_grid_configs_capped():
 # ---------------------------------------------------------------------------
 
 
-def _stub_segment(trial_id, config, budget, data, ckpt_dir):
+def _stub_segment(trial_id, config, budget, data, ckpt_dir,
+                  start_epochs=0):
     """Deterministic fake: loss improves with budget, ranked by cfg."""
     return {"trial_id": trial_id, "val_loss": config["v"] / (1 + budget),
             "epochs": budget, "seconds": 0.0, "pid": os.getpid()}
 
 
-def _claiming_stub_segment(trial_id, config, budget, data, ckpt_dir):
+def _claiming_stub_segment(trial_id, config, budget, data, ckpt_dir,
+                           start_epochs=0):
     """Stub that announces (pid, trial) via the shared workdir, then
     sleeps long enough for the chaos test to land a SIGKILL mid-segment."""
     with open(os.path.join(ckpt_dir, f"claim-{os.getpid()}"), "w"):
@@ -163,14 +212,16 @@ def _claiming_stub_segment(trial_id, config, budget, data, ckpt_dir):
     return _stub_segment(trial_id, config, budget, data, ckpt_dir)
 
 
-def _nan_stub_segment(trial_id, config, budget, data, ckpt_dir):
+def _nan_stub_segment(trial_id, config, budget, data, ckpt_dir,
+                      start_epochs=0):
     out = _stub_segment(trial_id, config, budget, data, ckpt_dir)
     if config.get("diverge"):
         out["val_loss"] = float("nan")
     return out
 
 
-def _boom_segment(trial_id, config, budget, data, ckpt_dir):
+def _boom_segment(trial_id, config, budget, data, ckpt_dir,
+                  start_epochs=0):
     if config.get("boom"):
         raise ValueError("segment kaboom")
     return _stub_segment(trial_id, config, budget, data, ckpt_dir)
@@ -212,6 +263,77 @@ def test_executor_records_raised_segment_as_failed():
     assert trials[0]["state"] == "failed"
     assert "kaboom" in trials[0]["error"]
     assert trials[1]["state"] == "completed"
+
+
+def test_executor_passes_cumulative_start_epochs():
+    """Each segment receives the driver-accounted cumulative budget, so
+    a requeued segment reruns with the same (budget, start) pair."""
+    seen = {}
+
+    def fn(trial_id, config, budget, data, ckpt_dir, start_epochs):
+        seen.setdefault(trial_id, []).append((start_epochs, budget))
+        return {"trial_id": trial_id,
+                "val_loss": config["v"] / (1 + start_epochs + budget),
+                "epochs": budget, "seconds": 0.0, "pid": os.getpid()}
+
+    sched = AshaScheduler(max_epochs=9, min_epochs=1, reduction_factor=3)
+    ex = AsyncTrialExecutor(sched, trial_fn=fn, serial=True)
+    ex.run([{"v": v} for v in (1.0, 0.5, 0.2)], data=None)
+    for segments in seen.values():
+        done = 0
+        for start, budget in segments:
+            assert start == done
+            done += budget
+
+
+_SEG_CFG = {"model": "lstm", "lstm_units": (4,), "batch_size": 16,
+            "dropout": 0.0, "lr": 1e-2}
+
+
+def _tiny_windows():
+    from analytics_zoo_tpu.automl.feature import (rolling_window,
+                                                  train_val_split)
+    x, y = rolling_window(_sine_series(80), 6, 1)
+    return train_val_split(x, y, 0.25)
+
+
+def test_segment_skips_epochs_already_committed(tmp_path):
+    """A worker killed after committing its checkpoint but before the
+    result reached the driver must not double-train the requeued
+    segment: progress.json caps the rerun at the rung budget."""
+    from analytics_zoo_tpu.automl.executor import run_trial_segment
+
+    (xt, yt), (xv, yv) = _tiny_windows()
+    data = (xt, yt, xv, yv)
+    r1 = run_trial_segment(0, _SEG_CFG, 1, data, str(tmp_path), 0)
+    assert r1["epochs"] == 1
+    # requeue of the same segment: already committed -> evaluate only
+    r2 = run_trial_segment(0, _SEG_CFG, 1, data, str(tmp_path), 0)
+    assert r2["epochs"] == 0
+    assert r2["resumed"] and r2["cached"]
+    # the promoted next segment still trains its full delta
+    r3 = run_trial_segment(0, _SEG_CFG, 2, data, str(tmp_path), 1)
+    assert r3["epochs"] == 2
+
+
+def test_model_cache_trusts_progress_token_not_stat(tmp_path):
+    """An intermediate commit by another worker — same-architecture
+    weights (identical size), possibly within one mtime granule — must
+    invalidate the worker model cache: validity rides the random
+    sidecar token, not (st_mtime_ns, st_size)."""
+    from analytics_zoo_tpu.automl import executor as exmod
+
+    (xt, yt), (xv, yv) = _tiny_windows()
+    data = (xt, yt, xv, yv)
+    exmod.run_trial_segment(5, _SEG_CFG, 1, data, str(tmp_path), 0)
+    ckpt = os.path.join(str(tmp_path), "trial-5", "weights.npz")
+    # simulate the foreign worker's commit of epoch 2-of-3: the token
+    # rolls even though the weights file stat could be unchanged
+    exmod._write_progress(ckpt, 2)
+    r2 = exmod.run_trial_segment(5, _SEG_CFG, 2, data, str(tmp_path), 1)
+    assert not r2["cached"]           # stale live model was not trusted
+    assert r2["resumed"]              # fell back to the checkpoint
+    assert r2["epochs"] == 1          # trains only the uncommitted epoch
 
 
 def test_executor_seeded_serial_search_is_deterministic():
@@ -287,6 +409,47 @@ def test_executor_requeues_killed_worker_segment_exactly_once(tmp_path):
     assert len(ex.stats["worker_pids"]) >= 1   # the survivor did the work
 
 
+def test_worker_dead_before_claim_marker_resolves_lost(tmp_path):
+    """A worker dying between ``task_q.get()`` and its feeder thread
+    flushing the _STARTED claim marker must not hang the search: the
+    liveness sweep blames the consumed-but-unclaimed task and resolves
+    it as WorkerLostError so the executor can requeue.
+
+    Construction: both workers are parked on long segments while the
+    victim task is stolen straight off the queue (the exact state a
+    dying worker leaves: consumed, no marker), then one worker exits
+    without ever claiming it.  (A SIGKILL against an *idle* worker
+    would land inside ``Queue.get`` while it holds the reader lock and
+    wedge the queue itself — the real kill window is after ``get()``
+    returns, which this reproduces without the lock hazard.)"""
+    from analytics_zoo_tpu.ray import RayContext, WorkerLostError
+
+    with RayContext(num_ray_nodes=2, ray_node_cpu_cores=1,
+                    platform="cpu") as ctx:
+        busy = [ctx.remote(_touch_sleep_then).remote(
+            str(tmp_path / f"busy-{i}"), 1.5, i) for i in range(2)]
+        deadline = time.time() + 30
+        while not all((tmp_path / f"busy-{i}").exists()
+                      for i in range(2)) and time.time() < deadline:
+            time.sleep(0.02)          # both workers picked up a task
+        victim = ctx.remote(_sleep_then).remote(0.0, "victim")
+        # steal the queued task: exactly the state a worker leaves when
+        # it dies after get() but before its claim marker flushes
+        item = ctx._task_q.get(timeout=10)
+        assert item[0] == victim.task_id
+        assert ctx.get(busy) == [0, 1]
+        ctx._task_q.put(None)         # one worker exits, claiming nothing
+        deadline = time.time() + 30
+        while all(p.is_alive() for p in ctx._procs) and \
+                time.time() < deadline:
+            time.sleep(0.02)
+        with pytest.raises(WorkerLostError):
+            ctx.get(victim, timeout=30)
+        # the survivor still serves new work after the sweep
+        ok = ctx.remote(_sleep_then).remote(0.0, "ok")
+        assert ctx.get(ok, timeout=30) == "ok"
+
+
 def test_automl_smoke_script_passes():
     """The scripts/automl-smoke CI hook: 8-trial ASHA on 2 local
     workers with one mid-segment SIGKILL, exactly-once accounting."""
@@ -315,5 +478,12 @@ def test_ray_wait_returns_as_completed():
 
 
 def _sleep_then(seconds, value):
+    time.sleep(seconds)
+    return value
+
+
+def _touch_sleep_then(path, seconds, value):
+    with open(path, "w"):
+        pass
     time.sleep(seconds)
     return value
