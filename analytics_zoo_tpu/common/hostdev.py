@@ -1,0 +1,66 @@
+"""Forced multi-device CPU host topology (re-exec helpers).
+
+``XLA_FLAGS=--xla_force_host_platform_device_count=N`` must be set
+BEFORE jax initializes its backends — too late for any code that runs
+after ``import jax``. Every place that needs a guaranteed N-device CPU
+host therefore re-execs itself into a subprocess carrying the flag:
+``attn_smoke`` hand-rolled the pattern first, the ``zero-smoke`` CLI
+and the ``multi_device_cpu`` test fixture need the same thing, so the
+one canonical copy lives here.
+
+``ZOO_HOSTDEV_CHILD=1`` marks the child (re-exec exactly once: a child
+whose topology still comes up short must fail loudly, not fork-bomb).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Dict, Optional, Sequence
+
+CHILD_ENV = "ZOO_HOSTDEV_CHILD"
+
+
+def cpu_device_env(n: int, base: Optional[Dict[str, str]] = None) \
+        -> Dict[str, str]:
+    """Environment for a subprocess pinned to an ``n``-device CPU host
+    platform: forces the CPU backend, adds the device-count flag unless
+    one is already present, and marks the child."""
+    env = dict(os.environ if base is None else base)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    if "host_platform_device_count" not in env.get("XLA_FLAGS", ""):
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "") +
+            f" --xla_force_host_platform_device_count={n}").strip()
+    env[CHILD_ENV] = "1"
+    return env
+
+
+def have_devices(n: int) -> bool:
+    import jax
+    return len(jax.devices()) >= n
+
+
+def reexec_module(module: str, n: int,
+                  argv: Optional[Sequence[str]] = None) -> Optional[int]:
+    """Re-exec ``python -m module argv...`` pinned to ``n`` CPU devices.
+
+    Returns ``None`` when the caller should just proceed inline — the
+    process already has ``n`` devices, or IS the re-exec child (short
+    topology in the child is then the caller's own loud failure).
+    Otherwise runs the child and returns its exit code."""
+    if os.environ.get(CHILD_ENV) == "1" or have_devices(n):
+        return None
+    return subprocess.run(
+        [sys.executable, "-m", module] +
+        (list(argv) if argv is not None else sys.argv[1:]),
+        env=cpu_device_env(n)).returncode
+
+
+def reexec_pytest(nodeid: str, n: int, timeout: float = 900) -> int:
+    """Run ONE pytest node in a child pinned to ``n`` CPU devices (the
+    ``multi_device_cpu`` fixture's fallback on short-topology hosts)."""
+    return subprocess.run(
+        [sys.executable, "-m", "pytest", "-q", "-x", nodeid],
+        env=cpu_device_env(n), timeout=timeout).returncode

@@ -106,6 +106,17 @@ class ZooConfig:
     # chunk instead of per batch). 0 = follow steps_per_dispatch (auto:
     # fuse on accelerator backends, per-batch on CPU).
     eval_steps_per_dispatch: int = 0
+    # ZeRO-style optimizer-state partitioning (Rajbhandari et al.) over
+    # the DATA mesh axis. 0 = today's replicated path (every dp replica
+    # holds full Adam moments, XLA inserts one grad psum). 1 = shard the
+    # optimizer state of dp-replicated params 1/dp per device: the step
+    # reduce-scatters gradients, runs the optimizer on the local shard
+    # only, and all-gathers updated params — same bytes on the wire as
+    # the all-reduce, a fraction of the optimizer HBM. Leaves already
+    # laid out over a model axis (tp/pp/ep, or fsdp params) are left
+    # alone. Requires an elementwise optimizer chain (all built-in
+    # ZooOptimizers qualify). See docs/zero.md.
+    zero_stage: int = 0
     # gradient accumulation: split each logical batch into this many
     # microbatches inside the compiled step (inner lax.scan, grads
     # combined weighted by microbatch sample-weight mass before the ONE

@@ -256,22 +256,12 @@ def main(argv=None) -> int:
     # the dp check needs >= 2 devices, but running as ``python -m``
     # imports the ops package (and with it jax) before this line — too
     # late for XLA_FLAGS. If the topology is short, re-exec once into a
-    # subprocess pinned to a 2-device CPU host platform.
-    import jax
-    if len(jax.devices()) < 2 and \
-            os.environ.get("ZOO_ATTN_SMOKE_CHILD") != "1":
-        import subprocess
-        env = dict(os.environ)
-        env.setdefault("JAX_PLATFORMS", "cpu")
-        if "host_platform_device_count" not in env.get("XLA_FLAGS", ""):
-            env["XLA_FLAGS"] = (
-                env.get("XLA_FLAGS", "") +
-                " --xla_force_host_platform_device_count=2").strip()
-        env["ZOO_ATTN_SMOKE_CHILD"] = "1"
-        return subprocess.run(
-            [sys.executable, "-m", "analytics_zoo_tpu.ops.attn_smoke"] +
-            (list(argv) if argv is not None else sys.argv[1:]),
-            env=env).returncode
+    # subprocess pinned to a 2-device CPU host platform (shared helper;
+    # this module used to hand-roll the pattern).
+    from ..common.hostdev import reexec_module
+    rc = reexec_module("analytics_zoo_tpu.ops.attn_smoke", 2, argv)
+    if rc is not None:
+        return rc
     rc, payload = run_smoke(stream=sys.stderr if args.json
                             else sys.stdout)
     if args.json:

@@ -43,6 +43,39 @@ def _pvary(x, axis):
     return x  # pre-vma jax: no device-varying type system to satisfy
 
 
+def _record_schedule(S: int, M: int) -> None:
+    """Host-side replay of the static GPipe schedule into telemetry.
+
+    The compiled program gives no per-tick timing, but the schedule is
+    fully determined by (S, M): every rank does useful work on exactly
+    ``M`` of the ``M + S - 1`` ticks, so the idle (bubble) fraction is
+    ``(S - 1) / (M + S - 1)`` — the analytic GPipe bound. Emitting the
+    per-rank occupancy lets the bubble property test measure the
+    fraction from trace events rather than re-deriving it from the same
+    formula it checks. A 1-microbatch schedule is pure serialization
+    (every tick but one is bubble on some rank) — flagged loudly."""
+    if S <= 1:
+        return
+    ticks = M + S - 1
+    bubble = (S - 1) / ticks
+    from ..utils import telemetry
+    if M == 1:
+        import logging
+        logging.getLogger("analytics_zoo_tpu.parallel").warning(
+            "degenerate pipeline schedule: 1 microbatch over %d stages "
+            "runs fully serialized (bubble fraction %.2f) — raise "
+            "n_microbatch", S, bubble)
+        if telemetry.enabled():
+            telemetry.event("pipeline/degenerate_schedule", stages=S,
+                            microbatches=M, bubble_fraction=bubble)
+    if telemetry.enabled():
+        telemetry.event("pipeline/schedule", stages=S, microbatches=M,
+                        ticks=ticks, bubble_fraction=bubble)
+        for rank in range(S):
+            telemetry.event("pipeline/stage_occupancy", rank=rank,
+                            busy_ticks=M, total_ticks=ticks)
+
+
 def stack_stage_params(per_stage_params) -> Any:
     """Stack a list of identically-shaped per-stage param pytrees along a new
     leading 'stage' axis (the axis sharded over ``pipe``)."""
@@ -84,6 +117,7 @@ def pipeline_forward(stage_fn: Callable, stacked_params, x, mesh: Mesh,
         raise ValueError(f"batch {batch} not divisible by "
                          f"n_microbatch {n_microbatch}")
     mb = batch // n_microbatch
+    _record_schedule(int(S), int(n_microbatch))
 
     # (M, mb, ...) microbatch-major view per leaf
     xs = jax.tree.map(

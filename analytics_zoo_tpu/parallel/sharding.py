@@ -110,3 +110,22 @@ def make_param_sharding_fn(graph, mesh, rules: Optional[Dict] = None):
 def shard_params(params, sharding_fn):
     import jax
     return jax.device_put(params, sharding_fn(params))
+
+
+def spec_is_replicated(spec) -> bool:
+    """True when a PartitionSpec places the array on no mesh axis at all
+    (fully replicated). Treats a missing/None spec as replicated; nested
+    tuple entries (axis groups) count as sharded. The ZeRO stage-1
+    classifier (parallel.zero) uses this to pick which optimizer moments
+    may flat-shard over ``data``."""
+    if spec is None:
+        return True
+    for entry in tuple(spec):
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            if any(a is not None for a in entry):
+                return False
+        else:
+            return False
+    return True

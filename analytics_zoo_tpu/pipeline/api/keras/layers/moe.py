@@ -109,6 +109,28 @@ class SparseMoE(KerasLayer):
             combine = combine + sel * top_w[:, slot][:, None, None]
             used = used + jnp.sum(onehot, axis=0)
 
+        # overflow semantics (pinned by tests/test_parallel_props.py):
+        # a (token, slot) assignment past expert capacity contributes
+        # ZERO dispatch and ZERO combine weight — the token's output row
+        # is zero for that slot, it is DROPPED, never re-routed to a
+        # colder expert. Drops must be observable instead of silently
+        # flattening the loss: the shortfall vs the n*k issued
+        # assignments rides out through a host callback into the
+        # telemetry counter. Gated on telemetry.enabled() at TRACE time
+        # (a program traced while disabled keeps no callback); under
+        # multi-device jit the callback may fire once per device — read
+        # the counter as "drops observed", not an exact global count.
+        dropped = jnp.asarray(float(n * k)) - jnp.sum(dispatch)
+        from .....utils import telemetry
+        if telemetry.enabled():
+            name = self.name
+
+            def _surface(d):
+                telemetry.counter("zoo_moe_dropped_tokens_total",
+                                  layer=name).inc(float(d))
+
+            jax.debug.callback(_surface, dropped)
+
         xin = jnp.einsum("nec,nh->ech", dispatch.astype(x.dtype), flat)
         h1 = jnp.einsum("ech,ehf->ecf", xin,
                         params["w_in"].astype(x.dtype)) + \

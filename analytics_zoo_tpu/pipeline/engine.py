@@ -29,7 +29,10 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from ..common.jax_compat import shard_map
 from ..common.nncontext import ZooContext, get_nncontext
+from ..parallel import zero as zero_part
+from ..parallel.sharding import spec_is_replicated
 from ..common.zoo_trigger import (And, EveryEpoch, MaxEpoch, MaxIteration,
                                   Or, SeveralIteration, TrainRecord,
                                   ZooTrigger)
@@ -154,13 +157,17 @@ class GradientClipping:
     def apply(self, grads):
         return self.apply_with_norm(grads)[0]
 
-    def apply_with_norm(self, grads):
+    def apply_with_norm(self, grads, precomputed_norm=None):
         """Clip and also return the pre-clip global norm when L2-norm
         clipping computes one anyway (else None — callers must not pay
-        an extra full-gradient reduce just to log it)."""
-        gnorm = None
+        an extra full-gradient reduce just to log it). The ZeRO step
+        passes ``precomputed_norm`` (its cross-rank psum'd norm of the
+        gradient shards): ``optax.global_norm`` over a shard would be a
+        rank-LOCAL norm and clip each rank differently."""
+        gnorm = precomputed_norm
         if self.l2_norm is not None:
-            gnorm = optax.global_norm(grads)
+            if gnorm is None:
+                gnorm = optax.global_norm(grads)
             scale = jnp.minimum(1.0, self.l2_norm / (gnorm + 1e-12))
             grads = jax.tree.map(lambda g: g * scale, grads)
         if self.min_value is not None or self.max_value is not None:
@@ -248,6 +255,16 @@ class SPMDTrainer:
         # top-level param keys (layer names) excluded from updates
         # (GraphNet freeze/unFreeze parity)
         self.frozen_names: frozenset = frozenset()
+        # ZeRO stage-1 (ZooConfig.zero_stage=1, parallel/zero.py,
+        # docs/zero.md): "off" | "flat" (explicit reduce-scatter step on a
+        # pure-dp mesh) | "gspmd" (layout-only sharding under mixed
+        # meshes). Resolved lazily on first placement — needs the param
+        # shardings — and fixed for the trainer's lifetime.
+        self._zero_mode: Optional[str] = None
+        # opt-state leaf paths currently in the sharded-flat layout
+        self._zero_opt_paths: frozenset = frozenset()
+        # gspmd mode: the opt-state layout tree the step re-constrains to
+        self._zero_gspmd_shardings = None
         # observability hooks
         self.train_summary = None
         self.val_summary = None
@@ -375,13 +392,90 @@ class SPMDTrainer:
 
         return sh_for
 
+    def _zero_mode_resolved(self) -> str:
+        """Which ZeRO stage-1 implementation this trainer uses (cached):
+
+        * ``"off"``  — zero_stage=0 or dp<=1: today's replicated path.
+        * ``"flat"`` — pure-dp mesh AND every param replicated: optimizer
+          moments live flattened/padded ``P('data')`` and the step is an
+          explicit reduce-scatter / local-update / all-gather shard_map.
+        * ``"gspmd"`` — model-parallel mesh or sharded params: the step
+          stays the GSPMD program; only dp-replicated moments get a
+          ``data`` dimension in their layout (memory win, no collective
+          rewrite — pp/tp/ep-laid-out leaves are left alone).
+        """
+        if self._zero_mode is not None:
+            return self._zero_mode
+        stage = int(getattr(self.ctx.config, "zero_stage", 0) or 0)
+        if stage not in (0, 1):
+            raise ValueError(f"zero_stage must be 0 or 1, got {stage}")
+        mesh = self.ctx.mesh
+        if stage == 0 or int(mesh.shape["data"]) <= 1:
+            self._zero_mode = "off"
+        else:
+            all_repl = all(
+                spec_is_replicated(getattr(sh, "spec", None))
+                for sh in jax.tree.leaves(self._param_shardings(self.params)))
+            self._zero_mode = "flat" if zero_part.pure_dp(mesh) and all_repl \
+                else "gspmd"
+        return self._zero_mode
+
+    def _zero_widen_sharding(self, sh, shape):
+        """gspmd mode: add ``data`` to the first replicated, dp-divisible
+        dim of a param-mirroring moment leaf's sharding (placement only —
+        XLA keeps the step program and inserts the moves)."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        mesh = self.ctx.mesh
+        dp = int(mesh.shape["data"])
+        spec = tuple(getattr(sh, "spec", ()) or ())
+        if not spec_is_replicated(spec) and any(
+                e == "data" or (isinstance(e, tuple) and "data" in e)
+                for e in spec):
+            return sh
+        entries = list(spec) + [None] * (len(shape) - len(spec))
+        for i, dim in enumerate(shape):
+            if entries[i] is None and dim > 0 and dim % dp == 0:
+                entries[i] = "data"
+                return NamedSharding(mesh, PartitionSpec(*entries))
+        return sh
+
     def _place_opt_state(self, opt_state):
+        mode = self._zero_mode_resolved()
+        if mode == "flat":
+            opt_state, paths = zero_part.shard_opt_state(
+                opt_state, self.params, self._param_shardings(self.params),
+                self.ctx.mesh)
+            self._zero_opt_paths = frozenset(paths)
+            return opt_state
         sh_for = self._opt_sharding_resolver()
         flat, treedef = jax.tree_util.tree_flatten_with_path(opt_state)
-        placed = [leaf if self._keep_in_place(leaf, sh_for(tuple(path)))
-                  else jax.device_put(np.asarray(leaf), sh_for(tuple(path)))
-                  for path, leaf in flat]
+        placed, shs = [], []
+        for path, leaf in flat:
+            sh = sh_for(tuple(path))
+            if mode == "gspmd" and hasattr(leaf, "shape") and \
+                    getattr(leaf, "ndim", 0) >= 1:
+                sh = self._zero_widen_sharding(sh, tuple(leaf.shape))
+            shs.append(sh)
+            placed.append(leaf if self._keep_in_place(leaf, sh)
+                          else jax.device_put(np.asarray(leaf), sh))
+        if mode == "gspmd":
+            # the step constrains its opt-state outputs to these layouts
+            # so input/output shardings stay identical under donation (one
+            # drifting leaf = the ~100x per-dispatch reshard class above)
+            self._zero_gspmd_shardings = jax.tree_util.tree_unflatten(
+                treedef, shs)
         return jax.tree_util.tree_unflatten(treedef, placed)
+
+    def _canonical_opt_state(self, opt_state=None):
+        """Optimizer state in the canonical (param-shaped, zero=0)
+        representation — what EVERY checkpoint writes, so zero=1 runs
+        restore onto any dp degree and stages up/down-grade in place
+        (docs/zero.md). A no-op unless flat-mode leaves are live."""
+        opt_state = self.opt_state if opt_state is None else opt_state
+        if self._zero_mode == "flat" and self._zero_opt_paths:
+            return zero_part.unshard_opt_state(
+                opt_state, self.params, self._zero_opt_paths)
+        return opt_state
 
     def set_params(self, params, state=None):
         if params is None:
@@ -442,20 +536,34 @@ class SPMDTrainer:
         return jax.tree.map(split, tuple(batch),
                             is_leaf=lambda x: x is None)
 
-    def _accumulated_grads(self, params, net_state, batch, rng, accum):
-        """Gradient accumulation (traced): an inner ``lax.scan`` over
-        ``accum`` microbatches computes per-microbatch grads and combines
-        them weighted by each microbatch's sample-weight mass, so the
-        result equals the full-batch weighted-mean gradient up to
-        reduction order — while peak activation memory is that of ONE
-        microbatch. Runs inside the jitted step (and inside the k-step
-        dispatch scan): no host sync per microbatch.
+    def _weighted_grad_sums(self, params, net_state, batch, rng, accum):
+        """Weighted-SUM loss and gradients (traced), no normalization:
+        returns ``(loss_sum, grad_sum, mass, new_state)`` where
+        ``grad_sum = Σ grad(weighted-mean loss of microbatch) * mass`` and
+        ``mass`` is the sample-weight mass (or plain count). Dividing by
+        the TOTAL mass — local for the replicated step, psum'd over
+        ``data`` for the ZeRO step — recovers the exact weighted-mean
+        gradient, which is what makes the reduce-scatter path bit-match
+        the allreduce path up to reduction order.
 
-        Caveat (documented in docs/training.md): non-trainable state
-        (BatchNorm running stats) updates sequentially per microbatch,
-        and the dropout stream folds in the microbatch index — both
-        differ from the equivalent full batch.
+        With ``accum > 1`` this is the microbatch ``lax.scan``; peak
+        activation memory is that of ONE microbatch. Caveat (documented
+        in docs/training.md): non-trainable state (BatchNorm running
+        stats) updates sequentially per microbatch, and the dropout
+        stream folds in the microbatch index — both differ from the
+        equivalent full batch.
         """
+        if accum == 1:
+            (loss, (_, new_state)), grads = jax.value_and_grad(
+                lambda p: self._loss_and_preds(p, net_state, batch, rng,
+                                               True), has_aux=True)(params)
+            w = batch[2]
+            sw = jnp.sum(w.astype(jnp.float32)) if w is not None \
+                else jnp.asarray(
+                    float(jax.tree.leaves(batch[0])[0].shape[0]))
+            return (loss * sw, jax.tree.map(lambda g: g * sw, grads),
+                    sw, new_state)
+
         micro = self._split_microbatches(batch, accum)
         mb_len = micro[0][0].shape[1]
 
@@ -476,15 +584,142 @@ class SPMDTrainer:
                 jnp.zeros(()), net_state)
         (g_acc, loss_acc, w_acc, new_state), _ = jax.lax.scan(
             body, init, (jnp.arange(accum), micro))
+        return loss_acc, g_acc, w_acc, new_state
+
+    def _accumulated_grads(self, params, net_state, batch, rng, accum):
+        """Gradient accumulation (traced): weighted sums from
+        :meth:`_weighted_grad_sums` normalized by the local mass — the
+        full-batch weighted-mean loss/gradient up to reduction order."""
+        loss_sum, g_sum, w_acc, new_state = self._weighted_grad_sums(
+            params, net_state, batch, rng, accum)
         denom = jnp.maximum(w_acc, 1e-12)
-        return (loss_acc / denom,
-                jax.tree.map(lambda g: g / denom, g_acc), new_state)
+        return (loss_sum / denom,
+                jax.tree.map(lambda g: g / denom, g_sum), new_state)
+
+    def _zero_step_body(self, params, opt_state, net_state, batch, step):
+        """ZeRO stage-1 step (traced): the whole fwd/bwd/update runs in
+        ONE shard_map over ``data``. Gradients leave the backward pass as
+        per-rank weighted sums; each leaf is flattened, zero-padded to a
+        multiple of dp and **reduce-scattered** (``lax.psum_scatter`` —
+        same wire bytes as the allreduce, split in two phases), so every
+        rank holds only its 1/dp slice of the summed gradient. The optax
+        update then runs on the LOCAL shard of gradient/moments/params
+        (1/dp Adam memory per device — the stage-1 claim), and updated
+        params are **all-gathered** back to replicated. Freeze masks,
+        clipping (cross-rank norm), grad-accum and the health sentinel
+        compose exactly as in :meth:`_step_body`; the jaxpr contract is
+        pinned by ``parallel.zero.assert_zero_collectives``."""
+        from jax.sharding import PartitionSpec as P
+        mesh = self.ctx.mesh
+        dp = int(mesh.shape["data"])
+        accum = self._grad_accum_steps()
+        cfg = self.ctx.config
+        root = self._train_root_key()
+        frozen = self.frozen_names
+        sentinel = self._health_sentinel_on()
+        want_gnorm = self.clipping.l2_norm is not None or (
+            sentinel and bool(getattr(cfg, "health_grad_sentinel", False)))
+        want_gnorm_log = self.clipping.l2_norm is not None and \
+            bool(getattr(cfg, "log_grad_norm", False))
+
+        repl, data0 = P(), P("data")
+        o_flat, o_def = jax.tree_util.tree_flatten_with_path(opt_state)
+        o_specs = jax.tree_util.tree_unflatten(
+            o_def, [data0 if tuple(path) in self._zero_opt_paths else repl
+                    for path, _ in o_flat])
+        p_specs = jax.tree.map(lambda _: repl, params)
+        s_specs = jax.tree.map(lambda _: repl, net_state)
+        b_specs = jax.tree.map(lambda _: data0, tuple(batch))
+        logs_specs = {"loss": repl}
+        if want_gnorm_log:
+            logs_specs["grad_norm"] = repl
+        if sentinel:
+            logs_specs["health_bad"] = repl
+
+        def pad_flat(x):
+            flat = x.reshape(-1)
+            pad = zero_part.padded_size(flat.shape[0], dp) - flat.shape[0]
+            if pad:
+                flat = jnp.concatenate(
+                    [flat, jnp.zeros((pad,), flat.dtype)])
+            return flat
+
+        def body(params, opt_state, net_state, batch, step):
+            rng = jax.random.fold_in(root, step)
+            loss_sum, g_sum, mass, new_state = self._weighted_grad_sums(
+                params, net_state, batch, rng, accum)
+            denom = jnp.maximum(jax.lax.psum(mass, "data"), 1e-12)
+            loss = jax.lax.psum(loss_sum, "data") / denom
+            # reduce-scatter the weighted gradient sums, normalize the
+            # local shard: each rank now holds 1/dp of the GLOBAL mean
+            # gradient — no rank ever materializes the full reduced grad
+            g_sh = jax.tree.map(
+                lambda g: jax.lax.psum_scatter(
+                    pad_flat(g), "data", scatter_dimension=0,
+                    tiled=True) / denom, g_sum)
+            if frozen:
+                g_sh = {k: (jax.tree.map(jnp.zeros_like, g)
+                            if k in frozen else g)
+                        for k, g in g_sh.items()}
+            gnorm = None
+            if want_gnorm:
+                sq = sum(jnp.vdot(g, g)
+                         for g in jax.tree.leaves(g_sh)) + jnp.zeros(())
+                gnorm = jnp.sqrt(jax.lax.psum(sq, "data"))
+            g_sh, gnorm = self.clipping.apply_with_norm(
+                g_sh, precomputed_norm=gnorm)
+            rank = jax.lax.axis_index("data")
+            p_sh = jax.tree.map(
+                lambda p: jax.lax.dynamic_slice_in_dim(
+                    pad_flat(p), rank * (zero_part.padded_size(
+                        int(np.prod(p.shape, dtype=np.int64)), dp) // dp),
+                    zero_part.padded_size(
+                        int(np.prod(p.shape, dtype=np.int64)), dp) // dp),
+                params)
+            updates, new_opt = self.tx.update(g_sh, opt_state, p_sh)
+            if frozen:
+                updates = {k: (jax.tree.map(jnp.zeros_like, u)
+                               if k in frozen else u)
+                           for k, u in updates.items()}
+            p_new = optax.apply_updates(p_sh, updates)
+            new_params = jax.tree.map(
+                lambda pl, p: jax.lax.all_gather(
+                    pl, "data", tiled=True)[:int(np.prod(
+                        p.shape, dtype=np.int64))].reshape(p.shape),
+                p_new, params)
+            # keep non-trainable state replicated: each rank updated BN
+            # stats from its local shard of the batch — average them (the
+            # replicated path's stats see the full batch instead; the
+            # small difference is documented in docs/zero.md)
+            new_state = jax.tree.map(
+                lambda x: jax.lax.pmean(x, "data")
+                if hasattr(x, "dtype") and
+                jnp.issubdtype(x.dtype, jnp.inexact) else x, new_state)
+            logs = {"loss": loss}
+            if want_gnorm_log:
+                logs["grad_norm"] = gnorm
+            if sentinel:
+                bad = ~jnp.isfinite(loss)
+                if gnorm is not None:
+                    bad = bad | ~jnp.isfinite(gnorm)
+                logs["health_bad"] = bad
+            return new_params, new_opt, new_state, logs
+
+        fn = shard_map(body, mesh=mesh,
+                       in_specs=(p_specs, o_specs, s_specs, b_specs, repl),
+                       out_specs=(p_specs, o_specs, s_specs, logs_specs),
+                       check_vma=False)
+        return fn(params, opt_state, net_state, tuple(batch), step)
 
     def _step_body(self, params, opt_state, net_state, batch, step):
         """One optimization step (traced): fwd, bwd, clip, update. With
         ``grad_accum_steps > 1`` the fwd/bwd runs as an inner microbatch
         scan (see :meth:`_accumulated_grads`); clip + update still happen
-        exactly once on the combined gradient."""
+        exactly once on the combined gradient. ZeRO flat mode swaps in
+        the explicit reduce-scatter step (:meth:`_zero_step_body`)."""
+        if self._zero_mode_resolved() == "flat":
+            return self._zero_step_body(params, opt_state, net_state,
+                                        batch, step)
         rng = jax.random.fold_in(self._train_root_key(), step)
         accum = self._grad_accum_steps()
         if accum > 1:
@@ -500,6 +735,14 @@ class SPMDTrainer:
                      for k, g in grads.items()}
         grads, gnorm = self.clipping.apply_with_norm(grads)
         updates, opt_state = self.tx.update(grads, opt_state, params)
+        if self._zero_mode == "gspmd" and \
+                self._zero_gspmd_shardings is not None:
+            # ZeRO gspmd mode: pin the moment outputs to their widened
+            # (data-sharded) layouts so input/output shardings stay
+            # identical under donation — one drifting leaf re-creates the
+            # ~100x per-dispatch reshard documented at _place_state
+            opt_state = jax.lax.with_sharding_constraint(
+                opt_state, self._zero_gspmd_shardings)
         if self.frozen_names:
             # zeroed grads are not enough: stateful transforms (Adam
             # moments accumulated pre-freeze, weight decay) still emit
@@ -1467,7 +1710,9 @@ class SPMDTrainer:
         groups = {
             "params": jax.tree_util.tree_leaves(self.params),
             "state": jax.tree_util.tree_leaves(self.net_state or {}),
-            "optim": jax.tree_util.tree_leaves(self.opt_state),
+            # always the canonical (param-shaped) representation on disk:
+            # a ZeRO flat-sharded save would pin the writer's dp degree
+            "optim": jax.tree_util.tree_leaves(self._canonical_opt_state()),
         }
         # tag every file of this save with the step: the save only becomes
         # visible at the single write_commit rename below, so a crash at
@@ -1548,6 +1793,10 @@ class SPMDTrainer:
                 dtypes=[getattr(leaf, "dtype", None) or
                         np.asarray(leaf).dtype for leaf in o_leaves],
                 tag=tag))
+        if self._zero_mode_resolved() == "flat":
+            # the store holds the canonical representation; flat mode
+            # re-shards onto THIS run's dp degree (dp-resharding restore)
+            self.opt_state = self._place_opt_state(self.opt_state)
         meta_name = "meta.npz" if tag is None else f"meta.{tag}.npz"
         meta = serialization.load_pytree(os.path.join(directory, meta_name))
         self._restore_position(meta)
@@ -1739,9 +1988,13 @@ class SPMDTrainer:
                     return np.array(arr, copy=True)
             return arr
 
+        # opt state is snapshotted in the canonical (param-shaped) form:
+        # ZeRO flat-sharded leaves are assembled to fresh host arrays by
+        # the unshard (owned bytes — the copy-vs-alias logic below only
+        # matters for the leaves that pass through untouched)
         return (jax.tree.map(snap, self.params),
                 jax.tree.map(snap, self.net_state),
-                jax.tree.map(snap, self.opt_state),
+                jax.tree.map(snap, self._canonical_opt_state()),
                 self._train_position_meta())
 
     def wait_for_checkpoint(self):

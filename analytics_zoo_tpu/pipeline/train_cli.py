@@ -169,6 +169,31 @@ def render_status(logdir: str, trace_dir: Optional[str],
             f" | opt {_val(scalars, 'HBMOptStateMB'):.1f}"
             f" | act+temp {_val(scalars, 'HBMActivationsMB'):.1f}"
             f" | transfers {_val(scalars, 'HBMTransfersMB'):.1f}")
+    # per-param-group optimizer-state breakout (ZeRO visibility): the
+    # per-device gauge is where stage-1's 1/dp sharding shows up — the
+    # global bytes stay flat across zero_stage, by design
+    groups: Dict[str, Tuple[Optional[float], Optional[float]]] = {}
+    for key, value in gauges.items():
+        for name, slot in (
+                ("zoo_hbm_program_opt_state_group_per_device_bytes{", 1),
+                ("zoo_hbm_program_opt_state_group_bytes{", 0)):
+            if key.startswith(name) and "program=train" in key:
+                group = next((part.split("=", 1)[1] for part in
+                              key[len(name):-1].split(",")
+                              if part.startswith("group=")), None)
+                if group is not None:
+                    pair = list(groups.get(group, (None, None)))
+                    pair[slot] = value
+                    groups[group] = tuple(pair)
+    if groups:
+        lines.append("  opt state by group (global / per-device):")
+        for group in sorted(groups):
+            g_total, g_dev = groups[group]
+            row = f"    {group:<24s}"
+            row += _fmt_bytes(g_total) if g_total is not None else "?"
+            if g_dev is not None:
+                row += f" / {_fmt_bytes(g_dev)}"
+            lines.append(row)
     in_use = {k: v for k, v in gauges.items()
               if k.startswith("zoo_hbm_bytes_in_use")}
     if in_use:

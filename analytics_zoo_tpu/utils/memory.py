@@ -67,6 +67,69 @@ def _bytes_of_tree(tree) -> int:
     return total
 
 
+def _per_device_bytes_of_tree(tree) -> int:
+    """PER-DEVICE bytes of a pytree: ``sharding.shard_shape`` when a leaf
+    is laid out over the mesh (ZeRO flat-sharded optimizer moments, TP
+    weights), global ``nbytes`` for replicated leaves. This is the number
+    the ZeRO stage-1 1/dp claim is about — global bytes of a sharded
+    leaf count the whole logical array and would hide the win."""
+    if tree is None:
+        return 0
+    import jax
+    import numpy as np
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        if not (hasattr(leaf, "shape") and hasattr(leaf, "dtype")):
+            continue
+        itemsize = np.dtype(leaf.dtype).itemsize
+        sh = getattr(leaf, "sharding", None)
+        if sh is not None and hasattr(sh, "shard_shape"):
+            try:
+                total += int(np.prod(sh.shard_shape(tuple(leaf.shape)),
+                                     dtype=np.int64)) * itemsize
+                continue
+            except Exception:  # noqa: BLE001 - fall back to global size
+                pass
+        total += int(np.prod(leaf.shape, dtype=np.int64)) * itemsize
+    return total
+
+
+def opt_state_groups(opt_state, params) -> Dict[str, Dict[str, int]]:
+    """Per-param-group optimizer-state bytes.
+
+    Every opt-state leaf that mirrors a parameter (its tree path ENDS
+    with the param's path — the engine's resolver rule) is attributed to
+    the param's leading key (the layer name); everything else (schedule
+    counts, scalars) lands in ``_other``. Each group reports both global
+    ``bytes`` and shard-aware ``per_device_bytes``; the global values sum
+    EXACTLY to :func:`_bytes_of_tree` of the whole opt state, which is
+    what ``account_program`` publishes as ``zoo_hbm_program_opt_state``
+    — tests pin that invariant so the breakout can never drift from the
+    total."""
+    if opt_state is None:
+        return {}
+    import jax
+    import numpy as np
+    param_paths = set()
+    if params is not None:
+        param_paths = {tuple(p) for p, _ in
+                       jax.tree_util.tree_flatten_with_path(params)[0]}
+    groups: Dict[str, Dict[str, int]] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(opt_state)[0]:
+        path = tuple(path)
+        match = next((path[start:] for start in range(len(path))
+                      if path[start:] in param_paths), None)
+        if match is not None:
+            key = match[0]
+            group = str(getattr(key, "key", getattr(key, "idx", key)))
+        else:
+            group = "_other"
+        g = groups.setdefault(group, {"bytes": 0, "per_device_bytes": 0})
+        g["bytes"] += _bytes_of_tree([leaf])
+        g["per_device_bytes"] += _per_device_bytes_of_tree([leaf])
+    return groups
+
+
 def _stat(stats, name) -> int:
     try:
         v = getattr(stats, name, None)
@@ -104,6 +167,7 @@ def program_breakdown(compiled, params=None, opt_state=None) -> \
     return {
         "params_bytes": params_b,
         "opt_state_bytes": opt_b,
+        "opt_state_per_device_bytes": _per_device_bytes_of_tree(opt_state),
         "activations_temp_bytes": temp,
         "transfers_bytes": max(argument - alias, 0) + max(output - alias, 0),
         "argument_bytes": argument,
@@ -127,14 +191,28 @@ def account_program(program: str, compiled, params=None, opt_state=None,
     bd = program_breakdown(compiled, params=params, opt_state=opt_state)
     if bd is None:
         return None
+    groups = opt_state_groups(opt_state, params)
     with _LOCK:
-        _PROGRAMS[program] = dict(bd)
+        _PROGRAMS[program] = dict(bd,
+                                  opt_state_groups={g: dict(v) for g, v
+                                                    in groups.items()})
     telemetry.gauge("zoo_hbm_program_total_bytes",
                     program=program).set(bd["total_bytes"])
     telemetry.gauge("zoo_hbm_program_params_bytes",
                     program=program).set(bd["params_bytes"])
     telemetry.gauge("zoo_hbm_program_opt_state_bytes",
                     program=program).set(bd["opt_state_bytes"])
+    telemetry.gauge("zoo_hbm_program_opt_state_per_device_bytes",
+                    program=program).set(bd["opt_state_per_device_bytes"])
+    # per-param-group breakout (ZeRO visibility): the global-bytes gauges
+    # sum exactly to zoo_hbm_program_opt_state_bytes; the per-device
+    # variant is where the 1/dp sharding shows up in `zoo-train top`
+    for group, gb in groups.items():
+        telemetry.gauge("zoo_hbm_program_opt_state_group_bytes",
+                        program=program, group=group).set(gb["bytes"])
+        telemetry.gauge(
+            "zoo_hbm_program_opt_state_group_per_device_bytes",
+            program=program, group=group).set(gb["per_device_bytes"])
     telemetry.gauge("zoo_hbm_program_temp_bytes",
                     program=program).set(bd["activations_temp_bytes"])
     telemetry.gauge("zoo_hbm_program_transfer_bytes",

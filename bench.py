@@ -1094,6 +1094,68 @@ def bench_attention(seq_len=2048):
     return out
 
 
+def bench_zero():
+    """ZeRO stage-1 optimizer-sharding leg (docs/zero.md) — CPU-provable.
+
+    Runs the zero-smoke module (the same checks ``scripts/zero-smoke``
+    gates CI on) in a pinned 4-device CPU subprocess with ``--bench``:
+
+    (a) loss parity zero=1 vs zero=0 at dp=2 and dp=4 (<= 1e-6 over 20
+        Adam steps) — the sharded update must be bit-for-bit the same
+        math;
+    (b) per-device optimizer moment bytes at dp=4, zero=1 vs replicated
+        — live arrays and the AOT-compiled step's memory_analysis()
+        both; gate: ratio <= 0.30 (ideal 1/dp = 0.25 plus padding);
+    (c) jaxpr collective contract: reduce-scatter + all-gather present,
+        no full-gradient-sized all-reduce;
+    (d) hot-step wall time, zero=1 vs replicated on a 256-wide model
+        (toy widths are dispatch-dominated and meaningless); gate:
+        not worse than 1.05x.
+    """
+    out = {}
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env.pop("ZOO_TPU_ZERO_STAGE", None)
+    p = subprocess.run(
+        [sys.executable, "-m", "analytics_zoo_tpu.pipeline.zero_smoke",
+         "--bench", "--json"],
+        capture_output=True, text=True, env=env, timeout=900)
+    out["zero_smoke_rc"] = p.returncode
+    ratio = time_ratio = None
+    try:
+        payload = json.loads(p.stdout.strip().splitlines()[-1])
+        out["zero_smoke_checks"] = payload.get("checks")
+        out["zero_parity_ok"] = payload.get("parity_ok")
+        out["zero_parity_dp4_max_err"] = payload.get("parity_dp4_max_err")
+        ratio = payload.get("opt_state_bytes_ratio")
+        out["zero_opt_state_bytes_ratio"] = ratio
+        out["zero_compiled_opt_state_ratio"] = payload.get(
+            "compiled_opt_state_ratio")
+        out["zero_opt_moment_bytes_replicated"] = payload.get(
+            "opt_moment_bytes_replicated")
+        out["zero_opt_moment_bytes_zero1"] = payload.get(
+            "opt_moment_bytes_zero1")
+        out["zero_step_time_replicated_ms"] = payload.get(
+            "step_time_replicated_ms")
+        out["zero_step_time_ms"] = payload.get("step_time_zero1_ms")
+        time_ratio = payload.get("step_time_ratio")
+        out["zero_step_time_ratio"] = time_ratio
+    except Exception:  # noqa: BLE001 — keep stderr head for diagnosis
+        out["zero_smoke_parse_err"] = (p.stderr or p.stdout)[-300:]
+    _gate("zero_smoke", p.returncode == 0,
+          f"zero_smoke rc={p.returncode}: "
+          f"{(p.stderr or p.stdout)[-160:]}")
+    _gate("zero_opt_state_bytes_0p30x", ratio is not None and
+          ratio <= 0.30,
+          f"per-device opt moment bytes ratio {ratio} > 0.30 "
+          f"(dp=4 ideal 0.25)")
+    _gate("zero_step_time_not_worse", time_ratio is not None and
+          time_ratio <= 1.05,
+          f"zero=1 step time {time_ratio}x replicated > 1.05x")
+    return out
+
+
 def _serving_pipeline_compare(make_serving, enqueue, n_records,
                               batch_size, pacing_s):
     """Run the identical mixed-arrival workload through the synchronous
@@ -3372,6 +3434,22 @@ def main():
             RESULT["attn_error"] = (str(e).splitlines()[0][:500]
                                     if str(e) else repr(e)[:500])
         _stamp_leg_artifacts("attn")
+        emit()
+
+    # ZeRO stage-1 leg: parity + per-device optimizer bytes (<= 0.30x
+    # replicated) + collective contract + step-time-not-worse, via the
+    # zero-smoke subprocess on a pinned 4-device CPU host
+    # (docs/zero.md). CPU-provable.
+    if time.time() - T_START < TOTAL_BUDGET_S * 0.9:
+        try:
+            RESULT.update(bench_zero())
+        except Exception as e:  # noqa: BLE001
+            import traceback
+            traceback.print_exc()
+            RESULT["zero_error"] = (str(e).splitlines()[0][:500]
+                                    if str(e) else repr(e)[:500])
+            _gate("zero_smoke", False, RESULT["zero_error"])
+        _stamp_leg_artifacts("zero")
         emit()
 
     # Serving-latency leg (SURVEY §7 hard-part (e)): AOT predict p50/p99

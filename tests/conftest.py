@@ -79,3 +79,27 @@ def _fresh_context():
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+@pytest.fixture
+def multi_device_cpu(request):
+    """Guaranteed >=2-device CPU host for dp property tests.
+
+    This suite's header already forces an 8-device CPU topology, so the
+    fixture normally just hands back the devices. On a host where jax
+    initialized short anyway (conftest bypassed, exotic plugin), it
+    re-runs the requesting test in a child pinned to 8 CPU devices via
+    the shared helper (common/hostdev.py — the pattern attn_smoke used
+    to hand-roll) and reports that child's verdict, so dp=2/4 tests
+    stay in the fast tier on any host."""
+    if jax.default_backend() == "cpu" and len(jax.devices()) >= 2:
+        return jax.devices()
+    from analytics_zoo_tpu.common import hostdev
+    if os.environ.get(hostdev.CHILD_ENV) == "1":
+        pytest.fail(f"re-exec child still has {len(jax.devices())} "
+                    f"{jax.default_backend()} device(s)")
+    rc = hostdev.reexec_pytest(request.node.nodeid, n=8)
+    if rc != 0:
+        pytest.fail(
+            f"test failed under forced 8-device CPU re-exec (rc={rc})")
+    pytest.skip("verified in re-exec child on a forced 8-device CPU host")

@@ -1,0 +1,162 @@
+"""Parallelism property tests (fast tier): the GPipe bubble fraction
+measured from telemetry trace spans vs the analytic bound, and the MoE
+capacity-overflow drop semantics + its observability counter.
+
+These pin behavior a refactor could silently change: the pipeline
+schedule must keep every rank busy for exactly M of the M+S-1 ticks
+(bubble = (S-1)/(M+S-1)), a 1-microbatch schedule must be flagged
+loudly instead of silently serializing, and tokens routed past expert
+capacity must be DROPPED (zero combine weight) with the shortfall
+surfaced in ``zoo_moe_dropped_tokens_total`` — never silently eaten.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.parallel import (make_mesh, pipeline_forward,
+                                        stack_stage_params,
+                                        stage_param_sharding)
+from analytics_zoo_tpu.utils import telemetry
+
+
+@pytest.fixture
+def _telemetry_on():
+    telemetry.reset_for_tests()
+    telemetry.set_enabled(True)
+    yield
+    telemetry.reset_for_tests()
+
+
+def _stage_fn(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def _run_pipeline(S, M, H=8, B=16):
+    # B = 16 keeps every microbatch divisible by the dp axis (8/S) for
+    # all parametrized M
+    mesh = make_mesh(data=8 // S, pipe=S)
+    rng = np.random.default_rng(0)
+    per_stage = [{"w": jnp.asarray(rng.standard_normal((H, H)) /
+                                   np.sqrt(H), jnp.float32),
+                  "b": jnp.zeros((H,), jnp.float32)}
+                 for _ in range(S)]
+    stacked = stack_stage_params(per_stage)
+    stacked = jax.device_put(stacked, stage_param_sharding(stacked, mesh))
+    x = jnp.asarray(rng.standard_normal((B, H)), jnp.float32)
+    return pipeline_forward(_stage_fn, stacked, x, mesh, n_microbatch=M)
+
+
+def _events(name):
+    return [ev.get("args", {}) for ev in telemetry.flight_events()
+            if ev["name"] == name]
+
+
+@pytest.mark.parametrize("M", [2, 4, 8])
+def test_pipeline_bubble_fraction_matches_analytic(_telemetry_on, M):
+    """Measure the bubble from the emitted per-rank occupancy spans and
+    check it against the analytic GPipe bound (S-1)/(M+S-1) — from the
+    trace, not by re-evaluating the same closed form on the same
+    inputs the scheduler used."""
+    S = 4
+    _run_pipeline(S, M)
+
+    occ = _events("pipeline/stage_occupancy")
+    assert len(occ) == S, f"expected {S} per-rank occupancy events: {occ}"
+    assert sorted(ev["rank"] for ev in occ) == list(range(S))
+    busy = sum(ev["busy_ticks"] for ev in occ)
+    total = sum(ev["total_ticks"] for ev in occ)
+    measured_bubble = 1.0 - busy / total
+    analytic = (S - 1) / (M + S - 1)
+    assert measured_bubble == pytest.approx(analytic, abs=1e-9), \
+        f"measured {measured_bubble} vs analytic {analytic} (S={S}, M={M})"
+
+    sched = _events("pipeline/schedule")
+    assert len(sched) == 1
+    assert sched[0]["ticks"] == M + S - 1
+    assert sched[0]["bubble_fraction"] == pytest.approx(analytic)
+    # more microbatches must shrink the bubble, never grow it
+    assert measured_bubble < (S - 1) / (1 + S - 1)
+
+
+def test_pipeline_single_microbatch_flagged(_telemetry_on):
+    """M=1 serializes the whole pipeline (bubble (S-1)/S) — it must run
+    correctly but scream, not pass silently."""
+    S = 4
+    _run_pipeline(S, 1)
+    degen = _events("pipeline/degenerate_schedule")
+    assert len(degen) == 1, "1-microbatch schedule was not flagged"
+    assert degen[0]["stages"] == S
+    assert degen[0]["bubble_fraction"] == pytest.approx((S - 1) / S)
+
+
+# --------------------------------------------------------------- MoE caps
+
+def _overflowing_moe():
+    from analytics_zoo_tpu.pipeline.api.keras.layers import SparseMoE
+
+    h, e = 4, 2
+    layer = SparseMoE(n_experts=e, intermediate_size=4, top_k=1,
+                      capacity_factor=0.25, name="props_moe")
+    params = dict(layer.build(jax.random.PRNGKey(0), (None, h)))
+    # deterministic routing: every token prefers expert 0
+    params["router_w"] = jnp.zeros_like(params["router_w"]) \
+        .at[:, 0].set(5.0)
+    return layer, params
+
+
+def test_moe_capacity_overflow_drops_exact_count(_telemetry_on):
+    """n=8 tokens, top_k=1, all routed to expert 0 with capacity
+    ceil(8/2*0.25)=1: exactly one token is served, the 7 over-capacity
+    tokens get ZERO output rows (dropped, not re-routed to the cold
+    expert), and the drop count lands in the telemetry counter."""
+    layer, params = _overflowing_moe()
+    n = 8
+    x = jnp.ones((n, 4), jnp.float32)
+    out = np.asarray(layer.call(params, x))
+
+    nonzero = np.abs(out).sum(axis=-1) > 1e-6
+    assert nonzero.sum() == 1, \
+        f"expected 1 in-capacity row, got {nonzero.sum()}"
+    # capacity is assigned in token order (running cumsum): token 0 wins
+    assert nonzero[0] and not nonzero[1:].any()
+
+    drops = [m for m in telemetry.snapshot_metrics()["metrics"]
+             if m["name"] == "zoo_moe_dropped_tokens_total" and
+             m["labels"].get("layer") == "props_moe"]
+    assert drops, "drop counter never surfaced"
+    assert sum(m["value"] for m in drops) == pytest.approx(n - 1)
+
+
+def test_moe_no_overflow_counts_zero_drops(_telemetry_on):
+    """Head-room case: with capacity >= n every token is served and the
+    counter stays at exactly zero (the callback still fires — absence
+    of drops is an observation, not an absence of telemetry)."""
+    from analytics_zoo_tpu.pipeline.api.keras.layers import SparseMoE
+
+    layer = SparseMoE(n_experts=2, intermediate_size=4, top_k=1,
+                      capacity_factor=4.0, name="props_moe_ok")
+    params = layer.build(jax.random.PRNGKey(1), (None, 4))
+    x = jnp.asarray(np.random.default_rng(2)
+                    .standard_normal((6, 4)), jnp.float32)
+    out = np.asarray(layer.call(params, x))
+    assert (np.abs(out).sum(axis=-1) > 1e-8).all()
+
+    drops = [m for m in telemetry.snapshot_metrics()["metrics"]
+             if m["name"] == "zoo_moe_dropped_tokens_total" and
+             m["labels"].get("layer") == "props_moe_ok"]
+    assert drops and sum(m["value"] for m in drops) == 0.0
+
+
+def test_moe_drop_counter_absent_when_disabled():
+    """Telemetry gating is trace-time: a call with telemetry off keeps
+    no callback and registers no metric."""
+    telemetry.reset_for_tests()
+    telemetry.set_enabled(False)
+    layer, params = _overflowing_moe()
+    layer.call(params, jnp.ones((8, 4), jnp.float32))
+    names = {m["name"] for m in telemetry.snapshot_metrics()["metrics"]}
+    assert "zoo_moe_dropped_tokens_total" not in names
+    telemetry.reset_for_tests()
